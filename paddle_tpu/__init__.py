@@ -1,0 +1,79 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new framework with the capabilities of the reference PaddlePaddle fork
+(`/root/reference`), redesigned TPU-first: a single eager API whose autograd
+tape records `jax.vjp` closures, so the same code runs eagerly (dygraph
+analog) or traces under `paddle_tpu.jit.to_static` into one fused XLA program
+(static-graph analog). Distribution is GSPMD sharding over a
+`jax.sharding.Mesh` instead of NCCL program rewriting.
+"""
+__version__ = "0.1.0"
+
+from .core.dtype import (  # noqa: F401
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .core.random import seed, get_rng_state_tracker  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import add_n  # noqa: F401
+
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import amp  # noqa: F401
+from . import metric  # noqa: F401
+from . import framework  # noqa: F401
+from . import device  # noqa: F401
+
+from .framework import CPUPlace, TPUPlace, CUDAPlace, get_flags, set_flags  # noqa: F401
+from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401
+from .io.serialization import save, load  # noqa: F401
+
+# heavier subpackages are imported lazily to keep import cost low
+_LAZY = ("distributed", "vision", "text", "hapi", "profiler", "inference",
+         "ops", "incubate", "static", "onnx")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi.model import Model
+        return Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+        return DataParallel
+    if name == "summary":
+        from .hapi.summary import summary
+        return summary
+    if name == "flops":
+        from .hapi.flops import flops
+        return flops
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def disable_static(place=None):
+    """No-op: paddle_tpu is always 'dygraph' (eager-traceable)."""
+
+
+def enable_static():
+    import warnings
+    warnings.warn("paddle_tpu has no separate static mode; use "
+                  "paddle_tpu.jit.to_static to compile", stacklevel=2)
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled():
+    from .core import autograd
+    return autograd.grad_enabled()

@@ -1,0 +1,171 @@
+"""paddle_tpu.amp — mixed precision.
+
+Parity: `python/paddle/amp/` (auto_cast `amp/auto_cast.py:21`, GradScaler
+`grad_scaler.py:26`; reference kernels `operators/amp/
+check_finite_and_unscale_op.cc`, `update_loss_scaling_op.cc`).
+
+TPU-native stance: bf16 is the native fast dtype; it has fp32's exponent
+range, so **loss scaling is unnecessary** for bf16 (GradScaler becomes a
+near-no-op that still tracks the API). auto_cast('bfloat16') casts op inputs
+at the eager-dispatch boundary (the analog of the tracer-level cast insertion
+in `imperative/amp_auto_cast.cc`), and under jit the casts compile away into
+bf16 MXU matmuls.
+"""
+import contextlib
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..core.dtype import convert_dtype, float32, bfloat16, float16
+
+# ops (by name of the jnp-level function wrapped) that benefit from low
+# precision — the "white list" (reference `fp16_lists.py`)
+_WHITE = {"matmul", "conv"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_to_compute(x_value):
+    """Called by F.linear / matmul / conv paths when amp is enabled."""
+    if not _state.enabled:
+        return x_value
+    if x_value.dtype in (jnp.float32,):
+        return x_value.astype(_state.dtype)
+    return x_value
+
+
+class GradScaler:
+    """Dynamic loss scaling — needed for fp16, a no-op pass-through for bf16
+    (kept for API parity; `init_loss_scaling=1` disables scaling)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        s = self._scale
+        return apply(lambda v: v * s, loss)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found_inf = found_inf | bool(
+                    np.any(~np.isfinite(np.asarray(g))))
+                p.grad._value = g
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate analog: for O2, cast model params to the compute
+    dtype (master fp32 copies live in the optimizer state, which is always
+    fp32 here)."""
+    dt = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,4 @@
+from . import dtype, autograd, random, tensor  # noqa: F401
+from .tensor import Tensor, Parameter, apply, to_tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .random import seed, default_generator, get_rng_state_tracker  # noqa: F401

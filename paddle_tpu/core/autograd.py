@@ -1,0 +1,216 @@
+"""Define-by-run autograd engine on JAX.
+
+TPU-native replacement for the reference's imperative autograd
+(`/root/reference/paddle/fluid/imperative/basic_engine.cc:39,251,379` BasicEngine
+and `tracer.cc:146,235` grad-node recording). Instead of recording OpBase grad
+nodes that later dispatch CUDA kernels, every eager op records a `jax.vjp`
+closure on a thread-local tape; `Tensor.backward()` walks the tape in reverse
+creation order (the tape is already topologically sorted, so no dep-counting
+pass like PrepareDeps is needed) and accumulates cotangents.
+
+The key TPU design win: all of this machinery runs at *trace time* under
+`jax.jit`, so a whole train step (forward + backward + optimizer update)
+compiles to a single fused XLA program — the reference needed a second world
+(static graph + append_backward, `python/paddle/fluid/backward.py:1390`) to get
+that; here eager and compiled are one code path.
+"""
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax.dtypes import float0
+
+
+class _AutogradState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.nodes = []  # the tape, in op-creation (topological) order
+
+
+_state = _AutogradState()
+
+
+def grad_enabled():
+    return _state.grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Analog of paddle.no_grad / dygraph no_grad (`fluid/dygraph/base.py`)."""
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def set_grad_enabled(mode):
+    prev = _state.grad_enabled
+    _state.grad_enabled = not not mode
+    return prev
+
+
+class Node:
+    """One recorded op: inputs, outputs, and its reverse rule.
+
+    Analog of `imperative::OpBase` + GradOpNode (`imperative/op_base.h`) with
+    the grad kernel replaced by a jax.vjp closure.
+    """
+
+    __slots__ = ("inputs", "outputs", "vjp_fn", "multi_output")
+
+    def __init__(self, inputs, outputs, vjp_fn, multi_output):
+        self.inputs = inputs          # tuple[Tensor]
+        self.outputs = outputs        # tuple[Tensor]
+        self.vjp_fn = vjp_fn
+        self.multi_output = multi_output
+
+
+def record(node):
+    _state.nodes.append(node)
+    for o in node.outputs:
+        o._has_producer = True
+
+
+def tape_size():
+    return len(_state.nodes)
+
+
+@contextlib.contextmanager
+def fresh_tape():
+    """Push a fresh tape (used when tracing a compiled step so recorded nodes
+    never leak between trace-time and eager graphs)."""
+    prev = _state.nodes
+    _state.nodes = []
+    try:
+        yield
+    finally:
+        _state.nodes = prev
+
+
+def clear_tape():
+    _state.nodes.clear()
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Reverse-mode over the tape. Analog of BasicEngine::Execute
+    (`imperative/basic_engine.cc:379`) + GradientAccumulator summation
+    (`gradient_accumulator.cc`)."""
+    from .tensor import Tensor
+
+    if grad is None:
+        seed = jnp.ones_like(tensor._value)
+    elif isinstance(grad, Tensor):
+        seed = grad._value
+    else:
+        seed = jnp.asarray(grad, dtype=tensor._value.dtype)
+
+    # pending cotangents for non-leaf tensors, keyed by identity
+    pending = {id(tensor): seed}
+    if tensor._retain_grad or not tensor._has_producer:
+        if not tensor.stop_gradient:
+            tensor._accumulate_grad(seed)
+
+    for node in reversed(_state.nodes):
+        if not any(id(o) in pending for o in node.outputs):
+            continue
+        cots = []
+        for o in node.outputs:
+            c = pending.pop(id(o), None)
+            if c is None:
+                c = jnp.zeros_like(o._value)
+            cots.append(c)
+        cot = tuple(cots) if node.multi_output else cots[0]
+        in_grads = node.vjp_fn(cot)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp.stop_gradient or g.dtype == float0:
+                continue
+            if inp._has_producer:
+                prev = pending.get(id(inp))
+                pending[id(inp)] = g if prev is None else prev + g
+                if inp._retain_grad:
+                    inp._accumulate_grad(g)
+            else:
+                # leaf: accumulate into .grad (paddle accumulates across
+                # backward() calls until clear_grad, varbase_patch_methods.py)
+                inp._accumulate_grad(g)
+
+    if not retain_graph:
+        clear_tape()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         allow_unused=True):
+    """Analog of paddle.grad (`imperative/partial_grad_engine.cc`): grads of
+    outputs w.r.t. an explicit input list, without touching .grad fields."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    pending = {}
+    for o, g in zip(outputs, grad_outputs):
+        seed = jnp.ones_like(o._value) if g is None else (
+            g._value if isinstance(g, Tensor) else jnp.asarray(g))
+        prev = pending.get(id(o))
+        pending[id(o)] = seed if prev is None else prev + seed
+
+    wanted = {id(t): i for i, t in enumerate(inputs)}
+    results = [None] * len(inputs)
+
+    def _stash(t, g):
+        i = wanted.get(id(t))
+        if i is not None:
+            results[i] = g if results[i] is None else results[i] + g
+
+    for o in outputs:
+        if id(o) in wanted:
+            _stash(o, pending[id(o)])
+
+    for node in reversed(_state.nodes):
+        if not any(id(o) in pending for o in node.outputs):
+            continue
+        cots = []
+        for o in node.outputs:
+            c = pending.pop(id(o), None)
+            cots.append(jnp.zeros_like(o._value) if c is None else c)
+        cot = tuple(cots) if node.multi_output else cots[0]
+        in_grads = node.vjp_fn(cot)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp.stop_gradient or g.dtype == float0:
+                continue
+            if inp._has_producer:
+                prev = pending.get(id(inp))
+                pending[id(inp)] = g if prev is None else prev + g
+            _stash(inp, g)
+
+    if not retain_graph:
+        clear_tape()
+
+    out = []
+    for i, t in enumerate(inputs):
+        if results[i] is None:
+            if not allow_unused:
+                raise RuntimeError(f"input {i} unused in the graph")
+            out.append(None)
+        else:
+            out.append(Tensor(results[i], stop_gradient=True))
+    return out

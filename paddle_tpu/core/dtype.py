@@ -1,0 +1,85 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native replacement for the reference's dtype enum
+(`/root/reference/paddle/fluid/framework/framework.proto:117` VarType and
+`paddle/fluid/framework/data_type.h`). Canonical dtypes are numpy dtypes
+(bfloat16 via ml_dtypes, which JAX re-exports); bf16 is the *default compute
+policy* on TPU rather than an AMP afterthought.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+# canonical dtype singletons (numpy dtype objects)
+bool = np.dtype("bool")  # noqa: A001 - mirrors paddle.bool
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "fp16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16, "float32": float32,
+    "fp32": float32, "float64": float64, "fp64": float64,
+    "complex64": complex64, "complex128": complex128, "float": float32,
+    "double": float64, "int": int32, "long": int64, "half": float16,
+}
+
+_default_dtype = float32
+
+
+def _demote_64(dtype):
+    """When jax x64 is off (the TPU-native default: 64-bit is slow and rarely
+    wanted on TPU), silently canonicalize 64-bit requests to 32-bit rather
+    than warn on every index op."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return dtype
+    if dtype == int64:
+        return int32
+    if dtype == float64:
+        return float32
+    if dtype == complex128:
+        return complex64
+    return dtype
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp type/python type to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, np.dtype):
+        return _demote_64(dtype)
+    if isinstance(dtype, str):
+        try:
+            return _demote_64(_ALIASES[dtype])
+        except KeyError:
+            raise ValueError(f"unsupported dtype string: {dtype!r}") from None
+    return _demote_64(np.dtype(dtype))
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating(dtype):
+    return np.issubdtype(convert_dtype(dtype), np.floating) or convert_dtype(dtype) == bfloat16
+
+
+def is_integer(dtype):
+    return np.issubdtype(convert_dtype(dtype), np.integer)

@@ -1,0 +1,115 @@
+"""Stateful RNG facade over JAX's functional PRNG.
+
+The reference uses per-device stateful generators
+(`/root/reference/paddle/fluid/framework/generator.cc`, python `paddle.seed`,
+and the model-parallel RNG tracker
+`python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py`).
+JAX PRNG is functional (explicit keys), so we keep a global Generator that
+splits a fresh subkey per call — eager code gets paddle's stateful feel.
+
+Inside a `to_static`/jit-traced function the global key would be baked in as a
+constant (same dropout mask every step). `rng_guard(key)` threads a *traced*
+key through instead: jitted train steps pass a per-step key and all random ops
+inside draw from it. `RNGStatesTracker` reproduces the model-parallel seed
+discipline (same dropout mask inside a TP group where activations are
+replicated, different where they are sharded).
+"""
+import contextlib
+import threading
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed=0):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey, advancing internal state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.generator = Generator(0)
+        self.override = None  # traced key stack for jitted regions
+
+
+_state = _RngState()
+
+
+def seed(s):
+    """paddle.seed analog."""
+    _state.generator.manual_seed(int(s))
+    return _state.generator
+
+
+def default_generator():
+    return _state.generator
+
+
+def next_key():
+    """Fresh PRNG subkey for one random op."""
+    if _state.override is not None:
+        key, sub = jax.random.split(_state.override)
+        _state.override = key
+        return sub
+    return _state.generator.split()
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Thread an explicit (possibly traced) key through random ops — used by
+    jitted train steps and by the MP rng tracker."""
+    prev = _state.override
+    _state.override = key
+    try:
+        yield
+    finally:
+        _state.override = prev
+
+
+class RNGStatesTracker:
+    """Model-parallel RNG tracker — analog of
+    `meta_parallel/parallel_layers/random.py` model_parallel_rng tracker."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name, seed_):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.PRNGKey(int(seed_))
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states:
+            raise ValueError(f"unknown rng state {name}")
+        key, sub = jax.random.split(self.states[name])
+        self.states[name] = key
+        with rng_guard(sub):
+            yield
+
+
+_mp_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _mp_tracker

@@ -1,0 +1,300 @@
+"""Eager Tensor on JAX arrays.
+
+TPU-native replacement for the reference's dense Tensor + dygraph VarBase
+(`/root/reference/paddle/fluid/framework/tensor.h:89`,
+`paddle/fluid/imperative/layer.cc` VarBase,
+`python/paddle/fluid/dygraph/varbase_patch_methods.py`). A Tensor wraps a
+jax.Array (device-resident, XLA-managed — the reference's Allocation/allocator
+stack, `memory/allocation/allocator_facade.cc:104`, is owned by the XLA runtime
+here) or a JAX tracer when executing under `paddle_tpu.jit.to_static`.
+
+`apply()` is the single eager-dispatch point — the analog of
+`imperative::Tracer::TraceOp` (`imperative/tracer.cc:146`) + PreparedOp kernel
+launch (`prepared_operator.cc:92,228`): it runs the jnp/lax computation and, if
+gradient is required, records a jax.vjp closure on the autograd tape.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype, bfloat16
+
+_tensor_method_registry = {}
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
+                 "_has_producer", "_retain_grad", "trainable", "is_distributed",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None,
+                 place=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        dtype = convert_dtype(dtype)
+        if isinstance(value, (jax.Array, jax.core.Tracer)):
+            if dtype is not None and value.dtype != dtype:
+                value = value.astype(dtype)
+        else:
+            if dtype is None and isinstance(value, (float,)):
+                dtype = get_default_dtype()
+            if dtype is None and isinstance(value, (list, tuple)):
+                arr = np.asarray(value)
+                if arr.dtype == np.float64:
+                    dtype = get_default_dtype()
+            value = jnp.asarray(value, dtype=dtype)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self.is_distributed = False
+        self._has_producer = False
+        self._retain_grad = False
+
+    # ---- metadata -------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def rank(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._value.devices()))
+            return f"Place({dev.platform}:{dev.id})"
+        except Exception:
+            return "Place(traced)"
+
+    @property
+    def is_leaf(self):
+        return not self._has_producer
+
+    @property
+    def T(self):
+        # paddle.Tensor.T reverses all dims
+        perm = tuple(range(self._value.ndim - 1, -1, -1))
+        return apply(lambda v: jnp.transpose(v, perm), self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._value.aval}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    # ---- host interchange ----------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    # ---- autograd -------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad._value)
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def _accumulate_grad(self, g):
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._value + g, stop_gradient=True)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        self._has_producer = False
+        return self
+
+    def stop_gradient_(self, flag=True):
+        self.stop_gradient = flag
+        return self
+
+    # ---- value mutation (optimizer in-place updates) -------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # ---- device / dtype movement ---------------------------------------
+    def cpu(self):
+        return Tensor(np.asarray(self._value), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, np.dtype)) and str(a) not in ("cpu", "gpu", "tpu"):
+                try:
+                    dtype = convert_dtype(a)
+                except ValueError:
+                    pass
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ---- indexing -------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply(lambda v: v[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = self._value.at[idx].set(value)
+        return self
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # generic method plumbing: ops attach themselves via register_method
+    def __getattr__(self, item):
+        fn = _tensor_method_registry.get(item)
+        if fn is None:
+            raise AttributeError(f"'Tensor' object has no attribute {item!r}")
+        return fn.__get__(self, Tensor)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor — analog of `framework.py:5954` ParamBase."""
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# eager dispatch
+# ---------------------------------------------------------------------------
+
+def apply(fn, *tensors):
+    """Run `fn` over the raw values of `tensors`; record vjp on the tape when
+    gradient is required. fn takes/returns jax values (single or tuple)."""
+    vals = tuple(t._value for t in tensors)
+    requires = autograd.grad_enabled() and any(
+        not t.stop_gradient for t in tensors)
+    if requires:
+        outs, vjp_fn = jax.vjp(fn, *vals)
+    else:
+        outs = fn(*vals)
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+    wrapped = [Tensor(o, stop_gradient=not requires) for o in out_list]
+    if requires:
+        autograd.record(autograd.Node(tensors, tuple(wrapped), vjp_fn, multi))
+    return wrapped if multi else wrapped[0]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor analog (`python/paddle/tensor/creation.py`)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def as_tensor_args(*args, dtype=None):
+    return tuple(a if isinstance(a, Tensor) else Tensor(a, dtype=dtype)
+                 for a in args)
+
+
+def register_method(name, fn=None):
+    """Attach a function as a Tensor method (the reference monkey-patches
+    VarBase the same way, `varbase_patch_methods.py:monkey_patch_varbase`)."""
+    if fn is None:
+        def deco(f):
+            _tensor_method_registry[name] = f
+            return f
+        return deco
+    _tensor_method_registry[name] = fn
+    return fn

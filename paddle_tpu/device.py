@@ -1,0 +1,77 @@
+"""Device management — analog of `paddle.device` + DeviceContextPool
+(`platform/device_context.h:818`). On TPU, streams/contexts are XLA's; this
+module only selects the default JAX device and reports topology.
+"""
+import jax
+
+_current_device = None
+
+
+def set_device(device):
+    """Accepts 'cpu', 'tpu', 'tpu:0', 'gpu:0' (mapped to accelerator)."""
+    global _current_device
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name in ("gpu", "cuda", "xpu", "npu"):
+        name = _default_backend()
+    devs = [d for d in jax.devices() if d.platform == name] or jax.devices()
+    _current_device = devs[min(idx, len(devs) - 1)]
+    jax.config.update("jax_default_device", _current_device)
+    return _current_device
+
+
+def _default_backend():
+    return jax.default_backend()
+
+
+def get_device():
+    if _current_device is not None:
+        return f"{_current_device.platform}:{_current_device.id}"
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (the reference's
+    cudaDeviceSynchronize analog; XLA arrays expose block_until_ready)."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """API-parity stub: XLA orders work; there are no user streams on TPU."""
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream()

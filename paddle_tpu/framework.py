@@ -1,0 +1,95 @@
+"""Framework-level utilities: places, flags, dtype helpers.
+
+Replaces the reference's `Place` variant (`platform/place.h:26-150`) and the
+exported-gflags registry (`platform/flags.cc`,
+`pybind/global_value_getter_setter.cc`). Devices are PJRT devices owned by
+JAX/XLA; Place objects are thin identities kept for API parity.
+"""
+import os
+
+import jax
+import numpy as np
+
+
+class Place:
+    def __init__(self, kind, device_id=0):
+        self.kind = kind
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.kind == other.kind
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPlace(Place):
+    """Kept for API compatibility; maps onto the accelerator device."""
+
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+CUDAPinnedPlace = CPUPlace
+XPUPlace = TPUPlace
+NPUPlace = TPUPlace
+
+
+# ---------------------------------------------------------------------------
+# flags registry — analog of PADDLE_DEFINE_EXPORTED gflags (flags.cc)
+# ---------------------------------------------------------------------------
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_default_dtype": "float32",
+    "FLAGS_use_donated_buffers": True,
+    "FLAGS_jit_cache_dir": "",
+    "FLAGS_profile": False,
+    "FLAGS_allocator_strategy": "xla",
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": False,
+    "FLAGS_max_inplace_grad_add": 0,
+}
+
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _v = os.environ[_k]
+        if isinstance(_FLAGS[_k], bool):
+            _FLAGS[_k] = _v.lower() in ("1", "true", "yes")
+        else:
+            _FLAGS[_k] = type(_FLAGS[_k])(_v)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def core_avx_supported():
+    return True
+
+
+def _current_expected_place():
+    dev = jax.devices()[0]
+    return Place(dev.platform, dev.id)

@@ -1,0 +1,8 @@
+"""paddle_tpu.io — mirrors `python/paddle/io/`."""
+from .dataloader import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ConcatDataset,
+    ChainDataset, Subset, random_split, Sampler, SequenceSampler,
+    RandomSampler, WeightedRandomSampler, BatchSampler,
+    DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
+)
+from .serialization import save, load  # noqa: F401

@@ -1,0 +1,58 @@
+"""paddle.save / paddle.load analog.
+
+Parity: `python/paddle/framework/io.py:550,766` — pickle protocol with
+tensors converted to numpy. Orbax-based sharded/async checkpointing for
+distributed training lives in `paddle_tpu.distributed.checkpoint`.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _SavedTensor(np.asarray(obj._value), obj.name)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj, return_numpy=False):
+    if isinstance(obj, _SavedTensor):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+class _SavedTensor:
+    __slots__ = ("array", "name")
+
+    def __init__(self, array, name=None):
+        self.array = array
+        self.name = name
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy)

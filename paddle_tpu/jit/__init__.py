@@ -1,0 +1,265 @@
+"""paddle_tpu.jit — trace-compile eager code into XLA programs.
+
+TPU-native replacement for the reference's dynamic-to-static subsystem
+(`python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:768`,
+15+ AST transformers, `partial_program.py` run_program_op). No AST rewriting
+is needed: the eager Tensor ops *are* traceable jax computations, so
+`to_static` simply binds Layer parameters/buffers as traced inputs and runs
+the Python function under `jax.jit`. The autograd tape records at trace time,
+so a whole train step (forward+backward+optimizer) compiles into ONE fused
+XLA program — `TrainStep` packages that pattern.
+"""
+import contextlib
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd
+from ..core.random import rng_guard, default_generator
+from ..core.dtype import convert_dtype
+
+
+class InputSpec:
+    """Shape/dtype spec for traced inputs (paddle.static.InputSpec analog)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+@contextlib.contextmanager
+def bind_tensors(tensors, values):
+    """Temporarily swap raw values (possibly tracers) into Tensors; always
+    restores, even on trace error."""
+    olds = [t._value for t in tensors]
+    grads = [t.grad for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+        t.grad = None
+    try:
+        yield
+    finally:
+        for t, o, g in zip(tensors, olds, grads):
+            t._value = o
+            t.grad = g
+
+
+def _split_args(args):
+    """Flatten args into (tensor values, rebuild fn, static cache key)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor))
+    dyn_idx, dyn_vals, static = [], [], []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Tensor):
+            dyn_idx.append(i)
+            dyn_vals.append(leaf._value)
+            static.append(None)
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            dyn_idx.append(i)
+            dyn_vals.append(jnp.asarray(leaf))
+            static.append(None)
+        else:
+            static.append(leaf)
+
+    def rebuild(values):
+        out = list(static)
+        for i, v in zip(dyn_idx, values):
+            out[i] = Tensor(v)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    key = (treedef, tuple(s if _hashable(s) else repr(s) for s in static))
+    return dyn_vals, rebuild, key
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def _unwrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+
+class StaticFunction:
+    """Compiled wrapper of a python function / Layer forward."""
+
+    def __init__(self, function, layer=None, input_spec=None):
+        self._fn = function
+        self._layer = layer if layer is not None else getattr(
+            function, "__self__", None)
+        from ..nn.layer.layers import Layer
+        if not isinstance(self._layer, Layer):
+            self._layer = None
+        self._input_spec = input_spec
+        self._jit_cache = {}
+        try:
+            functools.update_wrapper(self, function,
+                                     assigned=("__name__", "__doc__"))
+        except Exception:
+            pass
+
+    def _collect_state(self):
+        if self._layer is None:
+            return [], []
+        params = [p for _, p in self._layer.named_parameters()]
+        buffers = [b for _, b in self._layer.named_buffers() if b is not None]
+        return params, buffers
+
+    def __call__(self, *args, **kwargs):
+        params, buffers = self._collect_state()
+        dyn_vals, rebuild, key = _split_args(args)
+        cache_key = (key, tuple(sorted(kwargs)) if kwargs else ())
+
+        jitted = self._jit_cache.get(cache_key)
+        if jitted is None:
+            fn = self._fn
+
+            def traced(param_vals, buffer_vals, rng, arg_vals):
+                with autograd.fresh_tape(), autograd.no_grad(), \
+                        bind_tensors(params, param_vals), \
+                        bind_tensors(buffers, buffer_vals), rng_guard(rng):
+                    rebuilt = rebuild(arg_vals)
+                    out = fn(*rebuilt, **kwargs)
+                    new_buf = [b._value for b in buffers]
+                    return _unwrap_out(out), new_buf
+
+            jitted = jax.jit(traced)
+            self._jit_cache[cache_key] = jitted
+
+        rng = default_generator().split()
+        out_vals, new_buf = jitted([p._value for p in params],
+                                   [b._value for b in buffers], rng, dyn_vals)
+        for b, v in zip(buffers, new_buf):
+            b._value = v
+        return _wrap_out(out_vals)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper: compile a function or a Layer.
+
+    paddle.jit.to_static analog. Accepts a Layer (compiles its forward) or a
+    function (possibly a bound Layer method).
+    """
+    from ..nn.layer.layers import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, layer=obj,
+                                    input_spec=input_spec)
+            obj.forward = static
+            return obj
+        return StaticFunction(obj, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(function):
+    function._not_to_static = True
+    return function
+
+
+class TrainStep:
+    """One fused-XLA training step: forward + backward + clip + optimizer.
+
+    The TPU-native answer to the reference's static-graph training path
+    (program + `append_backward` `python/paddle/fluid/backward.py:1390` +
+    optimizer ops run by `framework/executor.cc:485`): the same eager code is
+    traced once and jitted, with params/opt-state donated so updates happen
+    in-place in HBM.
+
+    loss_fn(*batch_tensors) -> scalar loss Tensor, computed with the model
+    (closed over). Buffers (e.g. BN running stats) are threaded functionally.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.params = [p for _, p in model.named_parameters()
+                       if not p.stop_gradient]
+        self.buffers = [b for _, b in model.named_buffers() if b is not None]
+        for p in self.params:
+            self.optimizer._get_state(p)
+        self._jitted = None
+        self._donate = donate
+
+    def _make_step(self):
+        params, buffers, opt = self.params, self.buffers, self.optimizer
+        loss_fn = self.loss_fn
+
+        def step(param_vals, opt_states, buffer_vals, lr, rng, batch_vals):
+            with autograd.fresh_tape(), \
+                    bind_tensors(params, param_vals), \
+                    bind_tensors(buffers, buffer_vals), rng_guard(rng):
+                batch = [Tensor(v) for v in batch_vals]
+                loss = loss_fn(*batch)
+                autograd.backward(loss)
+                grads = []
+                for p in params:
+                    grads.append(p.grad._value if p.grad is not None
+                                 else jnp.zeros_like(p._value))
+                with autograd.no_grad():
+                    if opt._grad_clip is not None:
+                        pg = opt._grad_clip(
+                            [(p, Tensor(g)) for p, g in zip(params, grads)])
+                        grads = [g._value for _, g in pg]
+                    new_vals, new_states = opt._functional_apply(
+                        params, param_vals, grads, opt_states, lr)
+                new_buf = [b._value for b in buffers]
+                return loss._value, new_vals, new_states, new_buf
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._jitted = self._make_step()
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        param_vals = [p._value for p in self.params]
+        opt_states = [self.optimizer._states[id(p)] for p in self.params]
+        buffer_vals = [b._value for b in self.buffers]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        rng = default_generator().split()
+        loss, new_vals, new_states, new_buf = self._jitted(
+            param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        for p, v in zip(self.params, new_vals):
+            p._value = v
+            p.grad = None
+        for p, s in zip(self.params, new_states):
+            self.optimizer._states[id(p)] = s
+        for b, v in zip(self.buffers, new_buf):
+            b._value = v
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export for inference: StableHLO via jax.export + params
+    (paddle.jit.save analog — see paddle_tpu.inference)."""
+    from ..inference.export import save_inference_model
+    save_inference_model(path, layer, input_spec=input_spec)
+
+
+def load(path, **configs):
+    from ..inference.export import load_inference_model
+    return load_inference_model(path)

@@ -1,0 +1,166 @@
+"""paddle_tpu.metric — mirrors `python/paddle/metric/metrics.py`."""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred) if not isinstance(pred, Tensor) else pred.numpy()
+        label = np.asarray(label) if not isinstance(label, Tensor) else label.numpy()
+        order = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            label = label.squeeze(-1)
+        correct = (order == label[..., None]).astype(np.float32)
+        return Tensor(correct)
+
+    def update(self, correct, *args):
+        if isinstance(correct, Tensor):
+            correct = correct.numpy()
+        n = correct.reshape(-1, correct.shape[-1]).shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].sum()
+            self.count[i] += n
+        accs = self.total / np.maximum(self.count, 1)
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        accs = self.total / np.maximum(self.count, 1)
+        return float(accs[0]) if len(self.topk) == 1 else [float(a) for a in accs]
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        pred_bin = (preds.reshape(-1) > 0.5).astype(np.int32)
+        labels = labels.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (labels == 1)).sum())
+        self.fp += int(((pred_bin == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        pred_bin = (preds.reshape(-1) > 0.5).astype(np.int32)
+        labels = labels.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (labels == 1)).sum())
+        self.fn += int(((pred_bin == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        if preds.ndim == 2:
+            preds = preds[:, -1]
+        labels = labels.reshape(-1)
+        idx = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                         self.num_thresholds)
+        for i, lab in zip(idx, labels):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds, descending
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    import jax.numpy as jnp
+    from ..tensor._helpers import ensure_tensor
+    input = ensure_tensor(input)  # noqa: A001
+    label = ensure_tensor(label)
+    iv, lv = input._value, label._value
+    if lv.ndim == iv.ndim:
+        lv = lv.reshape(lv.shape[:-1])
+    import jax
+    _, top_idx = jax.lax.top_k(iv, k)
+    correct_mask = jnp.any(top_idx == lv[..., None], axis=-1)
+    return Tensor(jnp.mean(correct_mask.astype(jnp.float32)))

@@ -1,0 +1,107 @@
+"""Gradient clipping.
+
+Parity: `python/paddle/fluid/clip.py` (ClipGradByValue/Norm/GlobalNorm).
+Operates on (param, grad) lists like the reference; used by Optimizer before
+the update step. Under hybrid parallelism, `distributed.HybridParallelClipGrad`
+wraps GlobalNorm to sum norms across mesh axes.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor._helpers import ensure_tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, apply(
+                lambda v: jnp.clip(v, self.min, self.max), ensure_tensor(g))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            cn = self.clip_norm
+
+            def fn(v):
+                norm = jnp.sqrt(jnp.sum(jnp.square(v)))
+                return jnp.where(norm > cn, v * (cn / jnp.maximum(norm, 1e-12)), v)
+            out.append((p, apply(fn, ensure_tensor(g))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def _compute_global_norm_sq(self, grads):
+        sq = None
+        for g in grads:
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        return sq
+
+    def _dygraph_clip(self, params_grads):
+        grads = [ensure_tensor(g) for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        global_sq = self._compute_global_norm_sq(grads)
+        global_norm = jnp.sqrt(global_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            g = ensure_tensor(g)
+            out.append((p, apply(lambda v: v * scale.astype(v.dtype), g)))
+        return out
+
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads]))
+        total = total ** (1.0 / norm_type)
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = p.grad._value * coef.astype(p.grad._value.dtype)
+    return Tensor(total)

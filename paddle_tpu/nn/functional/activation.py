@@ -1,0 +1,159 @@
+"""Activation functionals.
+
+Parity: `python/paddle/nn/functional/activation.py` (reference kernels
+`operators/activation_op.cc/.cu`). All fuse into adjacent matmuls via XLA on
+TPU — no hand-written fusion needed (reference needed
+`fused_elemwise_activation`).
+"""
+import jax
+import jax.numpy as jnp
+import jax.nn as jnn
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor, unary
+
+
+def _u(fn):
+    def op(x, name=None):
+        return unary(fn, ensure_tensor(x))
+    return op
+
+
+relu = _u(jnn.relu)
+relu6 = _u(jnn.relu6)
+sigmoid = _u(jnn.sigmoid)
+tanh = _u(jnp.tanh)
+silu = _u(jnn.silu)
+swish = _u(jnn.silu)
+mish = _u(lambda v: v * jnp.tanh(jnn.softplus(v)))
+softsign = _u(jnn.soft_sign)
+tanhshrink = _u(lambda v: v - jnp.tanh(v))
+log_sigmoid = _u(jnn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return unary(lambda v: jnn.gelu(v, approximate=approximate),
+                 ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary(lambda v: jnn.leaky_relu(v, negative_slope), ensure_tensor(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return unary(lambda v: jnn.elu(v, alpha), ensure_tensor(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return unary(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 ensure_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return unary(lambda v: jnn.celu(v, alpha), ensure_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return unary(lambda v: jnp.clip(v, min, max), ensure_tensor(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return unary(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                 ensure_tensor(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return unary(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)), ensure_tensor(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return unary(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0),
+                 ensure_tensor(x))
+
+
+def hardswish(x, name=None):
+    return unary(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0,
+                 ensure_tensor(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return unary(lambda v: jnp.where(beta * v > threshold, v,
+                                     jnn.softplus(beta * v) / beta),
+                 ensure_tensor(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def fn(v, w):
+        if w.size > 1 and v.ndim > 1:
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v > 0, v, w * v)
+    return apply(fn, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from ...core.random import next_key
+        key = next_key()
+
+        def fn(v):
+            a = jax.random.uniform(key, v.shape, minval=lower, maxval=upper)
+            return jnp.where(v >= 0, v, a.astype(v.dtype) * v)
+        return apply(fn, x)
+    mid = (lower + upper) / 2.0
+    return unary(lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply(fn, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnn.softmax(v, axis=int(axis)), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnn.log_softmax(v, axis=int(axis)), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    from ...core.random import next_key
+    key = next_key()
+
+    def fn(v):
+        g = jax.random.gumbel(key, v.shape, dtype=v.dtype)
+        y = jnn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply(fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    return unary(lambda v: jnn.glu(v, axis=axis), ensure_tensor(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return unary(lambda v: jnp.where(v > threshold, v, 0.0), ensure_tensor(x))

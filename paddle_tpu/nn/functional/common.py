@@ -1,0 +1,297 @@
+"""Common functionals: linear, dropout, pad, embedding, interpolate, ...
+
+Parity: `python/paddle/nn/functional/common.py` + `input.py` (reference
+kernels `operators/matmul_v2_op.cc` + bias fusion, `dropout_op.cu`,
+`pad3d_op.cc`, `lookup_table_v2_op.cu`, `interpolate_v2_op.cc`).
+`linear` is the MXU workhorse: XLA fuses matmul+bias+activation.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...core.random import next_key
+from ...tensor._helpers import ensure_tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (paddle convention)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), x, weight)
+    bias = ensure_tensor(bias)
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1.0 - p), x)
+        return x
+    if p == 1.0:
+        return apply(lambda v: jnp.zeros_like(v), x)
+    key = next_key()
+    ax = axis if axis is None else (
+        [axis] if isinstance(axis, int) else list(axis))
+
+    def fn(v):
+        if ax is None:
+            mshape = v.shape
+        else:
+            mshape = tuple(v.shape[i] if i in ax else 1 for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, mshape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+    return apply(fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        return a * jnp.where(keep, v, alpha_p) + b
+    return apply(fn, x)
+
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect",
+              "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()  # noqa: A001
+    pad = [int(p) for p in pad]  # noqa: A001
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: [before0, after0, before1, after1, ...]?
+        # paddle uses per-dim pairs in *reverse* only for partial specs; the
+        # full form is ordered by dim.
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (torch/paddle style:
+        # last dim first)
+        widths = [(0, 0)] * nd
+        spatial = len(pad) // 2
+        if "C" in data_format and data_format.index("C") == 1:
+            dims = list(range(2, 2 + spatial))
+        else:
+            dims = list(range(1, 1 + spatial))
+        # paddle pad spec: [left, right, top, bottom, front, back] maps from
+        # innermost spatial dim outward
+        for i, d in enumerate(reversed(dims)):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = _PAD_MODES.get(mode, mode)
+
+    def fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return apply(fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight. On TPU this is an XLA gather; grads produce
+    dense scatter-adds (the reference used SelectedRows sparse grads,
+    `operators/lookup_table_v2_op.cu` — XLA handles the scatter)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    idx = x._value.astype(jnp.int32)
+
+    def fn(w):
+        out = jnp.take(w, jnp.clip(idx, 0, w.shape[0] - 1), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply(fn, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...core.dtype import get_default_dtype
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value, int(num_classes),
+                                 dtype=get_default_dtype()))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+
+    def fn(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+    return apply(fn, label)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply(fn, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis) * jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+    return apply(fn, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def fn(a, b, w):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out
+    out = apply(fn, x1, x2, weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        out = apply(lambda o, c: o + c, out, bias)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    channel_last = data_format[-1] == "C"
+    spatial_dims = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x._value.shape[d] for d in spatial_dims]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size._value)]
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                     for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(in_sizes)
+        out_sizes = [int(s * float(f)) for s, f in zip(in_sizes, sf)]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(v):
+        shape = list(v.shape)
+        for d, s in zip(spatial_dims, out_sizes):
+            shape[d] = s
+        if mode == "nearest":
+            # exact nearest via index gather (jax.image nearest matches)
+            return jax.image.resize(v, shape, method="nearest")
+        if align_corners:
+            # build index grids per spatial dim and gather-interp
+            return _resize_align_corners(v, spatial_dims, out_sizes, jmode)
+        return jax.image.resize(v, shape, method=jmode)
+    return apply(fn, x)
+
+
+def _resize_align_corners(v, spatial_dims, out_sizes, method):
+    out = v
+    for d, s in zip(spatial_dims, out_sizes):
+        n = out.shape[d]
+        if s == 1 or n == 1:
+            idx = jnp.zeros((s,), dtype=jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, n - 1, s)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[d] = s
+        w = w.reshape(shape)
+        take_lo = jnp.take(out, lo, axis=d)
+        take_hi = jnp.take(out, hi, axis=d)
+        if method == "nearest":
+            out = jnp.where(w > 0.5, take_hi, take_lo)
+        else:
+            out = take_lo * (1 - w) + take_hi * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference `operators/math/im2col.cc`, unfold_op)."""
+    x = ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = v[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply(fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = ensure_tensor(x)
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), dtype=v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(
+                    v[:, :, i, j])
+        return out[:, :, pd[0]: pd[0] + os_[0], pd[1]: pd[1] + os_[1]]
+    return apply(fn, x)

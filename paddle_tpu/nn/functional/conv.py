@@ -1,0 +1,176 @@
+"""Convolution functionals on `lax.conv_general_dilated`.
+
+Parity: `python/paddle/nn/functional/conv.py` (reference: cudnn conv kernels
+`operators/conv_cudnn_op.cu`, `conv_op.cc`, `conv_transpose_op.cc`). One lax
+primitive covers every case (groups/dilation/stride); XLA tiles it onto the
+MXU — the reference's algo-search machinery (`conv_search_cache.h`) has no
+TPU analog because the compiler picks the schedule.
+
+Weight layout follows paddle: [out_c, in_c/groups, *spatial].
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n, strides=None):
+    """paddle padding: int, list[n], list[2n], pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims (paddle 4-elem pair form)
+        pads = [tuple(p) for p in padding]
+        if len(pads) == n + 2:
+            pads = pads[2:]
+        return pads
+    return [(int(p), int(p)) for p in padding]
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "OIH", "NHC")
+    if nd == 2:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
+             channel_last):
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    pad = _norm_padding(padding, nd)
+    dn = _dim_numbers(nd, channel_last)
+
+    def fn(v, w):
+        return lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+
+    out = apply(fn, x, weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        ch_axis = (nd + 1) if channel_last else 1
+        bshape = [1] * (nd + 2)
+        bshape[ch_axis] = -1
+
+        def addb(o, b):
+            return o + b.reshape(bshape)
+        out = apply(addb, out, bias)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(ensure_tensor(x), ensure_tensor(weight), bias, stride,
+                    padding, dilation, groups, 1, data_format == "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(ensure_tensor(x), ensure_tensor(weight), bias, stride,
+                    padding, dilation, groups, 2, data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(ensure_tensor(x), ensure_tensor(weight), bias, stride,
+                    padding, dilation, groups, 3, data_format == "NDHWC")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, channel_last, output_size=None):
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    pad = _norm_padding(padding, nd)
+    opad = _norm_tuple(output_padding, nd)
+    dn = _dim_numbers(nd, channel_last)
+
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    def fn(v, w):
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            # transposed conv: effective padding = k - 1 - p (with dilation)
+            pads = []
+            for i in range(nd):
+                k = (w.shape[2 + i] - 1) * dilation[i] + 1
+                lo = k - 1 - pad[i][0]
+                hi = k - 1 - pad[i][1] + opad[i]
+                pads.append((lo, hi))
+        # grouped transpose: split in feature groups
+        if groups == 1:
+            wt = jnp.swapaxes(w, 0, 1)  # -> [out_c, in_c, *k]
+            wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+            return lax.conv_general_dilated(
+                v, wt, window_strides=(1,) * nd, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn)
+        vs = jnp.split(v, groups, axis=1 if not channel_last else nd + 1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = []
+        for vi, wi in zip(vs, ws):
+            wt = jnp.swapaxes(wi, 0, 1)
+            wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+            outs.append(lax.conv_general_dilated(
+                vi, wt, window_strides=(1,) * nd, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn))
+        return jnp.concatenate(outs, axis=1 if not channel_last else nd + 1)
+
+    out = apply(fn, x, weight)
+    if output_size is not None:
+        pass  # shapes already determined by padding math
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        ch_axis = (nd + 1) if channel_last else 1
+        bshape = [1] * (nd + 2)
+        bshape[ch_axis] = -1
+        out = apply(lambda o, b: o + b.reshape(bshape), out, bias)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(ensure_tensor(x), ensure_tensor(weight), bias,
+                              stride, padding, output_padding, dilation,
+                              groups, 1, False, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(ensure_tensor(x), ensure_tensor(weight), bias,
+                              stride, padding, output_padding, dilation,
+                              groups, 2, data_format == "NHWC", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(ensure_tensor(x), ensure_tensor(weight), bias,
+                              stride, padding, output_padding, dilation,
+                              groups, 3, data_format == "NDHWC", output_size)

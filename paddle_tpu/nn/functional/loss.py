@@ -1,0 +1,349 @@
+"""Loss functionals.
+
+Parity: `python/paddle/nn/functional/loss.py` (reference kernels
+`operators/softmax_with_cross_entropy_op.cu`, `bce_loss_op.cu`,
+`smooth_l1_loss_op.cc`, warpctc `operators/warpctc_op.cc`). CTC uses an
+in-framework lax.scan forward algorithm (no warpctc on TPU).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.nn as jnn
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input = ensure_tensor(input)  # noqa: A001
+    label = ensure_tensor(label)
+    lv = label._value
+    wv = ensure_tensor(weight)._value if weight is not None else None
+
+    def fn(logits):
+        ax = axis % logits.ndim
+        logp = jnn.log_softmax(logits, axis=ax) if use_softmax else \
+            jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = lv.astype(logp.dtype)
+            if label_smoothing > 0:
+                k = logits.shape[ax]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=ax)
+            if reduction == "mean":
+                return jnp.mean(loss)
+            return _reduce(loss, reduction)
+        idx = lv.astype(jnp.int32)
+        squeeze = False
+        if idx.ndim == logits.ndim and idx.shape[ax] == 1:
+            idx = jnp.squeeze(idx, axis=ax)
+            squeeze = True
+        valid = idx != ignore_index
+        safe_idx = jnp.where(valid, idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_idx, ax), axis=ax)
+        picked = jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0:
+            k = logits.shape[ax]
+            smooth = jnp.mean(logp, axis=ax)
+            loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+        else:
+            loss = -picked
+        if wv is not None:
+            w = jnp.take(wv.astype(loss.dtype), safe_idx)
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if wv is not None:
+                denom = jnp.sum(jnp.where(valid, jnp.take(
+                    wv.astype(loss.dtype), safe_idx), 0.0))
+            else:
+                denom = jnp.sum(valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+    return apply(fn, input)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    """Reference `operators/softmax_with_cross_entropy_op.cu`; returns
+    per-example loss with trailing 1-dim kept, like the reference."""
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+    lv = label._value
+
+    def fn(lg):
+        ax = axis % lg.ndim
+        logp = jnn.log_softmax(lg, axis=ax)
+        if soft_label:
+            loss = -jnp.sum(lv.astype(logp.dtype) * logp, axis=ax,
+                            keepdims=True)
+        else:
+            idx = lv.astype(jnp.int32)
+            if idx.ndim == lg.ndim and idx.shape[ax] == 1:
+                picked = jnp.take_along_axis(logp, idx, axis=ax)
+            else:
+                picked = jnp.take_along_axis(
+                    logp, jnp.expand_dims(jnp.where(idx == ignore_index, 0, idx), ax), axis=ax)
+            loss = -picked
+            mask_idx = idx if idx.ndim == loss.ndim else jnp.expand_dims(idx, ax)
+            loss = jnp.where(mask_idx == ignore_index, 0.0, loss)
+        if return_softmax:
+            return loss, jnn.softmax(lg, axis=ax)
+        return loss
+    out = apply(fn, logits)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    input = ensure_tensor(input)  # noqa: A001
+    label = ensure_tensor(label)
+    lv = label._value.astype(jnp.int32)
+    wv = ensure_tensor(weight)._value if weight is not None else None
+
+    def fn(logp):
+        valid = lv != ignore_index
+        safe = jnp.where(valid, lv, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        w = jnp.take(wv.astype(loss.dtype), safe) if wv is not None else \
+            jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        return _reduce(loss, reduction)
+    return apply(fn, input)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        # paddle's smooth_l1_loss multiplies by delta
+        return _reduce(loss * delta, reduction)
+    return apply(fn, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    wv = ensure_tensor(weight)._value if weight is not None else None
+
+    def fn(p, t):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if wv is not None:
+            loss = loss * wv
+        return _reduce(loss, reduction)
+    return apply(fn, input, label)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    wv = ensure_tensor(weight)._value if weight is not None else None
+    pw = ensure_tensor(pos_weight)._value if pos_weight is not None else None
+
+    def fn(z, t):
+        if pw is not None:
+            base = -(pw * t * jnn.log_sigmoid(z)
+                     + (1 - t) * jnn.log_sigmoid(-z))
+        else:
+            # numerically stable: max(z,0) - z*t + log(1+exp(-|z|))
+            base = jnp.maximum(z, 0) - z * t + jnn.softplus(-jnp.abs(z))
+        if wv is not None:
+            base = base * wv
+        return _reduce(base, reduction)
+    return apply(fn, logit, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+
+    def fn(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),  # noqa: A001
+                           ensure_tensor(label))
+    return apply(lambda a, b, t: _reduce(
+        jnp.maximum(0.0, -t * (a - b) + margin), reduction), input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    return apply(lambda a, t: _reduce(
+        jnp.where(t == 1.0, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = (ensure_tensor(input1), ensure_tensor(input2),
+                             ensure_tensor(label))
+
+    def fn(a, b, t):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    input, positive, negative = (ensure_tensor(input), ensure_tensor(positive),  # noqa: A001
+                                 ensure_tensor(negative))
+
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.abs(a - pos) ** p, axis=-1) + epsilon, 1 / p)
+        dn = jnp.power(jnp.sum(jnp.abs(a - neg) ** p, axis=-1) + epsilon, 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) + epsilon,
+                            1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(fn, input, positive, negative)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    return apply(lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)  # noqa: A001
+    return apply(lambda p, t: -t * jnp.log(p + epsilon)
+                 - (1 - t) * jnp.log(1 - p + epsilon), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    nv = ensure_tensor(normalizer)._value if normalizer is not None else None
+
+    def fn(z, t):
+        p = jnn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnn.softplus(-jnp.abs(z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nv is not None:
+            loss = loss / nv
+        return _reduce(loss, reduction)
+    return apply(fn, logit, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via lax.scan dynamic programming — TPU-native replacement for
+    warpctc (`operators/warpctc_op.cc`). log_probs: [T, B, C] (paddle layout);
+    labels: [B, L] int padded."""
+    log_probs = ensure_tensor(log_probs)
+    labels_v = ensure_tensor(labels)._value.astype(jnp.int32)
+    in_len = ensure_tensor(input_lengths)._value.astype(jnp.int32).reshape(-1)
+    lb_len = ensure_tensor(label_lengths)._value.astype(jnp.int32).reshape(-1)
+
+    def fn(lp):
+        lp = jnn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        L = labels_v.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank t1 blank t2 ... blank
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(labels_v)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, S), neg_inf, dtype=lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = jnp.where(lb_len > 0, labels_v[:, 0], blank)
+        alpha0 = alpha0.at[:, 1].set(jnp.where(
+            lb_len > 0, lp[0, jnp.arange(B), first_lab], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=jnp.bool_),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf, lp.dtype), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf, lp.dtype), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze once past input length
+            alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        idx_last = 2 * lb_len
+        idx_prev = jnp.maximum(2 * lb_len - 1, 0)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0],
+            jnp.where(lb_len > 0,
+                      jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0],
+                      neg_inf))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lb_len.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+    return apply(fn, log_probs)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = ensure_tensor(anchor), ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    def fn(a, p):
+        lv = labels._value.reshape(-1)
+        sim = jnp.matmul(a, p.T)
+        tgt = (lv[:, None] == lv[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jnn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), axis=1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), axis=1))) * 0.25
+        return xent + reg
+    return apply(fn, anchor, positive)

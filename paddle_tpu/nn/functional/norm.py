@@ -1,0 +1,189 @@
+"""Normalization functionals.
+
+Parity: `python/paddle/nn/functional/norm.py` (reference kernels
+`operators/batch_norm_op.cu`, `layer_norm_op.cu`, `group_norm_op.cu`,
+`instance_norm_op.cu`). XLA fuses the reduce+scale+shift chains; layer_norm
+is also provided as a Pallas kernel in `paddle_tpu.ops.pallas` for the
+residual+dropout fusion cases.
+"""
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    naxes = tuple(range(-len(normalized_shape), 0))
+
+    def fn(v, *wb):
+        mean = jnp.mean(v.astype(jnp.float32), axis=naxes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=naxes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jnp.power(var + epsilon, -0.5)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """When training, returns output computed from batch stats AND updates
+    running stats in place on the provided tensors (dygraph semantics,
+    reference `operators/batch_norm_op.cc`). Under `to_static` the buffer
+    update is captured by the functional-state machinery in paddle_tpu.jit."""
+    x = ensure_tensor(x)
+    running_mean = ensure_tensor(running_mean)
+    running_var = ensure_tensor(running_var)
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = -1
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # update running stats in place with (stop-gradient) batch stats;
+        # tracer-safe under jit via the functional-state capture in paddle_tpu.jit
+        xv32 = x._value.astype(jnp.float32)
+        bmean = jnp.mean(xv32, axis=red_axes)
+        bvar = jnp.var(xv32, axis=red_axes)
+        running_mean._value = (momentum * running_mean._value.astype(jnp.float32)
+                               + (1 - momentum) * bmean).astype(running_mean._value.dtype)
+        running_var._value = (momentum * running_var._value.astype(jnp.float32)
+                              + (1 - momentum) * bvar).astype(running_var._value.dtype)
+
+        def fn(v, *wb):
+            # batch stats recomputed inside so grads flow through mean/var
+            v32 = v.astype(jnp.float32)
+            mean = jnp.mean(v32, axis=red_axes).reshape(bshape)
+            var = jnp.var(v32, axis=red_axes).reshape(bshape)
+            out = (v32 - mean) * jnp.power(var + epsilon, -0.5)
+            out = out.astype(v.dtype)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape)
+            return out
+    else:
+        mean_c, var_c = running_mean._value, running_var._value
+
+        def fn(v, *wb):
+            out = (v - mean_c.reshape(bshape).astype(v.dtype)) * \
+                jnp.power(var_c.reshape(bshape).astype(v.dtype) + epsilon, -0.5)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape)
+            return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    red_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = -1
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=red_axes, keepdims=True)
+        var = jnp.var(v, axis=red_axes, keepdims=True)
+        out = (v - mean) * jnp.power(var + eps, -0.5)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+
+    def fn(v, *wb):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        spatial = v.shape[2:]
+        g = v.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jnp.power(var + epsilon, -0.5)).reshape(v.shape)
+        bshape = [1, c] + [1] * len(spatial)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply(fn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sq = jnp.square(v)
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        c = v.shape[ch_axis]
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_axis] = slice(i, i + c)
+            acc = acc + padded[tuple(sl)]
+        return v / jnp.power(k + alpha * acc, beta)
+    return apply(fn, x)

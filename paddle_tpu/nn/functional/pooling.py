@@ -1,0 +1,184 @@
+"""Pooling functionals via `lax.reduce_window`.
+
+Parity: `python/paddle/nn/functional/pooling.py` (reference
+`operators/pool_op.cc`, cudnn pooling). reduce_window lowers to efficient
+TPU vector ops.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+from .conv import _norm_tuple, _norm_padding
+
+
+def _pool(x, kernel, stride, padding, nd, channel_last, reducer, init,
+          ceil_mode=False, count_include_pad=True, divisor_override=None,
+          is_avg=False, exclusive=True):
+    kernel = _norm_tuple(kernel, nd)
+    stride = _norm_tuple(stride if stride is not None else kernel, nd)
+    pad = _norm_padding(padding, nd)
+
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+
+    def fn(v):
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            if channel_last:
+                pads = [(0, 0)] + list(pad) + [(0, 0)]
+            else:
+                pads = [(0, 0), (0, 0)] + list(pad)
+        if is_avg:
+            zero = jnp.zeros((), v.dtype)
+            summed = lax.reduce_window(v, zero, lax.add, dims, strides, pads)
+            if divisor_override:
+                return summed / divisor_override
+            if not exclusive or isinstance(pads, str):
+                return summed / np.prod(kernel)
+            counts = lax.reduce_window(jnp.ones_like(v), zero, lax.add, dims,
+                                       strides, pads)
+            return summed / counts
+        neg_inf = jnp.asarray(init, v.dtype)
+        return lax.reduce_window(v, neg_inf, reducer, dims, strides, pads)
+    return apply(fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    x = ensure_tensor(x)
+    out = _pool(x, kernel_size, stride, padding, 1, False, lax.max, -jnp.inf)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                lax.max, -jnp.inf)
+    if return_mask:
+        idx = _pool_indices(x, kernel_size, stride, padding, out)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    x = ensure_tensor(x)
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 lax.max, -jnp.inf)
+
+
+def _pool_indices(x, kernel_size, stride, padding, out):
+    # flat indices of maxima (for unpool); computed via comparison gather
+    xv, ov = x._value, out._value
+    n, c, h, w = xv.shape
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    oh, ow = ov.shape[2], ov.shape[3]
+    idx = jnp.zeros((n, c, oh, ow), dtype=jnp.int32)
+    best = jnp.full((n, c, oh, ow), -jnp.inf, dtype=jnp.float32)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            sl = xv[:, :, i: i + oh * s[0]: s[0], j: j + ow * s[1]: s[1]]
+            rows = jnp.arange(oh) * s[0] + i
+            cols = jnp.arange(ow) * s[1] + j
+            flat = rows[:, None] * w + cols[None, :]
+            better = sl.astype(jnp.float32) > best
+            best = jnp.where(better, sl.astype(jnp.float32), best)
+            idx = jnp.where(better, flat[None, None], idx)
+    return Tensor(idx)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    x = ensure_tensor(x)
+    return _pool(x, kernel_size, stride, padding, 1, False, lax.add, 0.0,
+                 is_avg=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    x = ensure_tensor(x)
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 lax.add, 0.0, is_avg=True, exclusive=exclusive,
+                 divisor_override=divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    x = ensure_tensor(x)
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 lax.add, 0.0, is_avg=True, exclusive=exclusive,
+                 divisor_override=divisor_override)
+
+
+def _adaptive_axes(in_size, out_size):
+    # exact adaptive pooling: per output cell start/end like the reference
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, mode, channel_last=False):
+    x = ensure_tensor(x)
+    out_sizes = _norm_tuple(output_size, nd)
+    spatial_off = 1 if channel_last else 2
+
+    def fn(v):
+        out = v
+        for d in range(nd):
+            axis = spatial_off + d
+            in_size = out.shape[axis]
+            osz = out_sizes[d] if out_sizes[d] is not None else in_size
+            starts, ends = _adaptive_axes(in_size, osz)
+            if all(e - s == ends[0] - starts[0] for s, e in zip(starts, ends)) \
+                    and in_size % osz == 0:
+                # uniform windows: reshape-reduce (fast path)
+                k = in_size // osz
+                shp = out.shape[:axis] + (osz, k) + out.shape[axis + 1:]
+                r = out.reshape(shp)
+                out = jnp.mean(r, axis=axis + 1) if mode == "avg" else \
+                    jnp.max(r, axis=axis + 1)
+            else:
+                slices = []
+                for s, e in zip(starts, ends):
+                    sl = jnp.take(out, jnp.arange(s, e), axis=axis)
+                    red = jnp.mean(sl, axis=axis, keepdims=True) \
+                        if mode == "avg" else jnp.max(sl, axis=axis, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=axis)
+        return out
+    return apply(fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max")

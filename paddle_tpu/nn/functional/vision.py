@@ -1,0 +1,132 @@
+"""Vision functionals: pixel_shuffle, grid_sample, affine_grid.
+
+Parity: `python/paddle/nn/functional/vision.py` (reference
+`operators/pixel_shuffle_op.cc`, `grid_sampler_op.cu`, `affine_grid_op.cc`).
+"""
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = int(upscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = int(downscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+    return apply(fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        v = jnp.swapaxes(v, 1, 2)
+        return v.reshape(n, c, h, w)
+    return apply(fn, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = ensure_tensor(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(s) for s in out_shape.numpy()]
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # h,w,3
+        out = jnp.einsum("hwk,njk->nhwj", base, th)
+        return out
+    return apply(fn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            val = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # n,gh,gw,c
+            if padding_mode == "zeros":
+                ok = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) &
+                      (iy <= h - 1)).astype(v.dtype)[..., None]
+                val = val * ok
+            return val
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = ((x1 - fx) * (y1 - fy))[..., None]
+            wb = ((x1 - fx) * (fy - y0))[..., None]
+            wc = ((fx - x0) * (y1 - fy))[..., None]
+            wd = ((fx - x0) * (fy - y0))[..., None]
+            out = (sample(x0, y0) * wa + sample(x0, y1) * wb +
+                   sample(x1, y0) * wc + sample(x1, y1) * wd)
+        return jnp.transpose(out, (0, 3, 1, 2))  # back to NCHW
+    return apply(fn, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                               axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+    return apply(fn, x)

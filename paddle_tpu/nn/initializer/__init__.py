@@ -1,0 +1,187 @@
+"""Parameter initializers.
+
+Parity: `python/paddle/nn/initializer/` and `python/paddle/fluid/initializer.py`
+in the reference. Initializers are pure functions shape×dtype→array drawing
+from the global Generator (`core.random`).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.random import next_key
+from ...core.dtype import convert_dtype, get_default_dtype
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight layout [out_c, in_c/groups, *k]
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return self.mean + self.std * jax.random.normal(
+            next_key(), tuple(shape)).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return (self.mean + self.std * jax.random.truncated_normal(
+            next_key(), -2.0, 2.0, tuple(shape))).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), tuple(shape), minval=self.low,
+                                  maxval=self.high).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), tuple(shape), minval=-limit,
+                                  maxval=limit).astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return (std * jax.random.normal(next_key(), tuple(shape))).astype(dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return math.sqrt(2.0)
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), tuple(shape), minval=-limit,
+                                  maxval=limit).astype(dt)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return (std * jax.random.normal(next_key(), tuple(shape))).astype(dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ...core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._value
+        arr = jnp.asarray(v, dtype=convert_dtype(dtype) or None)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        init = jax.nn.initializers.orthogonal(scale=self.gain)
+        return init(next_key(), tuple(shape)).astype(dt)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        init = jax.nn.initializers.delta_orthogonal()
+        try:
+            return init(next_key(), tuple(shape)).astype(dt)
+        except Exception:
+            w = np.zeros(shape, dtype=np.float32)
+            oc, ic = shape[0], shape[1]
+            centers = tuple(s // 2 for s in shape[2:])
+            for i in range(min(oc, ic * self.groups)):
+                w[(i, i % ic) + centers] = 1.0
+            return jnp.asarray(w, dtype=dt)
+
+
+# paddle aliases
+GlorotUniform = XavierUniform
+GlorotNormal = XavierNormal
+MSRAUniform = KaimingUniform
+MSRANormal = KaimingNormal
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "selu": 3.0 / 4.0}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)

@@ -1,0 +1,364 @@
+"""nn.Layer — module base class.
+
+Parity target: `python/paddle/fluid/dygraph/layers.py` (reference Layer:
+parameter/buffer/sublayer registries, hooks, state_dict, train/eval). The
+TPU-relevant difference: parameters are jax.Arrays, and
+`paddle_tpu.jit.functional_call` can temporarily bind traced values over the
+whole tree so a Layer runs inside a jitted/pjit'd step without rewriting user
+code.
+"""
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter
+from ...core.dtype import convert_dtype, get_default_dtype
+from ..initializer import Initializer, Constant, XavierUniform
+
+_name_counters = collections.defaultdict(int)
+
+
+def _unique_name(prefix):
+    _name_counters[prefix] += 1
+    return f"{prefix}_{_name_counters[prefix] - 1}"
+
+
+class ParamAttr:
+    """Analog of paddle.ParamAttr (`python/paddle/fluid/param_attr.py`)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"invalid ParamAttr {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._full_name = _unique_name(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+
+    # ---- construction helpers ------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        init = attr.initializer or default_initializer or \
+            (Constant(0.0) if is_bias else XavierUniform())
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name or _unique_name("param"),
+                      trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.is_bias = is_bias
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        t = Tensor(jnp.zeros((), convert_dtype(dtype) or get_default_dtype()))
+        t.name = name
+        return t
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        elif not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter requires a Parameter")
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ---- attribute routing ---------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            if buffers is not None:
+                buffers.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name].set_value(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extras = list(self._parameters) + list(self._sub_layers) + \
+            list(self._buffers)
+        return sorted(set(super().__dir__() + extras))
+
+    # ---- traversal ------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def children(self):
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for layer in self.children():
+            out.extend(layer.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self.named_children():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(sub_prefix, True, layers_set)
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ---- mode -----------------------------------------------------------
+    def train(self):
+        self.training = True
+        for layer in self.children():
+            layer.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.children():
+            layer.eval()
+        return self
+
+    # ---- dtype/device movement -----------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(convert_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def _cast_all(self, dtype):
+        from ...core.dtype import is_floating
+        for p in self.parameters():
+            if is_floating(p.dtype):
+                p._value = p._value.astype(dtype)
+        for b in self.buffers():
+            if b is not None and is_floating(b.dtype):
+                b._value = b._value.astype(dtype)
+
+    # ---- hooks ----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ---- call -----------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ---- state dict -----------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        if destination is None:
+            destination = collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            destination[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            bare = name.rsplit(".", 1)[-1]
+            # find owner to check persistability
+            destination[name] = b
+        # drop non-persistable buffers
+        for lname, layer in self.named_sublayers(include_self=True):
+            for bname in layer._non_persistable_buffer_names:
+                key = f"{lname}.{bname}" if lname else bname
+                destination.pop(key, None)
+        return destination
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, tgt in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                val = src._value if isinstance(src, Tensor) else jnp.asarray(src)
+                tgt.set_value(val.astype(tgt._value.dtype))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

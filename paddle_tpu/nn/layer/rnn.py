@@ -1,0 +1,334 @@
+"""Recurrent layers via `lax.scan`.
+
+Parity: `python/paddle/nn/layer/rnn.py` (reference: `operators/rnn_op.h`,
+cudnn LSTM/GRU kernels). TPU-native: the time loop is a lax.scan (one compiled
+step reused per timestep — XLA unrolls nothing, keeping compile time flat) and
+the gate matmuls are batched MXU ops. Gate order follows paddle:
+LSTM [i, f, c(g), o]; GRU [r, u(z), c(n)] with the cudnn-style
+"reset-after-matmul" candidate.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from ..initializer import Uniform
+from ...core.tensor import Tensor, apply
+from ...tensor._helpers import ensure_tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = ensure_tensor(batch_ref)._value.shape[batch_dim_idx]
+        return Tensor(jnp.full((batch, self.hidden_size), init_value,
+                               jnp.float32))
+
+
+def _cell_params(layer, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        [n_gates * hidden_size, input_size], attr=weight_ih_attr,
+        default_initializer=u)
+    layer.weight_hh = layer.create_parameter(
+        [n_gates * hidden_size, hidden_size], attr=weight_hh_attr,
+        default_initializer=u)
+    layer.bias_ih = layer.create_parameter(
+        [n_gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+        default_initializer=u)
+    layer.bias_hh = layer.create_parameter(
+        [n_gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+        default_initializer=u)
+
+
+def _lstm_step(x, h, c, wih, whh, bih, bhh, hidden_size):
+    gates = x @ wih.T + bih + h @ whh.T + bhh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, wih, whh, bih, bhh, hidden_size):
+    xg = x @ wih.T + bih
+    hg = h @ whh.T + bhh
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _simple_step(x, h, wih, whh, bih, bhh, hidden_size, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    return act(x @ wih.T + bih + h @ whh.T + bhh)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply(lambda x, h, wih, whh, bih, bhh: _simple_step(
+            x, h, wih, whh, bih, bhh, self.hidden_size, self.activation),
+            ensure_tensor(inputs), ensure_tensor(states), self.weight_ih,
+            self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        hn, cn = apply(lambda x, hh, cc, wih, whh, bih, bhh: _lstm_step(
+            x, hh, cc, wih, whh, bih, bhh, self.hidden_size),
+            ensure_tensor(inputs), ensure_tensor(h), ensure_tensor(c),
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return hn, (hn, cn)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        hn = apply(lambda x, h, wih, whh, bih, bhh: _gru_step(
+            x, h, wih, whh, bih, bhh, self.hidden_size),
+            ensure_tensor(inputs), ensure_tensor(states), self.weight_ih,
+            self.weight_hh, self.bias_ih, self.bias_hh)
+        return hn, hn
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference `nn/layer/rnn.py:RNN`)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        # eager python loop over time using the cell; for compiled perf use
+        # the multi-layer LSTM/GRU/SimpleRNN classes (lax.scan inside).
+        axis = 0 if self.time_major else 1
+        steps = inputs._value.shape[axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from ...tensor.manipulation import stack
+        for t in order:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, fs = self.rnn_fw(inputs, s_fw)
+        o_bw, bs = self.rnn_bw(inputs, s_bw)
+        from ...tensor.manipulation import concat
+        return concat([o_fw, o_bw], axis=-1), (fs, bs)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net, scan-compiled."""
+
+    MODE = "LSTM"
+    N_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        n_gates = self.N_GATES[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weights = []
+        for layer_i in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer_i == 0 else \
+                    hidden_size * self.num_directions
+                suffix = f"{layer_i}" + ("_reverse" if d else "")
+                wih = self.create_parameter([n_gates * hidden_size, in_sz],
+                                            attr=weight_ih_attr,
+                                            default_initializer=u)
+                whh = self.create_parameter(
+                    [n_gates * hidden_size, hidden_size],
+                    attr=weight_hh_attr, default_initializer=u)
+                bih = self.create_parameter([n_gates * hidden_size],
+                                            attr=bias_ih_attr, is_bias=True,
+                                            default_initializer=u)
+                bhh = self.create_parameter([n_gates * hidden_size],
+                                            attr=bias_hh_attr, is_bias=True,
+                                            default_initializer=u)
+                self.add_parameter(f"weight_ih_l{suffix}", wih)
+                self.add_parameter(f"weight_hh_l{suffix}", whh)
+                self.add_parameter(f"bias_ih_l{suffix}", bih)
+                self.add_parameter(f"bias_hh_l{suffix}", bhh)
+                self.weights.append((wih, whh, bih, bhh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        mode = self.MODE
+        hs = self.hidden_size
+        nl, nd = self.num_layers, self.num_directions
+        tm = self.time_major
+        act = self.activation
+        flat_w = [w for group in self.weights for w in group]
+
+        init_given = initial_states is not None
+        init_vals = []
+        if init_given:
+            if mode == "LSTM":
+                h0, c0 = initial_states
+                init_vals = [ensure_tensor(h0), ensure_tensor(c0)]
+            else:
+                init_vals = [ensure_tensor(initial_states)]
+
+        def fn(x, *args):
+            ws = args[:len(flat_w)]
+            inits = args[len(flat_w):]
+            if not tm:
+                x = jnp.swapaxes(x, 0, 1)  # -> [T, B, F]
+            T, B = x.shape[0], x.shape[1]
+            if init_given:
+                h0_all = inits[0]
+                c0_all = inits[1] if mode == "LSTM" else None
+            else:
+                h0_all = jnp.zeros((nl * nd, B, hs), x.dtype)
+                c0_all = jnp.zeros((nl * nd, B, hs), x.dtype) \
+                    if mode == "LSTM" else None
+
+            layer_in = x
+            last_h, last_c = [], []
+            for li in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wi = (li * nd + d) * 4
+                    wih, whh, bih, bhh = ws[wi:wi + 4]
+                    h0 = h0_all[li * nd + d]
+                    c0 = c0_all[li * nd + d] if mode == "LSTM" else None
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    if mode == "LSTM":
+                        def step(carry, xt):
+                            h, c = carry
+                            hn, cn = _lstm_step(xt, h, c, wih, whh, bih, bhh, hs)
+                            return (hn, cn), hn
+                        (hT, cT), outs = jax.lax.scan(step, (h0, c0), seq)
+                        last_c.append(cT)
+                    elif mode == "GRU":
+                        def step(carry, xt):
+                            hn = _gru_step(xt, carry, wih, whh, bih, bhh, hs)
+                            return hn, hn
+                        hT, outs = jax.lax.scan(step, h0, seq)
+                    else:
+                        def step(carry, xt):
+                            hn = _simple_step(xt, carry, wih, whh, bih, bhh,
+                                              hs, act)
+                            return hn, hn
+                        hT, outs = jax.lax.scan(step, h0, seq)
+                    last_h.append(hT)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(outs)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if nd == 2 \
+                    else dir_outs[0]
+            out = layer_in if tm else jnp.swapaxes(layer_in, 0, 1)
+            hstack = jnp.stack(last_h, 0)
+            if mode == "LSTM":
+                return out, hstack, jnp.stack(last_c, 0)
+            return out, hstack
+
+        res = apply(fn, inputs, *flat_w, *init_vals)
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        if activation == "relu":
+            self.MODE = "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,107 @@
+"""Attention kernels.
+
+TPU-native replacement for the reference's fused attention
+(`operators/fused/fused_attention_op.cu`, `fmha_ref.h` — full O(s^2)
+materialization). Two paths:
+
+- `flash_attention`: blockwise online-softmax Pallas kernel (paddle_tpu.ops.
+  pallas_attention) when running on TPU with supported shapes/dtypes.
+- composed XLA path: einsum + softmax + einsum; XLA fuses the chain and it is
+  the fallback on CPU and for odd shapes.
+
+Layout convention is paddle's: [batch, seq, heads, head_dim] (BSNH).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor._helpers import ensure_tensor
+
+
+def _composed_attention(q, k, v, bias=None, causal=False, scale=None,
+                        dropout_p=0.0, dropout_key=None):
+    """q,k,v: [B, S, N, H] jax values."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def _use_pallas(q):
+    if jax.default_backend() != "tpu":
+        return False
+    b, s, n, h = q.shape
+    return s % 128 == 0 and h in (64, 128, 256) and s >= 256
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention-compatible API on the Pallas
+    kernel (falls back to composed XLA path off-TPU)."""
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    dropout_key = None
+    if dropout > 0.0 and training:
+        from ..core.random import next_key
+        dropout_key = next_key()
+
+    def fn(q, k, v):
+        if _use_pallas(q) and dropout == 0.0:
+            from .pallas_attention import flash_attention_fwd
+            return flash_attention_fwd(q, k, v, causal=causal)
+        return _composed_attention(q, k, v, causal=causal,
+                                   dropout_p=dropout if training else 0.0,
+                                   dropout_key=dropout_key)
+    out = apply(fn, query, key, value)
+    if return_softmax:
+        return out, None
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    dropout_key = None
+    if dropout_p > 0.0 and training:
+        from ..core.random import next_key
+        dropout_key = next_key()
+
+    if attn_mask is None:
+        def fn(q, k, v):
+            if _use_pallas(q) and dropout_p == 0.0:
+                from .pallas_attention import flash_attention_fwd
+                return flash_attention_fwd(q, k, v, causal=is_causal)
+            return _composed_attention(
+                q, k, v, causal=is_causal,
+                dropout_p=dropout_p if training else 0.0,
+                dropout_key=dropout_key)
+        return apply(fn, query, key, value)
+
+    attn_mask = ensure_tensor(attn_mask)
+
+    def fn(q, k, v, m):
+        if m.dtype == jnp.bool_:
+            bias = jnp.where(m, 0.0, -1e30)
+        else:
+            bias = m
+        return _composed_attention(q, k, v, bias=bias, causal=is_causal,
+                                   dropout_p=dropout_p if training else 0.0,
+                                   dropout_key=dropout_key)
+    return apply(fn, query, key, value, attn_mask)

@@ -1,0 +1,456 @@
+"""Optimizer base.
+
+Parity: `python/paddle/optimizer/optimizer.py` (reference optimizer ops in
+`operators/optimizers/`: sgd_op, momentum_op, adam_op, lamb_op...). Design
+difference from the reference: each optimizer defines ONE pure update rule
+`_apply_one(pval, gval, state, lr) -> (new_pval, new_state)` used by
+- the eager `step()` (in-place set of param values), and
+- `paddle_tpu.jit.TrainStep`, which threads (params, opt-state) through a
+  jitted function so the whole fwd+bwd+update is one fused XLA program — the
+  analog of the reference's fused `merged_adam`/multi-tensor paths, but done
+  by the compiler.
+"""
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    # set True for decoupled decay (AdamW)
+    _decoupled_weight_decay = False
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups: flatten (group-specific lr handled via
+                # optimize_attr)
+                flat = []
+                for group in parameters:
+                    for p in group["params"]:
+                        if "learning_rate" in group:
+                            p.optimize_attr = dict(
+                                getattr(p, "optimize_attr", {}) or {},
+                                learning_rate=group["learning_rate"])
+                        if "weight_decay" in group:
+                            p._group_weight_decay = group["weight_decay"]
+                        flat.append(p)
+                parameters = flat
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (L2Decay, L1Decay)):
+            self._weight_decay = weight_decay.coeff
+            self._decay_is_l1 = isinstance(weight_decay, L1Decay)
+        else:
+            self._weight_decay = float(weight_decay or 0.0)
+            self._decay_is_l1 = False
+        self._states = {}
+        self._name = name
+
+    # ---- lr -------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # ---- state ----------------------------------------------------------
+    def _get_state(self, p):
+        st = self._states.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._states[id(p)] = st
+        return st
+
+    def _init_state(self, p):
+        return {}
+
+    def state_dict(self):
+        out = {}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        for p in self._parameter_list or []:
+            st = self._states.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name}_{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        return out
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list or []:
+            st = self._get_state(p)
+            for k in list(st.keys()):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    # ---- update rule (override) ----------------------------------------
+    def _apply_one(self, pval, gval, state, lr):
+        raise NotImplementedError
+
+    def _effective_decay(self, p):
+        wd = getattr(p, "_group_weight_decay", None)
+        if wd is None:
+            wd = self._weight_decay
+        if isinstance(wd, (L2Decay, L1Decay)):
+            wd = wd.coeff
+        # per-param regularizer overrides optimizer-level decay (paddle rule)
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            wd = reg.coeff if isinstance(reg, (L2Decay, L1Decay)) else wd
+        return float(wd)
+
+    def _param_lr(self, p):
+        attr = getattr(p, "optimize_attr", None) or {}
+        return float(attr.get("learning_rate", 1.0))
+
+    def _functional_apply(self, params, param_vals, grad_vals, states, lr):
+        """Pure update over raw values — used by jit.TrainStep (lr may be a
+        traced scalar so LR schedules never retrigger compilation)."""
+        new_vals, new_states = [], []
+        for p, pval, gval, state in zip(params, param_vals, grad_vals, states):
+            gval = gval.astype(jnp.float32)
+            wd = self._effective_decay(p)
+            eff_lr = lr * self._param_lr(p)
+            p32 = pval.astype(jnp.float32)
+            if wd and not self._decoupled_weight_decay:
+                if self._decay_is_l1:
+                    gval = gval + wd * jnp.sign(p32)
+                else:
+                    gval = gval + wd * p32
+            if wd and self._decoupled_weight_decay:
+                pval = (p32 * (1.0 - eff_lr * wd)).astype(pval.dtype)
+            new_p, new_state = self._apply_one(pval, gval, state, eff_lr)
+            new_vals.append(new_p.astype(param_vals[len(new_vals)].dtype))
+            new_states.append(new_state)
+        return new_vals, new_states
+
+    # ---- eager step -----------------------------------------------------
+    def step(self):
+        params_grads = [(p, p.grad) for p in (self._parameter_list or [])
+                        if not p.stop_gradient and p.grad is not None]
+        self._apply_params_grads(params_grads)
+
+    def _apply_params_grads(self, params_grads):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        with autograd.no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+                gval = gval.astype(jnp.float32)
+                pval = p._value
+                wd = self._effective_decay(p)
+                if wd and not self._decoupled_weight_decay:
+                    if self._decay_is_l1:
+                        gval = gval + wd * jnp.sign(pval.astype(jnp.float32))
+                    else:
+                        gval = gval + wd * pval.astype(jnp.float32)
+                state = self._get_state(p)
+                eff_lr = lr * self._param_lr(p)
+                if wd and self._decoupled_weight_decay:
+                    pval = (pval.astype(jnp.float32) *
+                            (1.0 - eff_lr * wd)).astype(pval.dtype)
+                new_p, new_state = self._apply_one(pval, gval, state, eff_lr)
+                p._value = new_p.astype(p._value.dtype)
+                self._states[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def backward(self, loss, **kw):
+        loss.backward()
+        return [(p, p.grad) for p in (self._parameter_list or [])]
+
+    def apply_gradients(self, params_grads):
+        self._apply_params_grads(params_grads)
+
+    def _accumulate_steps(self):
+        pass
+
+
+class SGD(Optimizer):
+    """Reference `operators/optimizers/sgd_op.cc`."""
+
+    def _apply_one(self, pval, gval, state, lr):
+        return pval.astype(jnp.float32) - lr * gval, state
+
+
+class Momentum(Optimizer):
+    """Reference `operators/optimizers/momentum_op.h` (incl. Nesterov)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, pval, gval, state, lr):
+        v = self._momentum * state["velocity"] + gval
+        if self._use_nesterov:
+            new_p = pval.astype(jnp.float32) - lr * (gval + self._momentum * v)
+        else:
+            new_p = pval.astype(jnp.float32) - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Reference `operators/optimizers/adam_op.h`."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32) * self._beta1,
+                "beta2_pow": jnp.ones((), jnp.float32) * self._beta2}
+
+    def _apply_one(self, pval, gval, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * gval
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(gval)
+        b1p, b2p = state["beta1_pow"], state["beta2_pow"]
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        new_p = pval.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p * b1,
+                       "beta2_pow": b2p * b2}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `adamw_op` / AdamW python)."""
+
+    _decoupled_weight_decay = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _effective_decay(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._effective_decay(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._value.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._value.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32) * self._beta1}
+
+    def _apply_one(self, pval, gval, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * gval
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(gval) + eps)
+        new_p = pval.astype(jnp.float32) - \
+            lr / (1 - state["beta1_pow"]) * m / u
+        return new_p, {"moment": m, "inf_norm": u,
+                       "beta1_pow": state["beta1_pow"] * b1}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _apply_one(self, pval, gval, state, lr):
+        mom = state["moment"] + jnp.square(gval)
+        new_p = pval.astype(jnp.float32) - \
+            lr * gval / (jnp.sqrt(mom) + self._epsilon)
+        return new_p, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, pval, gval, state, lr):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(gval)
+        update = gval * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return pval.astype(jnp.float32) - lr * update, \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros(p._value.shape, jnp.float32),
+              "momentum": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p._value.shape, jnp.float32)
+        return st
+
+    def _apply_one(self, pval, gval, state, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(gval)
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * gval
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * gval / denom
+        new_state = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            new_state["mean_grad"] = mg
+        return pval.astype(jnp.float32) - mom, new_state
+
+
+class Lamb(Optimizer):
+    """Reference `operators/optimizers/lamb_op.h` — layerwise adaptation."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_weight_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        st = {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+              "moment2": jnp.zeros(p._value.shape, jnp.float32),
+              "beta1_pow": jnp.ones((), jnp.float32) * self._beta1,
+              "beta2_pow": jnp.ones((), jnp.float32) * self._beta2}
+        st["_wd"] = jnp.asarray(
+            0.0 if (self._exclude_fn is not None and self._exclude_fn(p))
+            else self._lamb_weight_decay, jnp.float32)
+        return st
+
+    def _apply_one(self, pval, gval, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        p32 = pval.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * gval
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(gval)
+        mhat = m / (1 - state["beta1_pow"])
+        vhat = v / (1 - state["beta2_pow"])
+        r = mhat / (jnp.sqrt(vhat) + eps) + state["_wd"] * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p, {"moment1": m, "moment2": v,
+                       "beta1_pow": state["beta1_pow"] * b1,
+                       "beta2_pow": state["beta2_pow"] * b2,
+                       "_wd": state["_wd"]}
+
+
+class LarsMomentum(Optimizer):
+    """Reference `operators/optimizers/lars_momentum_op.cc`."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, epsilon=0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._eps = epsilon
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _apply_one(self, pval, gval, state, lr):
+        p32 = pval.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(gval)))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm /
+            (g_norm + self._lars_weight_decay * p_norm + self._eps), lr)
+        v = self._momentum * state["velocity"] + local_lr * (
+            gval + self._lars_weight_decay * p32)
+        return p32 - v, {"velocity": v}

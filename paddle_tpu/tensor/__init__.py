@@ -1,0 +1,117 @@
+"""paddle_tpu.tensor — the tensor-function namespace.
+
+Mirrors `python/paddle/tensor/__init__.py` in the reference, including the
+monkey-patching of every function as a Tensor method
+(`varbase_patch_methods.py` analog via `register_method`).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, apply, to_tensor, register_method
+from ..core import autograd as _autograd
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, linalg, logic, search, random  # noqa: F401
+from ._helpers import ensure_tensor, binary
+
+# ---------------------------------------------------------------------------
+# attach free functions as Tensor methods
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search, random]
+_SKIP = {"to_tensor", "apply", "ensure_tensor", "binary", "unary",
+         "normalize_axis", "shape_arg", "meshgrid", "arange", "linspace",
+         "eye", "zeros", "ones", "full", "empty", "rand", "randn", "randint",
+         "randperm", "uniform", "normal", "scatter_nd", "Tensor", "Parameter"}
+
+for _mod in _METHOD_SOURCES:
+    for _name in dir(_mod):
+        if _name.startswith("_") or _name in _SKIP:
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn) and getattr(_fn, "__module__", "").startswith("paddle_tpu"):
+            register_method(_name, _fn)
+
+# extra method aliases
+register_method("astype", manipulation.cast)
+register_method("cast", manipulation.cast)
+register_method("mm", linalg.mm)
+register_method("dim", lambda self: self.ndim)
+register_method("numel", lambda self: self.size)
+register_method("element_size", lambda self: self.dtype.itemsize)
+register_method("is_floating_point",
+                lambda self: np.issubdtype(self.dtype, np.floating)
+                or str(self.dtype) == "bfloat16")
+register_method("add_n", lambda self, *o: add_n([self, *o]))
+register_method("fill_", lambda self, v: self.set_value(
+    jnp.full_like(self._value, v)))
+register_method("zero_", lambda self: self.set_value(
+    jnp.zeros_like(self._value)))
+
+
+def add_n(inputs, name=None):
+    """Sum of a tensor list (reference `operators/sum_op.cc`)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = [ensure_tensor(t) for t in inputs]
+    if len(tensors) == 1:
+        return apply(jnp.asarray, tensors[0])
+    def fn(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply(fn, *tensors)
+
+
+register_method("scale", math.scale)
+
+# ---------------------------------------------------------------------------
+# operator dunders
+# ---------------------------------------------------------------------------
+
+
+def _setup_dunders():
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: logic.logical_and(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.logical_or(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: logic.logical_not(s) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_not(s)
+    Tensor.__hash__ = lambda s: id(s)
+
+
+_setup_dunders()

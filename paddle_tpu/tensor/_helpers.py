@@ -1,0 +1,69 @@
+"""Shared op-dispatch helpers for the tensor function namespace."""
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..core.dtype import convert_dtype, get_default_dtype
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def unary(fn, x, **kw):
+    x = ensure_tensor(x)
+    if kw:
+        return apply(lambda v: fn(v, **kw), x)
+    return apply(fn, x)
+
+
+def binary(fn, x, y):
+    """Binary op; python/numpy scalars stay closure constants (not tape
+    inputs), mirroring how the reference treats attrs vs inputs."""
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        return apply(fn, x, y)
+    if xt:
+        c = y
+        return apply(lambda a: fn(a, c), x)
+    if yt:
+        c = x
+        return apply(lambda b: fn(c, b), y)
+    return apply(fn, Tensor(x), Tensor(y))
+
+
+def normalize_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in np.asarray(axis._value).reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def int_or_tuple(v):
+    if isinstance(v, Tensor):
+        a = np.asarray(v._value)
+        return int(a) if a.ndim == 0 else tuple(int(x) for x in a)
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return int(v)
+
+
+def shape_arg(shape):
+    """Normalize a shape argument that may contain Tensors (paddle allows
+    Tensor elements in shape lists for dynamic shapes; on TPU we require
+    static shapes — XLA compiles per-shape)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(np.asarray(s._value)))
+        else:
+            out.append(int(s))
+    return tuple(out)

@@ -1,0 +1,176 @@
+"""Tensor creation ops.
+
+Parity target: `python/paddle/tensor/creation.py` in the reference (fill ops
+`operators/fill_constant_op.cc`, `operators/assign_op.cc`, etc.) — here each is
+a jnp constructor wrapped into a Tensor.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor  # noqa: F401
+from ..core.dtype import convert_dtype, get_default_dtype
+from ._helpers import ensure_tensor, shape_arg
+
+
+def _dt(dtype, default_float=True):
+    dtype = convert_dtype(dtype)
+    if dtype is None and default_float:
+        dtype = get_default_dtype()
+    return dtype
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(shape_arg(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(shape_arg(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = np.asarray(fill_value._value).item()
+    return Tensor(jnp.full(shape_arg(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    start = float(start) if not isinstance(start, Tensor) else start.item()
+    if end is not None:
+        end = float(end) if not isinstance(end, Tensor) else end.item()
+    step = float(step) if not isinstance(step, Tensor) else step.item()
+    if end is None:
+        start, end = 0.0, start
+    if dtype is None:
+        if all(float(v).is_integer() for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = get_default_dtype()
+    dtype = convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step).astype(dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def fn(v):
+            n = v.shape[0] + abs(int(offset))
+            out = jnp.full((n, n), padding_value, dtype=v.dtype)
+            return out + (jnp.diag(v, k=int(offset)) -
+                          jnp.diag(jnp.full((v.shape[0],), padding_value,
+                                            dtype=v.dtype), k=int(offset)))
+        return apply(fn, x)
+    return apply(lambda v: jnp.diag(v, k=int(offset)), x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.diagflat(v, k=int(offset)), x)
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.tril(v, k=int(diagonal)), x)
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.triu(v, k=int(diagonal)), x)
+
+
+def meshgrid(*args, **kwargs):
+    args = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and
+            isinstance(args[0], (list, tuple)) else args)]
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+    return outs
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    y = apply(jnp.asarray, x)
+    if output is not None:
+        output.set_value(y._value)
+        return output
+    return y
+
+
+def clone(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jnp.asarray, x)
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(int(np.prod(x._value.shape) if x._value.shape else 1),
+                              dtype=jnp.int64))
+
+
+def shape(x):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x._value.shape, dtype=jnp.int32))
+
+
+def real(x, name=None):
+    return apply(jnp.real, ensure_tensor(x))
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, ensure_tensor(x))
+
+
+def complex(real_, imag_, name=None):
+    from ._helpers import binary
+    return binary(lambda a, b: a + 1j * b, real_, imag_)
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+    x = ensure_tensor(x)
+    return apply(lambda v: jnn.one_hot(v, int(num_classes),
+                                       dtype=get_default_dtype()), x)
+
+
+def clone_detached(x):
+    return Tensor(ensure_tensor(x)._value)

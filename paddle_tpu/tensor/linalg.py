@@ -1,0 +1,236 @@
+"""Linear algebra ops — matmuls land on the TPU MXU.
+
+Parity target: `python/paddle/tensor/linalg.py` (reference kernels
+`operators/matmul_v2_op.cc`, `operators/math/blas.h` cublas wrappers,
+`operators/svd_op.h`, ...). On TPU every matmul lowers to MXU ops; bf16 inputs
+hit the native 8x128x128 systolic tiles.
+"""
+import builtins as _b
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+builtins_max = _b.max
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, normalize_axis
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(fn, x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 2:
+        return apply(jnp.asarray, x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+    return apply(lambda v: v.T, x)
+
+
+def transpose_last(x):
+    return apply(lambda v: jnp.swapaxes(v, -1, -2), ensure_tensor(x))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis)
+
+    def fn(v):
+        if p == "fro" and ax is None:
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        if p == "fro":
+            return jnp.linalg.norm(v, ord="fro" if isinstance(ax, tuple) else None,
+                                   axis=ax, keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            m = jnp.abs(v)
+            return jnp.max(m, axis=ax, keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        pw = float(p)
+        if ax is None:
+            return jnp.sum(jnp.abs(v) ** pw) ** (1.0 / pw)
+        return jnp.sum(jnp.abs(v) ** pw, axis=ax, keepdims=keepdim) ** (1.0 / pw)
+    return apply(fn, x)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return norm(apply(lambda a, b: a - b, x, y), p=p)
+
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(np.linalg.cond(np.asarray(x._value, dtype=np.float64),
+                                 p=p).astype(np.float32))
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis
+    if ax == 9:
+        ax = None
+        for i, s in enumerate(x._value.shape):
+            if s == 3:
+                ax = i
+                break
+    return apply(lambda a, b: jnp.cross(a, b, axis=int(ax)), x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(fn, x)
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, ensure_tensor(x))
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond), ensure_tensor(x))
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jsl.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax.scipy.linalg as jsl
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda b, L: jsl.cho_solve((L, not upper), b), x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    outs = apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._value))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), x)
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(np.linalg.eigvals(np.asarray(x._value)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(jnp.linalg.eigvalsh, ensure_tensor(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: tuple(jnp.linalg.slogdet(v)), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, int(n)), ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._value, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *tensors)
+
+
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(t) for t in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *tensors)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    x = ensure_tensor(input)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        arr = np.asarray(x._value)
+        lo, hi = float(arr.min()), float(arr.max())
+    h, _ = jnp.histogram(x._value, bins=int(bins), range=(lo, hi))
+    return Tensor(h)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weights)._value if weights is not None else None
+    arr = np.asarray(x._value)
+    length = int(builtins_max(int(arr.max()) + 1 if arr.size else 0, minlength))
+    return Tensor(jnp.bincount(x._value, weights=w, length=length))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(ensure_tensor(x)._value, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(ensure_tensor(x)._value, rowvar=rowvar,
+                          ddof=1 if ddof else 0))

@@ -1,0 +1,93 @@
+"""Comparison / logical ops.
+
+Parity: `python/paddle/tensor/logic.py` (reference `operators/controlflow/
+compare_op.cc`, `logical_op.cc`).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import ensure_tensor, binary, unary
+
+
+def equal(x, y, name=None):
+    return binary(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return binary(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return binary(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return binary(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return binary(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return binary(jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return binary(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return binary(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return binary(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return unary(jnp.logical_not, ensure_tensor(x))
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return binary(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return binary(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return binary(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return unary(jnp.bitwise_not, ensure_tensor(x))
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if tuple(x._value.shape) != tuple(y._value.shape):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(x._value == y._value))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), x, y)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(any(s == 0 for s in x._value.shape)))

@@ -1,0 +1,400 @@
+"""Shape/layout manipulation ops.
+
+Parity target: `python/paddle/tensor/manipulation.py` (reference kernels:
+`operators/reshape_op.cc`, `concat_op.cc`, `split_op.cc`, `gather_op.cu`,
+`scatter_op.cu`, `slice_op.cc`, `transpose_op.cc`, ...). All are XLA
+metadata/gather/scatter ops on TPU.
+"""
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+builtins_slice = builtins.slice
+
+from ..core.tensor import Tensor, apply
+from ..core.dtype import convert_dtype
+from ._helpers import ensure_tensor, shape_arg, normalize_axis
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    dt = convert_dtype(dtype)
+    return apply(lambda v: v.astype(dt), x)
+
+
+astype = cast
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = shape_arg(shape)
+    return apply(lambda v: jnp.reshape(v, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    x = ensure_tensor(x)
+    x._value = jnp.reshape(x._value, shape_arg(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def fn(v):
+        shp = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, shp)
+    return apply(fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    if ax is not None:
+        ax = tuple(a for a in ax if x._value.shape[a] == 1)
+        if not ax:
+            return apply(jnp.asarray, x)
+    return apply(lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis)
+    return apply(lambda v: jnp.expand_dims(v, axis=ax), x)
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = tuple(int(p) for p in perm)
+    return apply(lambda v: jnp.transpose(v, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.swapaxes(v, int(axis1), int(axis2)), x)
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *vs: jnp.concatenate(vs, axis=int(axis)), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=int(axis)), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x._value.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        # paddle allows one -1 meaning "the rest"
+        if -1 in sizes:
+            known = builtins_sum = 0
+            for s in sizes:
+                if s != -1:
+                    known += s
+            sizes = [dim - known if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(v):
+        return tuple(jax.lax.slice_in_dim(v, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply(fn, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x._value.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.take(v, i, axis=axis) for i in range(n))
+    return list(apply(fn, x))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    x = ensure_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return apply(fn, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(a)] = builtins_slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return apply(fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = shape_arg(shape)
+    offs = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    shp = [x._value.shape[i] if s == -1 else s for i, s in enumerate(shp)]
+    return apply(lambda v: jax.lax.dynamic_slice(v, offs, shp), x)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = shape_arg(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = list(shape_arg(shape))
+    cur = list(x._value.shape)
+    while len(cur) < len(shp):
+        cur.insert(0, 1)
+    tgt = tuple(c if s == -1 else s for s, c in zip(shp, cur))
+    return apply(lambda v: jnp.broadcast_to(v.reshape(cur), tgt), x)
+
+
+def expand_as(x, y, name=None):
+    y = ensure_tensor(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.broadcast_to(v, shape_arg(shape)), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    return list(apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *tensors))
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis)
+    return apply(lambda v: jnp.flip(v, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis)
+    sh = shifts if not isinstance(shifts, Tensor) else int(shifts.item())
+    if isinstance(sh, (list, tuple)):
+        sh = tuple(int(s) for s in sh)
+    return apply(lambda v: jnp.roll(v, sh, axis=ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    """Gather rows along axis (reference `operators/gather_op.h`)."""
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    idx = index._value.reshape(-1)
+    return apply(lambda v: jnp.take(v, idx, axis=ax), x)
+
+
+def gather_nd(x, index, name=None):
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+    idxv = index._value
+
+    def fn(v):
+        k = idxv.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idxv, -1, 0))
+        return v[flat_idx]
+    return apply(fn, x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr = ensure_tensor(arr)
+    indices = ensure_tensor(indices)
+    idxv = indices._value
+    return apply(lambda v: jnp.take_along_axis(v, idxv, axis=int(axis)), arr)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    arr = ensure_tensor(arr)
+    idxv = ensure_tensor(indices)._value
+    values = ensure_tensor(values)
+
+    def fn(v, val):
+        val = jnp.broadcast_to(val, idxv.shape).astype(v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idxv, val, axis=int(axis), inplace=False)
+        dims = list(range(v.ndim))
+        # build open indices for scatter via take_along_axis-style expansion
+        it = jnp.indices(idxv.shape)
+        full_idx = tuple(idxv if d == int(axis) % v.ndim else it[d]
+                         for d in dims)
+        if reduce == "add":
+            return v.at[full_idx].add(val)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[full_idx].multiply(val)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(fn, arr, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Row scatter (reference `operators/scatter_op.h`): out[index[i]] =
+    updates[i] (overwrite) or += (accumulate)."""
+    x = ensure_tensor(x)
+    idxv = ensure_tensor(index)._value.reshape(-1)
+    updates = ensure_tensor(updates)
+
+    def fn(v, u):
+        if overwrite:
+            return v.at[idxv].set(u)
+        return v.at[idxv].set(0).at[idxv].add(u)
+    return apply(fn, x, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = ensure_tensor(x)
+    idxv = ensure_tensor(index)._value
+    updates = ensure_tensor(updates)
+
+    def fn(v, u):
+        flat_idx = tuple(jnp.moveaxis(idxv, -1, 0))
+        return v.at[flat_idx].add(u)
+    return apply(fn, x, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idxv = ensure_tensor(index)._value
+    updates = ensure_tensor(updates)
+    shp = shape_arg(shape)
+
+    def fn(u):
+        z = jnp.zeros(shp, dtype=u.dtype)
+        flat_idx = tuple(jnp.moveaxis(idxv, -1, 0))
+        return z.at[flat_idx].add(u)
+    return apply(fn, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index, name=None):
+    x = ensure_tensor(x)
+    idxv = ensure_tensor(index)._value
+    return apply(lambda v: jnp.take_along_axis(v, idxv, axis=1), x)
+
+
+def masked_select(x, mask, name=None):
+    x = ensure_tensor(x)
+    maskv = ensure_tensor(mask)._value
+    # dynamic output shape: materialize on host (not jittable — documented)
+    return Tensor(x._value[np.asarray(maskv)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x = ensure_tensor(x)
+    maskv = ensure_tensor(mask)._value
+    val = value.item() if isinstance(value, Tensor) else value
+    return apply(lambda v: jnp.where(maskv, jnp.asarray(val, v.dtype), v), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    reps = repeats if not isinstance(repeats, Tensor) else repeats._value
+    return apply(lambda v: jnp.repeat(v, reps, axis=axis), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = jnp.unique(x._value, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(ensure_tensor(x)._value)
+    if axis is not None:
+        raise NotImplementedError
+    flat = arr.reshape(-1)
+    keep = np.ones(len(flat), dtype=np.bool_)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = Tensor(flat[keep])
+    rets = [out]
+    if return_inverse:
+        rets.append(Tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        rets.append(Tensor(np.diff(np.append(idx, len(flat)))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def pad_(x, pad, mode="constant", value=0.0):
+    from ..nn.functional.common import pad as _pad
+    return _pad(x, pad, mode=mode, value=value)
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: v[..., 0] + 1j * v[..., 1], x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = int(ax.item())
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """TP vocab-shard index remap (reference `operators/shard_index_op.cc`,
+    used by VocabParallelEmbedding)."""
+    x = ensure_tensor(input)
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+
+    def fn(v):
+        in_range = (v >= lo) & (v < hi)
+        return jnp.where(in_range, v - lo, ignore_value)
+    return apply(fn, x)

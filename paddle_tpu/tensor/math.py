@@ -1,0 +1,380 @@
+"""Elementwise / reduction math ops.
+
+Parity target: `python/paddle/tensor/math.py` and the reference's elementwise
+op family (`operators/elementwise/`, `operators/reduce_ops/`, activation ops
+`operators/activation_op.cc`). The ~10k LoC of CUDA broadcast machinery in the
+reference collapses into jnp broadcasting; XLA fuses chains of these into
+single kernels on TPU.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, unary, binary, normalize_axis
+
+# ---- binary arithmetic ----------------------------------------------------
+
+def add(x, y, name=None):
+    return binary(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return binary(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return binary(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return binary(jnp.true_divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return binary(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return binary(jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return binary(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return binary(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return binary(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return binary(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return binary(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return binary(jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return binary(jnp.hypot, x, y)
+
+
+def heaviside(x, y, name=None):
+    return binary(jnp.heaviside, x, y)
+
+
+def copysign(x, y, name=None):
+    return binary(jnp.copysign, x, y)
+
+
+def nextafter(x, y, name=None):
+    return binary(jnp.nextafter, x, y)
+
+
+def gcd(x, y, name=None):
+    return binary(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return binary(jnp.lcm, x, y)
+
+
+def ldexp(x, y, name=None):
+    return binary(jnp.ldexp, x, y)
+
+
+def inner(x, y, name=None):
+    return binary(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return binary(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def kron(x, y, name=None):
+    return binary(jnp.kron, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return binary(jnp.logaddexp, x, y)
+
+
+# ---- unary ----------------------------------------------------------------
+
+def _u(fn):
+    def op(x, name=None):
+        return unary(fn, ensure_tensor(x))
+    return op
+
+
+exp = _u(jnp.exp)
+expm1 = _u(jnp.expm1)
+log = _u(jnp.log)
+log2 = _u(jnp.log2)
+log10 = _u(jnp.log10)
+log1p = _u(jnp.log1p)
+sqrt = _u(jnp.sqrt)
+rsqrt = _u(lambda v: jax.lax.rsqrt(v))
+abs = _u(jnp.abs)  # noqa: A001
+neg = _u(jnp.negative)
+sign = _u(jnp.sign)
+floor = _u(jnp.floor)
+ceil = _u(jnp.ceil)
+round = _u(jnp.round)  # noqa: A001
+trunc = _u(jnp.trunc)
+frac = _u(lambda v: v - jnp.trunc(v))
+sin = _u(jnp.sin)
+cos = _u(jnp.cos)
+tan = _u(jnp.tan)
+asin = _u(jnp.arcsin)
+acos = _u(jnp.arccos)
+atan = _u(jnp.arctan)
+sinh = _u(jnp.sinh)
+cosh = _u(jnp.cosh)
+tanh = _u(jnp.tanh)
+asinh = _u(jnp.arcsinh)
+acosh = _u(jnp.arccosh)
+atanh = _u(jnp.arctanh)
+reciprocal = _u(jnp.reciprocal)
+square = _u(jnp.square)
+sigmoid = _u(jax.nn.sigmoid)
+erf = _u(jsp.erf)
+erfinv = _u(jsp.erfinv)
+lgamma = _u(jsp.gammaln)
+digamma = _u(jsp.digamma)
+i0 = _u(jsp.i0)
+i0e = _u(jsp.i0e)
+i1 = _u(jsp.i1)
+i1e = _u(jsp.i1e)
+angle = _u(jnp.angle)
+conj = _u(jnp.conj)
+deg2rad = _u(jnp.deg2rad)
+rad2deg = _u(jnp.rad2deg)
+exponent = _u(lambda v: jnp.frexp(v)[1].astype(jnp.int32))
+
+
+def logit(x, eps=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return apply(fn, x)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda v: jnp.clip(v, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def fn(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+    out = apply(fn, x)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = x._value + value
+    return x
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), ensure_tensor(x))
+
+
+def isnan(x, name=None):
+    return unary(jnp.isnan, ensure_tensor(x))
+
+
+def isinf(x, name=None):
+    return unary(jnp.isinf, ensure_tensor(x))
+
+
+def isfinite(x, name=None):
+    return unary(jnp.isfinite, ensure_tensor(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary(lambda v: scale_b * jnp.tanh(scale_a * v), ensure_tensor(x))
+
+
+# ---- reductions -----------------------------------------------------------
+
+def _reduce(fn, x, axis=None, keepdim=False, dtype=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    kw = {}
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        kw["dtype"] = convert_dtype(dtype)
+    return apply(lambda v: fn(v, axis=axis, keepdims=keepdim, **kw), x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(jnp.sum, x, axis, keepdim, dtype)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.mean, x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce(jnp.prod, x, axis, keepdim, dtype)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(jnp.max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(jnp.min, x, axis, keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce(jnp.nansum, x, axis, keepdim, dtype)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.nanmean, x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    return apply(lambda v: jnp.std(v, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    return apply(lambda v: jnp.var(v, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.median, x, axis, keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(q), axis=axis,
+                                        keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    return apply(lambda v: jsp.logsumexp(v, axis=axis, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(jnp.all, x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _reduce(jnp.any, x, axis, keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    axis = normalize_axis(axis)
+    return Tensor(jnp.count_nonzero(x._value, axis=axis, keepdims=keepdim))
+
+
+# ---- scans ----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        return apply(lambda v: jnp.cumsum(v.reshape(-1)), x)
+    return apply(lambda v: jnp.cumsum(v, axis=int(axis)), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dim is None:
+        return apply(lambda v: jnp.cumprod(v.reshape(-1)), x)
+    return apply(lambda v: jnp.cumprod(v, axis=int(dim)), x)
+
+
+def cummax(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    xx = x if axis is not None else apply(lambda v: v.reshape(-1), x)
+    vals = apply(lambda v: jax.lax.cummax(v, axis=ax), xx)
+    idx = Tensor(jnp.argmax(jnp.cumsum(jnp.ones_like(xx._value), axis=ax) *
+                            (xx._value == vals._value), axis=ax))
+    return vals, idx
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre,
+                                    append=app), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                     axis2=axis2), x)
+
+
+# ---- misc -----------------------------------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y)
+
+
+def multiplex(inputs, index, name=None):
+    inputs = [ensure_tensor(i) for i in inputs]
+    index = ensure_tensor(index)
+    stacked = apply(lambda *vs: jnp.stack(vs, axis=0), *inputs)
+    idx = index._value.reshape(-1).astype(jnp.int32)
+    return apply(lambda s: s[idx, jnp.arange(s.shape[1])], stacked)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply(lambda a, b: a + weight * (b - a), x, y)

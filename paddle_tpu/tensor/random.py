@@ -1,0 +1,132 @@
+"""Random sampling ops over the stateful Generator facade.
+
+Parity: `python/paddle/tensor/random.py` (reference kernels
+`operators/uniform_random_op.cc`, `gaussian_random_op.cc`,
+`randint_op.cc`, `randperm_op.cc`, `bernoulli_op.cc`, `multinomial_op.cc`).
+Keys come from `core.random.next_key()`, which respects `rng_guard` so jitted
+steps can thread traced keys.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.random import next_key
+from ._helpers import ensure_tensor, shape_arg
+
+
+def _i64():
+    from ..core.dtype import convert_dtype
+    return convert_dtype("int64")
+
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return get_default_dtype() if d is None else d
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), shape_arg(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), shape_arg(shape),
+                                    dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, shape_arg(shape), dtype=_dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._value if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp,
+                                                dtype=get_default_dtype()))
+    shp = shape_arg(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp,
+                                                 dtype=get_default_dtype()))
+
+
+gaussian = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), shape_arg(shape), int(low),
+                                     int(high), dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, tuple(x._value.shape),
+                   dtype=dtype or str(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(
+        convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.permutation(next_key(), x._value, axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(next_key(), x._value).astype(x._value.dtype))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(next_key(), x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        if v.ndim == 2:
+            out = jnp.moveaxis(out, 0, 1)
+        return Tensor(out.astype(_i64()))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), v.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(_i64()))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = jax.random.exponential(next_key(), x._value.shape,
+                                      dtype=x._value.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    x._value = jax.random.uniform(next_key(), x._value.shape,
+                                  dtype=x._value.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = mean + std * jax.random.normal(next_key(), x._value.shape,
+                                              dtype=x._value.dtype)
+    return x

@@ -1,0 +1,162 @@
+"""Search / sort / index ops.
+
+Parity: `python/paddle/tensor/search.py` (reference `operators/argsort_op.cc`,
+`top_k_v2_op.cc`, `where_op.cc`, `index_select_op.cc`, `kthvalue_op.cc`).
+TopK lowers to XLA's sort/top-k on TPU.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, binary
+
+
+def _i64():
+    from ..core.dtype import convert_dtype
+    return convert_dtype("int64")
+
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else int(axis)
+    out = jnp.argmax(x._value, axis=ax, keepdims=keepdim)
+    return Tensor(out.astype(_i64()))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else int(axis)
+    return Tensor(jnp.argmin(x._value, axis=ax, keepdims=keepdim).astype(_i64()))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    idx = jnp.argsort(v, axis=int(axis), descending=descending)
+    return Tensor(idx.astype(_i64()))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.sort(v, axis=int(axis), descending=descending), x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+
+    def fn(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply(fn, x)
+    idx.stop_gradient = True
+    return vals, Tensor(idx._value.astype(_i64()))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis)
+
+    def fn(v):
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax)
+        val = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            val = jnp.expand_dims(val, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return val, idx
+    vals, idx = apply(fn, x)
+    return vals, Tensor(idx._value.astype(_i64()))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis)
+
+    def fn(v):
+        sv = jnp.sort(v, axis=ax)
+        n = v.shape[ax]
+        same = jnp.concatenate(
+            [jnp.ones(shape=tuple(1 if i == ax % v.ndim else s
+                                  for i, s in enumerate(v.shape)), dtype=jnp.int32),
+             (jnp.take(sv, jnp.arange(1, n), axis=ax) ==
+              jnp.take(sv, jnp.arange(0, n - 1), axis=ax)).astype(jnp.int32)],
+            axis=ax)
+        runs = jnp.cumsum(same, axis=ax) * same + 1 - same
+        # run length ending at each position
+        best = jnp.argmax(runs + jnp.arange(n).reshape(
+            tuple(n if i == ax % v.ndim else 1 for i in range(v.ndim))) * 0,
+            axis=ax, keepdims=True)
+        val = jnp.take_along_axis(sv, best, axis=ax)
+        if not keepdim:
+            val = jnp.squeeze(val, axis=ax)
+        return val
+    vals = apply(fn, x)
+    origv = x._value
+    idx = jnp.argmax(jnp.equal(origv, jnp.expand_dims(vals._value, ax)
+                               if not keepdim else vals._value).astype(jnp.int32),
+                     axis=ax, keepdims=keepdim)
+    return vals, Tensor(idx.astype(_i64()))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cv = condition._value
+    return binary(lambda a, b: jnp.where(cv, a, b), x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)  # dynamic shape -> host
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(n.astype(np.int64)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss = ensure_tensor(sorted_sequence)
+    vals = ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        return jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(s, v)
+    out = fn(ss._value, vals._value)
+    return Tensor(out.astype(jnp.int32 if out_int32 else _i64()))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx = tuple(ensure_tensor(i)._value for i in indices)
+    value = ensure_tensor(value)
+
+    def fn(v, val):
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(jnp.broadcast_to(val, v.at[idx].get().shape)
+                             if np.ndim(val) == 0 else val)
+    return apply(fn, x, value)

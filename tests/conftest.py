@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE any
+computation, so distributed/sharding tests run without TPU hardware (the
+GSPMD-testing pattern; the reference instead spawned multi-process NCCL jobs,
+`test_dist_base.py:734`). Note: the axon sitecustomize pins
+jax_platforms=axon, so we must override via jax.config, not env vars."""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

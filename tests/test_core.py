@@ -1,0 +1,200 @@
+"""Core tensor + autograd tests (OpTest-style numeric checks vs numpy,
+reference pattern `tests/unittests/op_test.py:274`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, delta=1e-3):
+    """Central differences, like reference get_numeric_gradient
+    (`op_test.py:110`)."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += delta
+        xm = x.copy()
+        xm[idx] -= delta
+        g[idx] = (f(xp) - f(xm)) / (2 * delta)
+        it.iternext()
+    return g
+
+
+class TestTensor:
+    def test_creation(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert str(t.dtype) == "float32"
+        assert np.allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([4]).numpy().sum() == 4
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        assert paddle.linspace(0, 1, 5).shape == [5]
+
+    def test_arith(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        assert np.allclose((a + b).numpy(), [4, 6])
+        assert np.allclose((a * b).numpy(), [3, 8])
+        assert np.allclose((b / a).numpy(), [3, 2])
+        assert np.allclose((a - 1).numpy(), [0, 1])
+        assert np.allclose((2 ** a).numpy(), [2, 4])
+        assert np.allclose((-a).numpy(), [-1, -2])
+
+    def test_indexing(self):
+        x = paddle.arange(12).reshape([3, 4])
+        assert x[1].numpy().tolist() == [4, 5, 6, 7]
+        assert x[1, 2].item() == 6
+        assert x[:, 1].numpy().tolist() == [1, 5, 9]
+        assert x[-1, -1].item() == 11
+        x[0, 0] = 100
+        assert x[0, 0].item() == 100
+
+    def test_manipulation(self):
+        x = paddle.arange(6).reshape([2, 3])
+        assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+        assert paddle.concat([x, x], axis=0).shape == [4, 3]
+        assert paddle.stack([x, x]).shape == [2, 2, 3]
+        parts = paddle.split(x, 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        assert paddle.flatten(x).shape == [6]
+        assert paddle.unsqueeze(x, 0).shape == [1, 2, 3]
+        assert paddle.squeeze(paddle.ones([1, 2, 1]), axis=0).shape == [2, 1]
+        assert paddle.tile(x, [2, 1]).shape == [4, 3]
+        assert paddle.flip(x, 0)[0].numpy().tolist() == [3, 4, 5]
+
+    def test_reductions(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.sum().item() == 10
+        assert x.mean().item() == 2.5
+        assert x.max().item() == 4
+        assert paddle.sum(x, axis=0).numpy().tolist() == [4, 6]
+        assert paddle.argmax(x).item() == 3
+        vals, idx = paddle.topk(paddle.to_tensor([1.0, 5.0, 3.0]), 2)
+        assert vals.numpy().tolist() == [5, 3]
+        assert idx.numpy().tolist() == [1, 2]
+
+    def test_gather_scatter(self):
+        x = paddle.arange(12, dtype="float32").reshape([4, 3])
+        g = paddle.gather(x, paddle.to_tensor([0, 2]))
+        assert g.numpy().tolist() == [[0, 1, 2], [6, 7, 8]]
+        s = paddle.scatter(paddle.zeros([4, 2]), paddle.to_tensor([1, 3]),
+                           paddle.ones([2, 2]))
+        assert s.numpy()[1].tolist() == [1, 1]
+        assert s.numpy()[0].tolist() == [0, 0]
+
+    def test_where_masked(self):
+        x = paddle.to_tensor([1.0, -2.0, 3.0])
+        y = paddle.where(x > 0, x, paddle.zeros_like(x))
+        assert y.numpy().tolist() == [1, 0, 3]
+
+    def test_einsum_matmul(self):
+        a = paddle.randn([3, 4])
+        b = paddle.randn([4, 5])
+        c1 = paddle.matmul(a, b)
+        c2 = paddle.einsum("ij,jk->ik", a, b)
+        assert np.allclose(c1.numpy(), c2.numpy(), atol=1e-5)
+        assert np.allclose(c1.numpy(), a.numpy() @ b.numpy(), atol=1e-5)
+
+    def test_cast(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert str(x.astype("int32").dtype) == "int32"
+        assert str(paddle.cast(x, "bfloat16").dtype) == "bfloat16"
+
+
+class TestAutograd:
+    def test_simple_grad(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_chain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.exp(paddle.sin(x))
+        y.backward()
+        expect = np.exp(np.sin(2.0)) * np.cos(2.0)
+        assert np.allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+    def test_matmul_grad_numeric(self):
+        np.random.seed(0)
+        a0 = np.random.randn(3, 4).astype(np.float32)
+        b0 = np.random.randn(4, 2).astype(np.float32)
+        a = paddle.to_tensor(a0, stop_gradient=False)
+        b = paddle.to_tensor(b0, stop_gradient=False)
+        loss = paddle.matmul(a, b).sum()
+        loss.backward()
+        ng = numeric_grad(lambda av: (av @ b0.astype(np.float64)).sum(), a0)
+        assert np.allclose(a.grad.numpy(), ng, atol=1e-2)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        assert np.allclose(x.grad.numpy(), 5.0)
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x ** 3).sum()
+        (gx,) = paddle.grad(y, x)
+        assert np.allclose(gx.numpy(), 3 * np.array([1.0, 4.0]))
+        assert x.grad is None  # paddle.grad must not touch .grad
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor([[4.0, 1.0], [2.0, 3.0]], stop_gradient=False)
+        vals, idx = paddle.topk(x, 1, axis=1)
+        vals.sum().backward()
+        assert np.allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor([[1.0, 2.0]], stop_gradient=False)  # [1,2]
+        y = paddle.to_tensor([[1.0], [2.0]], stop_gradient=False)  # [2,1]
+        (x * y).sum().backward()
+        assert x.grad.shape == [1, 2]
+        assert np.allclose(x.grad.numpy(), [[3.0, 3.0]])
+        assert np.allclose(y.grad.numpy(), [[3.0], [3.0]])
+
+    def test_second_use_of_intermediate(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        h = x * 3
+        y = h * h
+        y.backward()
+        assert np.allclose(x.grad.numpy(), 2 * 3 * 3 * 2.0)  # d(9x^2)=18x
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        assert np.allclose(a, b)
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=-2.0, max=3.0)
+        arr = x.numpy()
+        assert arr.min() >= -2.0 and arr.max() <= 3.0
+
+    def test_randperm(self):
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
