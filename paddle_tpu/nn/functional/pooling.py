@@ -36,7 +36,10 @@ def _pool(x, kernel, stride, padding, nd, channel_last, reducer, init,
             else:
                 pads = [(0, 0), (0, 0)] + list(pad)
         if is_avg:
-            zero = jnp.zeros((), v.dtype)
+            # init must be a concrete scalar so jax recognizes the monoid
+            # (reduce_window grads need the known add/max pattern)
+            zero = np.zeros((), np.dtype(v.dtype)).item() \
+                if v.dtype != jnp.bfloat16 else jnp.bfloat16(0)
             summed = lax.reduce_window(v, zero, lax.add, dims, strides, pads)
             if divisor_override:
                 return summed / divisor_override
@@ -45,7 +48,7 @@ def _pool(x, kernel, stride, padding, nd, channel_last, reducer, init,
             counts = lax.reduce_window(jnp.ones_like(v), zero, lax.add, dims,
                                        strides, pads)
             return summed / counts
-        neg_inf = jnp.asarray(init, v.dtype)
+        neg_inf = -np.inf if v.dtype != jnp.bfloat16 else jnp.bfloat16(-np.inf)
         return lax.reduce_window(v, neg_inf, reducer, dims, strides, pads)
     return apply(fn, x)
 

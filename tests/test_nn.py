@@ -1,0 +1,368 @@
+"""nn.Layer zoo + functional tests, including LeNet end-to-end training
+(capability config 1 from BASELINE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestFunctional:
+    def test_linear(self):
+        x = paddle.randn([4, 8])
+        w = paddle.randn([8, 3])
+        b = paddle.randn([3])
+        y = F.linear(x, w, b)
+        assert np.allclose(y.numpy(), x.numpy() @ w.numpy() + b.numpy(),
+                           atol=1e-5)
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert np.allclose(F.relu(x).numpy(), [0, 0, 2])
+        assert np.allclose(F.sigmoid(x).numpy(),
+                           1 / (1 + np.exp(-x.numpy())), atol=1e-6)
+        assert F.softmax(x).numpy().sum() == pytest.approx(1.0, abs=1e-6)
+        assert np.allclose(F.leaky_relu(x, 0.1).numpy(), [-0.1, 0, 2],
+                           atol=1e-6)
+
+    def test_conv2d_matches_manual(self):
+        x = paddle.ones([1, 1, 4, 4])
+        w = paddle.ones([1, 1, 3, 3])
+        y = F.conv2d(x, w, padding=0)
+        assert y.shape == [1, 1, 2, 2]
+        assert np.allclose(y.numpy(), 9.0)
+        y2 = F.conv2d(x, w, padding=1)
+        assert y2.shape == [1, 1, 4, 4]
+        assert y2.numpy()[0, 0, 0, 0] == 4.0
+
+    def test_conv2d_stride_groups(self):
+        x = paddle.randn([2, 4, 8, 8])
+        w = paddle.randn([6, 2, 3, 3])
+        y = F.conv2d(x, w, stride=2, padding=1, groups=2)
+        assert y.shape == [2, 6, 4, 4]
+
+    def test_conv_transpose(self):
+        x = paddle.randn([1, 3, 5, 5])
+        w = paddle.randn([3, 4, 3, 3])  # [in, out, k, k]
+        y = F.conv2d_transpose(x, w, stride=2, padding=1, output_padding=1)
+        assert y.shape == [1, 4, 10, 10]
+
+    def test_pools(self):
+        x = paddle.arange(16, dtype="float32").reshape([1, 1, 4, 4])
+        y = F.max_pool2d(x, 2)
+        assert y.numpy().reshape(-1).tolist() == [5, 7, 13, 15]
+        y = F.avg_pool2d(x, 2)
+        assert y.numpy().reshape(-1).tolist() == [2.5, 4.5, 10.5, 12.5]
+        y = F.adaptive_avg_pool2d(x, 1)
+        assert y.numpy().item() == pytest.approx(7.5)
+
+    def test_layer_norm(self):
+        x = paddle.randn([2, 5])
+        y = F.layer_norm(x, 5)
+        assert np.allclose(y.numpy().mean(axis=-1), 0, atol=1e-5)
+        assert np.allclose(y.numpy().std(axis=-1), 1, atol=1e-2)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+        y = bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_dropout(self):
+        x = paddle.ones([1000])
+        y = F.dropout(x, 0.5, training=True)
+        kept = (y.numpy() > 0).mean()
+        assert 0.3 < kept < 0.7
+        assert np.allclose(F.dropout(x, 0.5, training=False).numpy(), 1.0)
+
+    def test_embedding(self):
+        w = paddle.arange(12, dtype="float32").reshape([4, 3])
+        idx = paddle.to_tensor([[0, 2], [3, 1]])
+        y = F.embedding(idx, w)
+        assert y.shape == [2, 2, 3]
+        assert y.numpy()[0, 1].tolist() == [6, 7, 8]
+
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]],
+                                  stop_gradient=False)
+        labels = paddle.to_tensor([0, 1])
+        loss = F.cross_entropy(logits, labels)
+        p = np.exp(logits.numpy())
+        p /= p.sum(-1, keepdims=True)
+        expect = -np.mean([np.log(p[0, 0]), np.log(p[1, 1])])
+        assert loss.item() == pytest.approx(expect, abs=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5], )
+        labels = paddle.to_tensor([1, -100, 2, -100])
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        l0 = F.cross_entropy(logits[0:1], labels[0:1])
+        l2 = F.cross_entropy(logits[2:3], labels[2:3])
+        assert loss.item() == pytest.approx((l0.item() + l2.item()) / 2,
+                                            abs=1e-5)
+
+    def test_mse_l1(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([2.0, 4.0])
+        assert F.mse_loss(a, b).item() == pytest.approx(2.5)
+        assert F.l1_loss(a, b).item() == pytest.approx(1.5)
+
+    def test_bce_logits(self):
+        z = paddle.to_tensor([0.0, 2.0])
+        t = paddle.to_tensor([0.0, 1.0])
+        loss = F.binary_cross_entropy_with_logits(z, t)
+        expect = np.mean([np.log(2), -np.log(1 / (1 + np.exp(-2.0)))])
+        assert loss.item() == pytest.approx(expect, abs=1e-5)
+
+    def test_interpolate(self):
+        x = paddle.arange(4, dtype="float32").reshape([1, 1, 2, 2])
+        y = F.interpolate(x, size=[4, 4], mode="nearest")
+        assert y.shape == [1, 1, 4, 4]
+        y2 = F.interpolate(x, scale_factor=2, mode="bilinear")
+        assert y2.shape == [1, 1, 4, 4]
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        y = F.pad(x, [1, 1, 1, 1])
+        assert y.shape == [1, 1, 4, 4]
+        assert y.numpy()[0, 0, 0, 0] == 0
+
+    def test_ctc_loss_decreases(self):
+        # sanity: perfect logits give low loss
+        T, B, C = 6, 1, 4
+        labels = paddle.to_tensor([[1, 2, 3]])
+        logits = np.full((T, B, C), -5.0, np.float32)
+        path = [1, 0, 2, 0, 3, 0]
+        for t, c in enumerate(path):
+            logits[t, 0, c] = 5.0
+        ll = F.ctc_loss(paddle.to_tensor(logits), labels,
+                        paddle.to_tensor([T]), paddle.to_tensor([3]))
+        bad = F.ctc_loss(paddle.to_tensor(-logits), labels,
+                         paddle.to_tensor([T]), paddle.to_tensor([3]))
+        assert ll.item() < bad.item()
+
+    def test_one_hot_sequence_mask(self):
+        y = F.one_hot(paddle.to_tensor([0, 2]), 3)
+        assert np.allclose(y.numpy(), [[1, 0, 0], [0, 0, 1]])
+        m = F.sequence_mask(paddle.to_tensor([1, 3]), maxlen=4)
+        assert m.numpy().tolist() == [[1, 0, 0, 0], [1, 1, 1, 0]]
+
+
+class TestLayers:
+    def test_linear_layer(self):
+        layer = nn.Linear(4, 3)
+        assert layer.weight.shape == [4, 3]
+        y = layer(paddle.randn([2, 4]))
+        assert y.shape == [2, 3]
+        assert len(layer.parameters()) == 2
+
+    def test_sequential_and_state_dict(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        y = model(paddle.randn([3, 4]))
+        assert y.shape == [3, 2]
+        sd = model.state_dict()
+        assert len(sd) == 4
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model2.set_state_dict(sd)
+        y2 = model2(paddle.zeros([3, 4]))
+        assert np.allclose(y2.numpy(), model(paddle.zeros([3, 4])).numpy())
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = nn.Linear(3, 2)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(model.state_dict(), path)
+        loaded = paddle.load(path)
+        model2 = nn.Linear(3, 2)
+        model2.set_state_dict(loaded)
+        x = paddle.randn([1, 3])
+        assert np.allclose(model(x).numpy(), model2(x).numpy())
+
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        y = mha(x, x, x)
+        assert y.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        y = enc(paddle.randn([2, 5, 16]))
+        assert y.shape == [2, 5, 16]
+
+    def test_lstm(self):
+        lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+        x = paddle.randn([4, 10, 8])
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 10, 32]
+        assert h.shape == [4, 4, 16]  # nl*nd, B, H
+        out.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_gru_cell(self):
+        cell = nn.GRUCell(4, 8)
+        out, h = cell(paddle.randn([2, 4]))
+        assert out.shape == [2, 8]
+
+    def test_embedding_layer(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        y = emb(paddle.to_tensor([[0, 1]]))
+        assert np.allclose(y.numpy()[0, 0], 0.0)
+
+    def test_grad_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+        g = paddle.to_tensor([3.0, 4.0])
+        out = clip([(p, g)])
+        assert np.allclose(np.linalg.norm(out[0][1].numpy()), 1.0, atol=1e-5)
+
+
+class TestOptimizer:
+    def _quadratic_steps(self, opt_cls, **kw):
+        w = paddle.to_tensor([5.0], stop_gradient=False)
+        w.name = "w"
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(50):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return abs(w.item())
+
+    def test_sgd(self):
+        assert self._quadratic_steps(paddle.optimizer.SGD,
+                                     learning_rate=0.1) < 0.1
+
+    def test_momentum(self):
+        assert self._quadratic_steps(paddle.optimizer.Momentum,
+                                     learning_rate=0.02) < 0.5
+
+    def test_adam(self):
+        assert self._quadratic_steps(paddle.optimizer.Adam,
+                                     learning_rate=0.3) < 0.5
+
+    def test_adamw_decay(self):
+        w = paddle.to_tensor([1.0], stop_gradient=False)
+        w.name = "w"
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[w],
+                                     weight_decay=0.5)
+        loss = (w * 0.0).sum()
+        loss.backward()
+        opt.step()
+        assert w.item() < 1.0  # decay applied even with zero grad
+
+    def test_lr_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched)
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_cosine_scheduler(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = paddle.flatten(x, 1)
+        return self.fc(x)
+
+
+class TestLeNetEndToEnd:
+    def _synthetic_mnist(self, n=64):
+        rng = np.random.RandomState(0)
+        x = rng.rand(n, 1, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, n)
+        # make learnable: class determined by mean intensity of a patch
+        for i in range(n):
+            x[i, 0, :8, :8] = y[i] / 10.0
+        return x, y
+
+    def test_lenet_train_eager(self):
+        model = LeNet()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        x, y = self._synthetic_mnist()
+        xb, yb = paddle.to_tensor(x), paddle.to_tensor(y)
+        first = None
+        for i in range(20):
+            logits = model(xb)
+            loss = F.cross_entropy(logits, yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = loss.item()
+        assert loss.item() < first
+
+    def test_lenet_train_jitted_step(self):
+        model = LeNet()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+
+        def loss_fn(xb, yb):
+            return F.cross_entropy(model(xb), yb)
+
+        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        x, y = self._synthetic_mnist(32)
+        xb, yb = paddle.to_tensor(x), paddle.to_tensor(y)
+        losses = [step(xb, yb).item() for _ in range(15)]
+        assert losses[-1] < losses[0]
+
+    def test_dataloader_pipeline(self):
+        x, y = self._synthetic_mnist(32)
+        ds = paddle.io.TensorDataset([paddle.to_tensor(x),
+                                      paddle.to_tensor(y)])
+        loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True,
+                                      drop_last=True)
+        batches = list(loader)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert xb.shape == [8, 1, 28, 28]
+
+
+class TestToStatic:
+    def test_to_static_layer(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        eager = model(x).numpy()
+        compiled = paddle.jit.to_static(model)
+        got = model(x).numpy()
+        assert np.allclose(eager, got, atol=1e-5)
+
+    def test_to_static_function(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a, b = paddle.randn([2, 3]), paddle.randn([3, 2])
+        assert np.allclose(f(a, b).numpy(),
+                           a.numpy() @ b.numpy() + 1, atol=1e-5)
+
+    def test_bn_buffer_update_under_jit(self):
+        bn = nn.BatchNorm1D(4)
+        compiled = paddle.jit.to_static(bn)
+        before = bn._mean.numpy().copy()
+        bn(paddle.randn([8, 4]) + 3.0)
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
