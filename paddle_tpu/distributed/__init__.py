@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — mirrors `python/paddle/distributed/`.
+
+The reference's distributed stack (NCCL rings + program-rewriting
+meta-optimizers + C++ Reducer/SectionWorker runtimes) is replaced by ONE
+mechanism: a `jax.sharding.Mesh` with axes (dp, pp, mp, sp, ep), parameter
+PartitionSpec tags, and GSPMD. See SURVEY.md §5/§7 mapping.
+"""
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .env import (  # noqa: F401
+    build_mesh, current_mesh, set_mesh, init_distributed,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, is_initialized, barrier, wait,
+    all_reduce, broadcast, reduce, all_gather, all_gather_object, scatter,
+    alltoall, send, recv, split, psum, pmean, pmax, all_gather_axis,
+    reduce_scatter_axis, ppermute, all_to_all_axis,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, DataParallel, ParallelEnv,
+    spawn,
+)
+from .sharded_train import ShardedTrainStep, shard_model, shard_batch  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .moe import MoELayer  # noqa: F401
+from .pipeline import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
+    PipelineParallel, pipeline_apply, pipeline_apply_tensors,
+)
+from .recompute import recompute  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+fleet.DistributedStrategy = DistributedStrategy

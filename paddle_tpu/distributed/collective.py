@@ -1,0 +1,223 @@
+"""Collective communication API.
+
+Parity: `python/paddle/distributed/collective.py` (Group:79, new_group:209,
+all_reduce:415, broadcast:348, all_gather:589, alltoall:1395, send/recv,
+barrier, split:1233). Two execution regimes:
+
+1. Single-controller (this process drives all chips): tensors are GLOBAL
+   jax.Arrays, so cross-device reductions are expressed by sharding, not
+   message passing — these functions then act on the global view (all_reduce
+   over a dp-sharded grad is an identity on the global array; the physical
+   collective happens inside jit where GSPMD placed it). This is the
+   TPU-native replacement for NCCL rings.
+2. Inside `shard_map` manual regions: the `*_in_shard_map` primitives map
+   1:1 onto lax collectives (psum/all_gather/ppermute/all_to_all) — used by
+   the pipeline and ring-attention implementations.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor._helpers import ensure_tensor
+from . import env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, rank=0, ranks=None, axis_name=None, id=0):  # noqa: A002
+        self.rank = rank
+        self.ranks = ranks or [0]
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name
+        self.id = id
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_GROUPS = {}
+_WORLD = Group(0, [0], axis_name=None, id=0)
+
+
+def _world():
+    global _WORLD
+    n = jax.device_count()
+    if _WORLD.nranks != n:
+        _WORLD = Group(0, list(range(n)), axis_name=None, id=0)
+    return _WORLD
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    gid = len(_GROUPS) + 1
+    g = Group(0, ranks or list(range(jax.device_count())),
+              axis_name=axis_name, id=gid)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid, _world())
+
+
+def is_initialized():
+    return True
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count() if jax.process_count() > 1 else 1
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._value.block_until_ready()
+
+
+# ---- global-view collectives (single-controller semantics) ----------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Global-array view: the tensor already holds the global value; a
+    sharded value gets re-materialized replicated (XLA all-reduce under jit)."""
+    t = ensure_tensor(tensor)
+    mesh = env.current_mesh()
+    if mesh is not None:
+        sh = env.replicated(mesh)
+        t._value = jax.device_put(t._value, sh) if not _is_traced(t) else \
+            jax.lax.with_sharding_constraint(t._value, sh)
+    return t
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return ensure_tensor(tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):  # noqa: A001
+    return all_reduce(tensor, op, group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    t = ensure_tensor(tensor)
+    n = group.nranks if group else 1
+    for _ in range(max(n, 1)):
+        tensor_list.append(t)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(ensure_tensor(tensor_list[0])._value)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    outs = [ensure_tensor(t) for t in in_tensor_list]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return out_tensor_list
+    return outs
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    return ensure_tensor(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return ensure_tensor(tensor)
+
+
+def _is_traced(t):
+    return isinstance(t._value, jax.core.Tracer)
+
+
+# ---- shard_map-region primitives (lax collectives) ------------------------
+
+def psum(tensor, axis_name):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.psum(v, axis_name), t)
+
+
+def pmean(tensor, axis_name):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.pmean(v, axis_name), t)
+
+
+def pmax(tensor, axis_name):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.pmax(v, axis_name), t)
+
+
+def all_gather_axis(tensor, axis_name, axis=0, tiled=True):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.all_gather(v, axis_name, axis=axis,
+                                              tiled=tiled), t)
+
+
+def reduce_scatter_axis(tensor, axis_name, axis=0):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.psum_scatter(v, axis_name,
+                                                scatter_dimension=axis,
+                                                tiled=True), t)
+
+
+def ppermute(tensor, axis_name, perm):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.ppermute(v, axis_name, perm), t)
+
+
+def all_to_all_axis(tensor, axis_name, split_axis, concat_axis):
+    t = ensure_tensor(tensor)
+    return apply(lambda v: jax.lax.all_to_all(
+        v, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True), t)
+
+
+# ---- model-parallel split op (reference collective.py:1233) ---------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split analog: build row/col-parallel linear or
+    vocab-parallel embedding using the mp mesh axis."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation}")

@@ -1,0 +1,122 @@
+"""Distributed environment: the global device mesh.
+
+TPU-native replacement for the reference's comm bootstrap
+(`platform/gen_comm_id_helper.cc` TCP ncclUniqueId broadcast +
+`collective_helper.h:68` NCCLCommContext ring registry): there are no rings,
+streams, or unique-ids — a single `jax.sharding.Mesh` over the device grid is
+the only communication structure, and XLA lowers collectives onto ICI from
+sharding annotations. Multi-host bootstrap is `jax.distributed.initialize`
+over DCN (the analog of the reference's env-var rendezvous,
+`launch_utils.py`).
+"""
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_MESH = None
+_HCG = None
+
+MESH_AXES = ("dp", "pp", "mp", "sp", "ep")
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Multi-host init over DCN (reference analog: fleet.init env contract
+    PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS, `launch_utils.py`)."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if num_processes <= 1:
+        return
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coordinator is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coordinator = eps[0] if eps and eps[0] else None
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def build_mesh(dp=1, pp=1, mp=1, sp=1, ep=1, devices=None):
+    """Create and install the global mesh. Axis order [dp, pp, mp, sp, ep]
+    places mp (highest-bandwidth collectives) innermost so tensor-parallel
+    allreduces ride adjacent-chip ICI links — generalizing the reference's
+    4-D rank grid (`fleet/base/topology.py:36` order [pp, sharding, mp, dp])."""
+    global _MESH
+    if devices is None:
+        devices = np.asarray(jax.devices())
+    else:
+        devices = np.asarray(devices)
+    sizes = (dp, pp, mp, sp, ep)
+    total = int(np.prod(sizes))
+    if devices.size != total:
+        raise ValueError(f"mesh {sizes} needs {total} devices, "
+                         f"have {devices.size}")
+    grid = devices.reshape(sizes)
+    _MESH = Mesh(grid, MESH_AXES)
+    return _MESH
+
+
+def set_mesh(mesh):
+    global _MESH
+    _MESH = mesh
+    return mesh
+
+
+def current_mesh():
+    return _MESH
+
+
+def clear_mesh():
+    global _MESH
+    _MESH = None
+
+
+def get_world_size():
+    return jax.device_count()
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_local_rank():
+    return 0
+
+
+def param_sharding(param, mesh=None, extra_axis=None):
+    """NamedSharding for a parameter from its `mesh_axes` tag (set by
+    TP/MoE layers); `extra_axis` optionally adds ZeRO-style sharding over a
+    data axis on the first free divisible dim."""
+    mesh = mesh or _MESH
+    axes = list(getattr(param, "mesh_axes", None) or ())
+    shape = tuple(param._value.shape)
+    while len(axes) < len(shape):
+        axes.append(None)
+    axes = axes[:len(shape)]
+    # drop axes whose mesh size doesn't divide the dim (safety for tiny tests)
+    for i, a in enumerate(axes):
+        if a is not None and (a not in mesh.axis_names or
+                              shape[i] % mesh.shape[a] != 0):
+            axes[i] = None
+    if extra_axis is not None and extra_axis in mesh.axis_names and \
+            mesh.shape[extra_axis] > 1:
+        for i, a in enumerate(axes):
+            if a is None and shape[i] % mesh.shape[extra_axis] == 0:
+                axes[i] = extra_axis
+                break
+    return NamedSharding(mesh, PartitionSpec(*axes))
+
+
+def replicated(mesh=None):
+    mesh = mesh or _MESH
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh=None, seq_axis=False):
+    """[dp(,sp)]-sharded batch inputs."""
+    mesh = mesh or _MESH
+    if seq_axis and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        return NamedSharding(mesh, PartitionSpec("dp", "sp"))
+    return NamedSharding(mesh, PartitionSpec("dp"))

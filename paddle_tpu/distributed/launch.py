@@ -1,0 +1,69 @@
+"""Launcher — parity with `python -m paddle.distributed.launch`
+(`fleet/launch.py:386`, `launch_utils.py` Cluster/Pod model,
+start_local_trainers:464, watch_local_trainers:573).
+
+TPU-native shape: JAX is single-controller per HOST (one process drives all
+local chips), so "nproc per device" disappears. On a multi-host pod slice,
+run this once per host with --nnodes/--node_rank/--master (or under a cluster
+scheduler exporting PADDLE_* envs); it wires `jax.distributed.initialize`
+over DCN and execs the training script in-process. Failure of any host
+surfaces as a collective error; the elastic wrapper relaunches (exit-code
+protocol kept from the reference: ELASTIC_EXIT_CODE=101,
+`fleet/elastic/manager.py:26`).
+"""
+import argparse
+import os
+import runpy
+import sys
+
+ELASTIC_EXIT_CODE = 101
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--devices", "--gpus", "--xpus", type=str, default="",
+                   help="accepted for CLI parity; chip selection is "
+                        "topology-driven on TPU")
+    p.add_argument("--elastic_level", type=int, default=int(
+        os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0")))
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        os.environ["PADDLE_MASTER"] = args.master
+        os.environ.setdefault("PADDLE_TRAINER_ENDPOINTS", args.master)
+    if args.nnodes > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=args.master or None,
+            num_processes=args.nnodes, process_id=args.node_rank)
+
+    sys.argv = [args.training_script] + args.training_script_args
+    restarts = 0
+    while True:
+        try:
+            runpy.run_path(args.training_script, run_name="__main__")
+            return 0
+        except SystemExit as e:
+            if e.code == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
+                    restarts < args.max_restarts:
+                restarts += 1
+                continue
+            raise
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

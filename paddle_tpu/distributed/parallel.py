@@ -1,0 +1,102 @@
+"""Process/data-parallel entry points.
+
+Parity: `python/paddle/distributed/parallel.py:85` (init_parallel_env) and
+`python/paddle/fluid/dygraph/parallel.py:383` (DataParallel). The reference's
+DataParallel wraps a C++ Reducer doing bucketed NCCL allreduce overlapped
+with backward (`reducer.cc:648,759`); on TPU the same overlap falls out of
+GSPMD + the XLA latency-hiding scheduler once the batch is dp-sharded, so
+DataParallel here only (a) places params replicated on the mesh, (b) shards
+input batches, (c) provides the API surface (scale_loss /
+apply_collective_grads are no-ops kept for compatibility).
+"""
+import os
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from . import env
+
+
+class ParallelEnv:
+    """Reference `parallel.py` ParallelEnv (env-var contract
+    PADDLE_TRAINER_ID etc.)."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       jax.process_index()))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             jax.process_count()))
+        self.device_id = 0
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+
+def init_parallel_env():
+    """Bootstrap multi-host (DCN) if env vars say so, and install a pure-dp
+    mesh over all chips."""
+    env.init_distributed()
+    if env.current_mesh() is None:
+        env.build_mesh(dp=jax.device_count())
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        mesh = env.current_mesh()
+        if mesh is None:
+            mesh = env.build_mesh(dp=jax.device_count())
+        from .sharded_train import shard_model
+        shard_model(layers, mesh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-controller JAX drives all local chips from one process, so
+    spawn degenerates to a direct call (reference `spawn.py:333` forked one
+    process per GPU)."""
+    func(*args)

@@ -1,0 +1,65 @@
+"""Activation recompute (gradient checkpointing).
+
+Parity: `python/paddle/distributed/fleet/utils/recompute.py:63`
+(RecomputeFunction PyLayer: stash RNG, re-forward in backward) and the static
+`RecomputeOptimizer` (`fluid/optimizer.py:5927`, checkpoint-segment backward
+`backward.py:749`). TPU-native: `jax.checkpoint` (rematerialization) — XLA
+re-runs the segment in the backward pass, trading FLOPs for HBM exactly like
+the reference, but scheduled by the compiler.
+"""
+import jax
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..core.tensor import apply
+from ..jit import bind_tensors
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              **kwargs):
+    """Run `function(*args)` under rematerialization. If `function` is a
+    Layer (or bound Layer method), its parameters are threaded as
+    differentiable inputs so their grads flow."""
+    from ..nn import Layer
+    layer = None
+    if isinstance(function, Layer):
+        layer = function
+    elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+        layer = function.__self__
+    params = [p for p in layer.parameters() if p is not None] if layer else []
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+    n_args = len(tensor_args)
+
+    def fn(*vals):
+        arg_vals, pvals = vals[:n_args], vals[n_args:]
+        rebuilt = list(args)
+        for i, v in zip(tensor_idx, arg_vals):
+            rebuilt[i] = Tensor(v)
+        with autograd.fresh_tape(), autograd.no_grad(), \
+                bind_tensors(params, pvals):
+            out = function(*rebuilt, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(fn)
+    return apply(ckpt, *tensor_args, *params)
+
+
+class RecomputeSequential:
+    """Helper: wrap each sublayer of a Sequential-like stack in recompute
+    (the reference's recompute_interval on PipelineLayer)."""
+
+    def __init__(self, layers, interval=1):
+        self.layers = layers
+        self.interval = interval
+
+    def __call__(self, x):
+        for i, layer in enumerate(self.layers):
+            if self.interval and i % self.interval == 0:
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return x

@@ -1,0 +1,159 @@
+"""GSPMD-sharded training step.
+
+This replaces the reference's entire distributed execution machinery for
+collective mode — meta-optimizer program rewriting
+(`sharding_optimizer.py:508`, `raw_program_optimizer.py:237`), the DDP
+Reducer (`imperative/reducer.cc`), and comm-op insertion — with data
+placement + one pjit:
+
+- parameters are device_put with NamedShardings derived from `mesh_axes`
+  tags (tensor/expert parallel) — GSPMD inserts TP collectives;
+- batch inputs are sharded over (dp, sp) — data/sequence parallelism; the
+  loss mean over a dp-sharded batch makes XLA emit the gradient allreduce
+  (the Reducer's job) fused and overlapped by the latency-hiding scheduler;
+- optimizer states are additionally sharded over dp (ZeRO-1/2 analog of
+  `DygraphShardingOptimizer`): XLA all-gathers weights on use and
+  reduce-scatters grads into the sharded update.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..core.random import rng_guard, default_generator
+from ..jit import bind_tensors
+from . import env
+
+
+def shard_model(model, mesh=None):
+    """Place every parameter/buffer according to its mesh_axes tag
+    (replicated if untagged). The analog of
+    `fleet.distributed_model` (`fleet_base.py:881`)."""
+    mesh = mesh or env.current_mesh()
+    for p in model.parameters():
+        if p is None:
+            continue
+        sh = env.param_sharding(p, mesh)
+        p._value = jax.device_put(p._value, sh)
+    for b in model.buffers():
+        if b is not None:
+            b._value = jax.device_put(b._value, env.replicated(mesh))
+    return model
+
+
+def shard_batch(batch, mesh=None, seq_axis=False):
+    mesh = mesh or env.current_mesh()
+    sh = env.batch_sharding(mesh, seq_axis)
+    out = []
+    for b in batch:
+        v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+        spec = list(sh.spec)
+        # only shard dims that exist & divide
+        spec = spec[:v.ndim]
+        for i, a in enumerate(spec):
+            if a is not None and v.shape[i] % mesh.shape[a] != 0:
+                spec[i] = None
+        out.append(jax.device_put(v, NamedSharding(mesh, PartitionSpec(*spec))))
+    return out
+
+
+class ShardedTrainStep:
+    """pjit'd fwd+bwd+update over the global mesh.
+
+    zero_stage: 0 = replicated states (pure DP/TP), 1/2 = optimizer states
+    sharded over dp (reference sharding stage1/2; stage 3 == weights also
+    sharded is expressed the same way via param extra_axis)."""
+
+    def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=1,
+                 seq_shard_batch=False, donate=True):
+        self.mesh = mesh or env.current_mesh()
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.zero_stage = zero_stage
+        self.seq_shard = seq_shard_batch
+        self.params = [p for _, p in model.named_parameters()
+                       if not p.stop_gradient]
+        self.buffers = [b for _, b in model.named_buffers() if b is not None]
+        for p in self.params:
+            self.optimizer._get_state(p)
+        self._place_states()
+        self._jitted = None
+        self._donate = donate
+
+    def _state_sharding(self, p):
+        extra = "dp" if self.zero_stage >= 1 else None
+        return env.param_sharding(p, self.mesh, extra_axis=extra)
+
+    def _place_states(self):
+        for p in self.params:
+            st = self.optimizer._states[id(p)]
+            sh = self._state_sharding(p)
+            rep = env.replicated(self.mesh)
+            for k, v in st.items():
+                v = jnp.asarray(v)
+                st[k] = jax.device_put(
+                    v, sh if v.shape == tuple(p._value.shape) else rep)
+
+    def _make_step(self):
+        params, buffers, opt = self.params, self.buffers, self.optimizer
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+
+        param_sh = [env.param_sharding(p, mesh) for p in params]
+        state_sh = []
+        for p in params:
+            psh = self._state_sharding(p)
+            rep = env.replicated(mesh)
+            st = opt._states[id(p)]
+            state_sh.append({k: (psh if np.shape(v) == tuple(p._value.shape)
+                                 else rep) for k, v in st.items()})
+        buf_sh = [env.replicated(mesh)] * len(buffers)
+        rep = env.replicated(mesh)
+
+        def step(param_vals, opt_states, buffer_vals, lr, rng, batch_vals):
+            with autograd.fresh_tape(), \
+                    bind_tensors(params, param_vals), \
+                    bind_tensors(buffers, buffer_vals), rng_guard(rng):
+                batch = [Tensor(v) for v in batch_vals]
+                loss = loss_fn(*batch)
+                autograd.backward(loss)
+                grads = [p.grad._value if p.grad is not None
+                         else jnp.zeros_like(p._value) for p in params]
+                with autograd.no_grad():
+                    if opt._grad_clip is not None:
+                        pg = opt._grad_clip(
+                            [(p, Tensor(g)) for p, g in zip(params, grads)])
+                        grads = [g._value for _, g in pg]
+                    new_vals, new_states = opt._functional_apply(
+                        params, param_vals, grads, opt_states, lr)
+                new_buf = [b._value for b in buffers]
+                return loss._value, new_vals, new_states, new_buf
+
+        in_sh = (param_sh, state_sh, buf_sh, rep, rep, None)
+        out_sh = (rep, param_sh, state_sh, buf_sh)
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._jitted = self._make_step()
+        batch_vals = shard_batch(batch, self.mesh, self.seq_shard)
+        param_vals = [p._value for p in self.params]
+        opt_states = [self.optimizer._states[id(p)] for p in self.params]
+        buffer_vals = [b._value for b in self.buffers]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        rng = default_generator().split()
+        loss, new_vals, new_states, new_buf = self._jitted(
+            param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        for p, v in zip(self.params, new_vals):
+            p._value = v
+            p.grad = None
+        for p, s in zip(self.params, new_states):
+            self.optimizer._states[id(p)] = s
+        for b, v in zip(self.buffers, new_buf):
+            b._value = v
+        return Tensor(loss)

@@ -1,0 +1,63 @@
+"""DistributedStrategy — parity with
+`python/paddle/distributed/fleet/base/distributed_strategy.py` +
+`framework/distributed_strategy.proto:26-228`. A plain dataclass registry of
+the same toggles, mapped onto GSPMD/mesh mechanisms:
+
+  amp            -> bf16 policy (paddle_tpu.amp)
+  recompute      -> jax.checkpoint on tagged blocks
+  sharding       -> ZeRO state sharding over the dp axis (ShardedTrainStep)
+  pipeline       -> shard_map GPipe over the pp axis
+  tensor_parallel-> mesh_axes parameter tags (GSPMD)
+  gradient_merge -> accumulation loop in TrainStep
+  fuse_allreduce -> XLA (automatic)
+  localsgd/dgc   -> optimizer wrappers
+"""
+import copy
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False, "use_bf16": True,
+                            "custom_white_list": [], "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1,
+                                 "segment_broadcast_MB": 32.0,
+                                 "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.fp16_allreduce = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1,
+                               "ep_degree": 1}
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.elastic = False
+        self.auto = False
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if not k.endswith("_configs")}
+        return f"DistributedStrategy({fields})"
+
+    def copy(self):
+        return copy.deepcopy(self)

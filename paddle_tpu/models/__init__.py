@@ -1,0 +1,8 @@
+"""Flagship model implementations (GPT pretraining, BERT)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForPretraining, GPTBlock, GPTAttention, GPTMLP,
+    gpt_tiny_config,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+)

@@ -1,0 +1,142 @@
+"""BERT / ERNIE-style encoder (capability config 3: fine-tune).
+
+Reference analog: the transformer encoder stack in
+`python/paddle/nn/layer/transformer.py` as used by BERT fine-tune configs;
+attention routes through the fused TPU path instead of
+`fused_transformer_op.cu`.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn import (Layer, Linear, LayerNorm, Dropout, Embedding,
+                  TransformerEncoder, TransformerEncoderLayer, Tanh)
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..tensor.manipulation import reshape
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
+                 initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+    @staticmethod
+    def bert_base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def bert_large(**kw):
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096, **kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(c.max_position, c.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size, weight_attr=init)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.dropout = Dropout(c.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(input_ids._value))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_heads, c.intermediate_size,
+            dropout=c.hidden_dropout, activation="gelu",
+            attn_dropout=c.attn_dropout, act_dropout=0.0)
+        self.encoder = TransformerEncoder(enc_layer, c.num_layers)
+        self.pooler = Linear(c.hidden_size, c.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            mask_bias = None
+        else:
+            # [b, s] 1/0 -> additive bias [b, 1, 1, s]
+            av = attention_mask._value if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            bias = (1.0 - av[:, None, None, :].astype(jnp.float32)) * -1e30
+            mask_bias = Tensor(bias)
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq_out = self.encoder(emb, mask_bias)
+        pooled = self.pooler_act(self.pooler(seq_out[:, 0]))
+        return seq_out, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertPretrainingHeads(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.transform = Linear(c.hidden_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.decoder_bias = self.create_parameter([c.vocab_size], is_bias=True)
+        self.seq_relationship = Linear(c.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output, word_embedding_weight):
+        h = F.gelu(self.transform(sequence_output))
+        h = self.layer_norm(h)
+        logits = apply(lambda hh, ww, bb: jnp.einsum("bsd,vd->bsv", hh, ww) + bb,
+                       h, word_embedding_weight, self.decoder_bias)
+        nsp = self.seq_relationship(pooled_output)
+        return logits, nsp
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertPretrainingHeads(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask=attention_mask)
+        return self.cls(seq_out, pooled,
+                        self.bert.embeddings.word_embeddings.weight)

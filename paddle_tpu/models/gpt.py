@@ -1,0 +1,222 @@
+"""GPT — the flagship pretraining model (capability config 5: GPT-3 1.3B/13B
+3D-hybrid).
+
+Reference analog: the fleet GPT examples driven by
+`python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py`
+(VocabParallelEmbedding/ColumnParallelLinear/RowParallelLinear) and
+`pp_layers.py` (PipelineLayer). TPU-native design: the SAME model code serves
+single-chip and 3D-parallel execution — parallelism is expressed as
+per-parameter `PartitionSpec` tags (`mesh_axes` attribute) plus activation
+sharding constraints, and GSPMD inserts the collectives the reference's
+meta-optimizers used to splice in by program rewriting.
+
+Sharding plan (Megatron-style, rides ICI):
+  wte [vocab, d]            -> ("mp", None)       vocab-parallel embedding
+  qkv/fc1 weight [d, 3d|4d] -> (None, "mp")       column-parallel
+  proj/fc2 weight [*, d]    -> ("mp", None)       row-parallel
+  activations [b, s, d]     -> ("dp", "sp", None) batch + sequence sharded
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn import Layer, LayerList, Linear, LayerNorm, Dropout, Embedding
+from ..nn import functional as F
+from ..nn.initializer import Normal, Constant
+from ..tensor.manipulation import reshape, transpose
+from ..ops.attention import flash_attention
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
+                 dropout=0.0, attn_dropout=0.0, initializer_range=0.02,
+                 use_flash_attention=True, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.use_flash_attention = use_flash_attention
+        self.dtype = dtype
+
+    @staticmethod
+    def gpt3_125m(**kw):
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @staticmethod
+    def gpt3_350m(**kw):
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
+    def gpt3_1_3b(**kw):
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
+    def gpt3_13b(**kw):
+        return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, **kw)
+
+
+def _tag(param, axes):
+    """Attach a GSPMD partition tag consumed by distributed.shard_model /
+    ShardedTrainStep."""
+    if param is not None:
+        param.mesh_axes = axes
+    return param
+
+
+class GPTAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.hidden_size = c.hidden_size
+        init = Normal(0.0, c.initializer_range)
+        self.qkv_proj = Linear(c.hidden_size, 3 * c.hidden_size,
+                               weight_attr=init)
+        self.out_proj = Linear(c.hidden_size, c.hidden_size, weight_attr=init)
+        _tag(self.qkv_proj.weight, (None, "mp"))
+        _tag(self.qkv_proj.bias, ("mp",))
+        _tag(self.out_proj.weight, ("mp", None))
+        self.attn_dropout = c.attn_dropout
+        self.use_flash = c.use_flash_attention
+
+    def forward(self, x, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            from ..tensor.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        out = flash_attention(q, k, v, dropout=self.attn_dropout,
+                              causal=True, training=self.training)
+        out = reshape(out, [b, s, self.hidden_size])
+        out = self.out_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        out_init = Normal(0.0, c.initializer_range / math.sqrt(2 * c.num_layers))
+        self.fc1 = Linear(c.hidden_size, c.ffn_hidden_size, weight_attr=init)
+        self.fc2 = Linear(c.ffn_hidden_size, c.hidden_size,
+                          weight_attr=out_init)
+        _tag(self.fc1.weight, (None, "mp"))
+        _tag(self.fc1.bias, ("mp",))
+        _tag(self.fc2.weight, ("mp", None))
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size)
+        self.attn = GPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size)
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        self.wte = Embedding(c.vocab_size, c.hidden_size, weight_attr=init)
+        self.wpe = Embedding(c.max_seq_len, c.hidden_size, weight_attr=init)
+        _tag(self.wte.weight, ("mp", None))  # vocab-parallel
+        self.drop = Dropout(c.dropout)
+        self.blocks = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        self.ln_f = LayerNorm(c.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        h = self.wte(input_ids) + self.wpe(position_ids)
+        h = self.drop(h)
+        h = _shard_activation(h)
+        for block in self.blocks:
+            h = block(h)
+            h = _shard_activation(h)
+        return self.ln_f(h)
+
+
+def _shard_activation(h):
+    """Apply a [dp, sp, None] sharding constraint when a mesh is active —
+    the GSPMD hook that keeps activations sequence-sharded between blocks."""
+    from ..distributed import env as dist_env
+    mesh = dist_env.current_mesh()
+    if mesh is None:
+        return h
+    from jax.sharding import PartitionSpec as P
+    import jax
+    axes = [None, None, None]
+    if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+        axes[0] = "dp"
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        axes[1] = "sp"
+    spec = P(*axes)
+    return apply(lambda v: jax.lax.with_sharding_constraint(
+        v, jax.sharding.NamedSharding(mesh, spec)), h)
+
+
+class GPTForPretraining(Layer):
+    """LM head tied to wte (the shared-embedding pattern whose cross-stage
+    allreduce the reference handles at `pipeline_parallel.py:162`; with GSPMD
+    the tied weight is just referenced twice and the compiler handles it)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        w = self.gpt.wte.weight
+        logits = apply(lambda hh, ww: jnp.einsum(
+            "bsd,vd->bsv", hh, ww,
+            preferred_element_type=jnp.float32), h, w)
+        return logits
+
+    def loss(self, input_ids, labels, loss_mask=None):
+        logits = self(input_ids)
+        vocab = logits.shape[-1]
+        flat_logits = reshape(logits, [-1, vocab])
+        flat_labels = reshape(labels, [-1])
+        losses = F.cross_entropy(flat_logits, flat_labels, reduction="none")
+        if loss_mask is not None:
+            m = reshape(loss_mask, [-1])
+            return (losses * m).sum() / m.sum()
+        return losses.mean()
+
+
+def gpt_tiny_config():
+    """Small config for tests/dryrun."""
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0)

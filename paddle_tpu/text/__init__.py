@@ -1,0 +1,3 @@
+"""paddle_tpu.text — NLP model re-exports (reference `python/paddle/text/`)."""
+from ..models.bert import BertConfig, BertModel  # noqa: F401
+from ..models.gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401

@@ -1,0 +1,4 @@
+"""paddle_tpu.vision — mirrors `python/paddle/vision/`."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

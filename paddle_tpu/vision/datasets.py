@@ -1,0 +1,129 @@
+"""Vision datasets — parity with `python/paddle/vision/datasets/`.
+
+Zero-egress environment: loaders read from local files when present
+(`image_path`/`label_path` args, standard IDX/pickle formats) and raise a
+clear error otherwise; `FakeData`/`SyntheticMNIST` provide deterministic
+generated data for tests and benchmarks.
+"""
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST from local files (reference downloads;
+    zero-egress here)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None, root=None):
+        self.transform = transform
+        prefix = "train" if mode == "train" else "t10k"
+        root = root or os.environ.get("MNIST_DATA_ROOT", "")
+        image_path = image_path or os.path.join(
+            root, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            root, f"{prefix}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"MNIST files not found ({image_path}); this environment has "
+                "no network access — provide local files or use "
+                "paddle_tpu.vision.datasets.SyntheticMNIST")
+        self.images = self._read_idx(image_path, 16).reshape(-1, 28, 28)
+        self.labels = self._read_idx(label_path, 8)
+
+    @staticmethod
+    def _read_idx(path, header):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        return np.frombuffer(data, dtype=np.uint8, offset=header)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "CIFAR batches not found; zero-egress environment — pass "
+                "data_file or use FakeData")
+        with open(data_file, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        self.images = batch[b"data"].reshape(-1, 3, 32, 32).transpose(
+            0, 2, 3, 1)
+        self.labels = batch.get(b"labels", batch.get(b"fine_labels"))
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image-classification data (for tests/bench)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = idx % self.num_classes
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class SyntheticMNIST(Dataset):
+    """Learnable synthetic MNIST-shaped data: class encoded in a patch."""
+
+    def __init__(self, size=1024, transform=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.rand(size, 1, 28, 28).astype(np.float32)
+        self.labels = rng.randint(0, 10, size)
+        for i in range(size):
+            self.images[i, 0, :8, :8] = self.labels[i] / 10.0
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)

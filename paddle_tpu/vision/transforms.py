@@ -1,0 +1,234 @@
+"""Vision transforms — parity with `python/paddle/vision/transforms/`.
+
+Host-side numpy implementations (transforms run in DataLoader workers on
+CPU; device work starts at the model). HWC uint8/float numpy in, numpy out;
+ToTensor converts to CHW float Tensors.
+"""
+import numbers
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def _resize_np(img, h, w, interpolation="bilinear"):
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    # separable bilinear/nearest on numpy
+    ys = np.linspace(0, ih - 1, h) if interpolation != "nearest" else \
+        np.minimum((np.arange(h) * ih / h).astype(np.int64), ih - 1)
+    xs = np.linspace(0, iw - 1, w) if interpolation != "nearest" else \
+        np.minimum((np.arange(w) * iw / w).astype(np.int64), iw - 1)
+    if interpolation == "nearest":
+        return img[ys][:, xs]
+    y0 = np.floor(ys).astype(np.int64)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x0 = np.floor(xs).astype(np.int64)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None, None] if img.ndim == 3 else (ys - y0)[:, None]
+    wx = (xs - x0)[None, :, None] if img.ndim == 3 else (xs - x0)[None, :]
+    imgf = img.astype(np.float32)
+    top = imgf[y0][:, x0] * (1 - wx) + imgf[y0][:, x1] * wx
+    bot = imgf[y1][:, x0] * (1 - wx) + imgf[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.floating) \
+        else np.clip(out, 0, 255).astype(img.dtype)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        if isinstance(self.size, numbers.Number):
+            ih, iw = img.shape[:2]
+            scale = self.size / min(ih, iw)
+            h, w = int(round(ih * scale)), int(round(iw * scale))
+        else:
+            h, w = _size_pair(self.size)
+        return _resize_np(img, h, w, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = _size_pair(size)
+
+    def _apply_image(self, img):
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = max((ih - h) // 2, 0)
+        left = max((iw - w) // 2, 0)
+        return img[top:top + h, left:left + w]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = _size_pair(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        h, w = self.size
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else [self.padding] * 4
+            pads = [(p[1], p[3]), (p[0], p[2])] + \
+                [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads)
+        ih, iw = img.shape[:2]
+        top = np.random.randint(0, max(ih - h, 0) + 1)
+        left = np.random.randint(0, max(iw - w, 0) + 1)
+        return img[top:top + h, left:left + w]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        ih, iw = img.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= iw and 0 < h <= ih:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+                crop = img[top:top + h, left:left + w]
+                return _resize_np(crop, *self.size,
+                                  interpolation=self.interpolation)
+        return _resize_np(CenterCrop(min(ih, iw))._apply_image(img),
+                          *self.size, interpolation=self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            mean = self.mean.reshape(-1, 1, 1)
+            std = self.std.reshape(-1, 1, 1)
+        else:
+            mean, std = self.mean, self.std
+        return (img - mean) / std
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if np.issubdtype(img.dtype, np.integer):
+            img = img.astype(np.float32) / 255.0
+        if self.data_format == "CHW":
+            img = img.transpose(2, 0, 1)
+        return img.astype(np.float32)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(img.astype(np.float32) * f, 0,
+                       255 if np.issubdtype(img.dtype, np.integer) else None
+                       ).astype(img.dtype)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = BrightnessTransform(brightness)
+
+    def _apply_image(self, img):
+        return self.brightness._apply_image(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return Tensor(ToTensor(data_format)._apply_image(np.asarray(pic)))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)._apply_image(np.asarray(img))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)._apply_image(np.asarray(img))
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
