@@ -1,0 +1,105 @@
+"""Single-chip flagship benchmark: GPT train step (fwd+bwd+AdamW, one fused
+XLA program) tokens/sec/chip and model FLOPs utilization.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.40 (the BASELINE.json north-star MFU target;
+the reference publishes no absolute numbers, see BASELINE.md).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# bf16 peak FLOP/s per chip by device kind
+_PEAK = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def _peak_flops(kind):
+    kind = kind.lower()
+    for key, val in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return None
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    on_tpu = jax.default_backend() == "tpu"
+    dev = jax.devices()[0]
+
+    if on_tpu:
+        cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
+        batch, seq, steps, warmup = 8, 1024, 30, 3
+    else:  # CPU smoke so the script always works
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=256, dropout=0.0,
+                        use_flash_attention=False)
+        batch, seq, steps, warmup = 2, 256, 3, 1
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+
+    def loss_fn(ids, labels):
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return model.loss(ids, labels)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    lbl = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+
+    # NOTE on timing: under the axon tunnel block_until_ready returns before
+    # the remote computation finishes, so synchronization must be a real
+    # device->host transfer. Steps chain through the donated params, so
+    # fetching the final loss scalar forces the whole timed sequence; the
+    # measured transfer round-trip latency is subtracted.
+    for _ in range(warmup):
+        loss = step(ids, lbl)
+    float(loss.item())  # sync
+
+    t0 = time.perf_counter()
+    float(loss.item())
+    fetch_latency = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, lbl)
+    float(loss.item())  # sync: forces all chained steps
+    dt = max(1e-9, time.perf_counter() - t0 - fetch_latency)
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # PaLM-style train FLOPs/token: 6N for matmuls + 12*L*H*S for attention
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    peak = _peak_flops(dev.device_kind) if on_tpu else None
+    mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    print(f"# device={dev.device_kind} loss={loss.item():.4f} "
+          f"mfu={mfu:.3f} params={n_params/1e6:.1f}M "
+          f"step={dt/steps*1000:.1f}ms", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,344 @@
+"""Blockwise (flash) attention as Pallas TPU kernels.
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(`operators/fused/fused_attention_op.cu`, `fmha_ref.h`), which materialize
+the full O(s^2) probability matrix in HBM. Here the softmax is computed
+online per [block_q, block_k] tile held in VMEM, so HBM traffic is O(s) and
+the two matmuls per tile run back-to-back on the MXU.
+
+Layout: inputs are paddle-convention [batch, seq, heads, head_dim] (BSNH);
+kernels internally operate on [batch*heads, seq, head_dim]. Forward saves
+the per-row logsumexp; backward recomputes probabilities per tile (the
+standard flash-attention recomputation trade) with three Pallas kernels
+(dkdv, dq) wired up through jax.custom_vjp so the eager tape's jax.vjp
+flows through it unchanged.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_LANES = 128  # stats buffers padded to a full lane register
+_SUB = 8     # row-stats (lse/delta) replicated over 8 sublanes so their
+             # [.., _SUB, bq] blocks satisfy the TPU (8, 128) tile minimum
+_NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, bq, bk, nk, offset):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    qi = pl.program_id(1)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, H]
+        k = k_ref[0].astype(jnp.float32)          # [bk, H]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+        m_prev = m_sc[:, :1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)           # [bk, H]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, H]
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    if causal:
+        # skip tiles strictly above the diagonal band
+        @pl.when(ki * bk <= (qi + 1) * bq - 1 + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse = (m_sc[:, :1] + jnp.log(l_safe))[:, 0]          # [bq]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (_SUB, lse.shape[0]))
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        offset=offset)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * n, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, i, 0)),
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, j, 0)),
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, i, 0)),
+            pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, sq, h), q.dtype),
+            jax.ShapeDtypeStruct((b * n, _SUB, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, h), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * n * sq * sk * h,
+            bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
+            transcendentals=b * n * sq * sk),
+        interpret=_interpret(),
+    )(qr, kr, vr)
+    return out, lse  # [BN, S, H], [BN, _SUB, S]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc,
+                *, scale, causal, bq, bk, nq, offset):
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    ki = pl.program_id(1)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)           # [bq, H]
+        k = k_ref[0].astype(jnp.float32)           # [bk, H]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)         # [bq, H]
+        lse = lse_ref[0][0][:, None]               # [bq, 1]
+        delta = delta_ref[0][0][:, None]           # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            p = jnp.where(rows + offset >= cols, p, 0.0)
+        # dv += p^T do
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when((qi + 1) * bq - 1 + offset >= ki * bk)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_sc, *, scale, causal, bq, bk, nk, offset):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    qi = pl.program_id(1)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0][:, None]
+        delta = delta_ref[0][0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            p = jnp.where(rows + offset >= cols, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * bk <= (qi + 1) * bq - 1 + offset)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    gr = g.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+
+    # delta_i = rowsum(dO * O); elementwise, XLA fuses it
+    delta = jnp.sum(gr.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * n, _SUB, sq))
+
+    common_in = [
+        pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, j, 0)),   # q by inner
+        pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, i, 0)),   # k by outer
+        pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, i, 0)),   # v by outer
+        pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, j, 0)),   # do by inner
+        pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, j)),  # lse
+        pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, j)),  # delta
+    ]
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+        offset=offset)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * n, nk, nq),
+        in_specs=common_in,
+        out_specs=[
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, i, 0)),
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, sk, h), k.dtype),
+            jax.ShapeDtypeStruct((b * n, sk, h), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, h), jnp.float32),
+            pltpu.VMEM((bk, h), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse, delta)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        offset=offset)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * n, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, i, 0)),
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, j, 0)),
+            pl.BlockSpec((1, bk, h), lambda bn, i, j: (bn, j, 0)),
+            pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, i, 0)),
+            pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, i)),
+            pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, h), lambda bn, i, j: (bn, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, sq, h), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, h), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse, delta)
+
+    def unflatten(x, s):
+        return x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
+    return unflatten(dq, sq), unflatten(dk, sk), unflatten(dv, sk)
+
+
+# ---------------------------------------------------------------------------
+# public custom-vjp entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_fwd(q, k, v, causal=False, scale=None,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q, k, v: [B, S, N, H] -> out [B, S, N, H]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b, sq, n, h = q.shape
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out.reshape(b, n, sq, h).transpose(0, 2, 1, 3)
+
+
+def _vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b, sq, n, h = q.shape
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    res = (q, k, v, out, lse)
+    return out.reshape(b, n, sq, h).transpose(0, 2, 1, 3), res
+
+
+def _vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, scale,
+                            block_q, block_k)
+    return dq, dk, dv
+
+
+flash_attention_fwd.defvjp(_vjp_fwd, _vjp_bwd)
