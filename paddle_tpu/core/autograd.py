@@ -88,6 +88,10 @@ def tape_size():
     return len(_state.nodes)
 
 
+def current_tape():
+    return _state.nodes
+
+
 @contextlib.contextmanager
 def fresh_tape():
     """Push a fresh tape (used when tracing a compiled step so recorded nodes

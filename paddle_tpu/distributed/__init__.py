@@ -34,5 +34,6 @@ from .pipeline import (  # noqa: F401
 )
 from .recompute import recompute  # noqa: F401
 from . import checkpoint  # noqa: F401
+from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy

@@ -41,14 +41,43 @@ def save_checkpoint(path, model, optimizer=None, step=None, async_save=True):
 
 
 def load_checkpoint(path, model, optimizer=None):
+    """Restore in place. Arrays are restored directly onto each live
+    tensor's current sharding (orbax reads only this host's shards when the
+    target is sharded), so a 13B-on-a-pod restore never materializes full
+    parameters on any single host."""
+    import jax.numpy as jnp
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-    restored = ckptr.restore(path)
+    target = _state_pytree(model, optimizer)
+    try:
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target, restore_args=restore_args))
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            f"sharded checkpoint restore failed ({type(e).__name__}: {e}); "
+            "falling back to unsharded restore — on multi-host this "
+            "materializes full arrays per host")
+        restored = ckptr.restore(path)
+    from . import env as dist_env
+    mesh = dist_env.current_mesh()
     sd = model.state_dict()
     for k, t in sd.items():
         if k in restored["model"]:
-            t.set_value(np.asarray(restored["model"][k]))
+            v = jnp.asarray(restored["model"][k])
+            if tuple(v.shape) != tuple(t._value.shape):
+                raise ValueError(
+                    f"checkpoint shape mismatch for '{k}': saved "
+                    f"{tuple(v.shape)} vs model {tuple(t._value.shape)}")
+            v = v.astype(t._value.dtype)
+            # re-shard from the persistent mesh_axes tag (survives
+            # set_value), falling back to the live array's placement
+            sh = dist_env.param_sharding(t, mesh) if mesh is not None \
+                else getattr(t._value, "sharding", None)
+            t._value = jax.device_put(v, sh) if sh is not None else v
     if optimizer is not None and "optimizer" in restored:
         params = {k: p for k, p in model.named_parameters()}
         for k, st in restored["optimizer"].items():
@@ -57,5 +86,9 @@ def load_checkpoint(path, model, optimizer=None):
                 cur = optimizer._get_state(p)
                 for sk in cur:
                     if sk in st:
-                        cur[sk] = jax.numpy.asarray(st[sk])
+                        v = jnp.asarray(st[sk])
+                        sh = getattr(cur[sk], "sharding", None) \
+                            if hasattr(cur[sk], "sharding") else None
+                        cur[sk] = jax.device_put(v, sh) if sh is not None \
+                            else v
     return restored

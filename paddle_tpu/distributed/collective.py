@@ -118,7 +118,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):  # noqa: A
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     t = ensure_tensor(tensor)
-    n = group.nranks if group else 1
+    n = (group or _world()).nranks
     for _ in range(max(n, 1)):
         tensor_list.append(t)
     return tensor_list

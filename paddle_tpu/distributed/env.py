@@ -101,7 +101,7 @@ def param_sharding(param, mesh=None, extra_axis=None):
                               shape[i] % mesh.shape[a] != 0):
             axes[i] = None
     if extra_axis is not None and extra_axis in mesh.axis_names and \
-            mesh.shape[extra_axis] > 1:
+            mesh.shape[extra_axis] > 1 and extra_axis not in axes:
         for i, a in enumerate(axes):
             if a is None and shape[i] % mesh.shape[extra_axis] == 0:
                 axes[i] = extra_axis

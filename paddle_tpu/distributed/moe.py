@@ -62,11 +62,17 @@ class MoELayer(Layer):
             gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [n, k]
             combine = jnp.zeros((n, E, capacity), dtype=xv.dtype)
             dispatch = jnp.zeros((n, E, capacity), dtype=jnp.bool_)
+            # per-expert token counts from earlier gate slots: slot-s
+            # positions start after all slot-<s assignments, so 1st- and
+            # 2nd-choice tokens of the same expert never share a capacity
+            # slot (the GShard position offset)
+            counts = jnp.zeros((E,), dtype=jnp.int32)
             for slot in range(k):
                 idx = gate_idx[:, slot]  # [n]
                 onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
                 pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # position within expert
-                pos_in_e = jnp.sum(pos, axis=-1)  # [n]
+                pos_in_e = jnp.sum(pos, axis=-1) + jnp.take(counts, idx)  # [n]
+                counts = counts + jnp.sum(onehot, axis=0)
                 ok = pos_in_e < capacity
                 g = gate_vals[:, slot] * ok.astype(xv.dtype)
                 pos_oh = jax.nn.one_hot(jnp.where(ok, pos_in_e, capacity),

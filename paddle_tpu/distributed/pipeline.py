@@ -216,13 +216,37 @@ class PipelineParallel(Layer):
         return self._layers(x)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Gradient-accumulated microbatch step (reference
+        `pipeline_parallel.py:80` train_batch semantics: the global batch is
+        split into `accumulate_steps` microbatches, grads accumulate across
+        them, one optimizer step at the end)."""
         x, y = data
-        loss_fn = self._layers._loss_fn or (lambda out, lbl: out.mean())
-        out = self._layers(x)
-        loss = loss_fn(out, y)
-        loss.backward()
-        optimizer.step()
+        loss_fn = self._layers._loss_fn
+        if loss_fn is None:
+            raise ValueError(
+                "PipelineParallel.train_batch requires the PipelineLayer to "
+                "be built with loss_fn=... (labels are otherwise unused)")
+        n_micro = max(1, self._num_micro)
+        bsz = x.shape[0]
+        if bsz % n_micro != 0:
+            raise ValueError(f"batch size {bsz} not divisible by "
+                             f"accumulate_steps {n_micro}")
+        mb = bsz // n_micro
+        total = None
+        for i in range(n_micro):
+            xm, ym = x[i * mb:(i + 1) * mb], y[i * mb:(i + 1) * mb]
+            loss = loss_fn(self._layers(xm), ym) / n_micro
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return loss
+        return total

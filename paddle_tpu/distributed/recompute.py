@@ -15,10 +15,84 @@ from ..core.tensor import apply
 from ..jit import bind_tensors
 
 
+def _closure_params(function):
+    """Collect parameters of Layers reachable from a callable's closure /
+    partial args — no execution, so no RNG or BN-running-stat side effects.
+    Handles the common `lambda t: model(t)` / nested-def wrappers."""
+    import functools as _ft
+    from ..nn import Layer
+
+    objs, layers, seen = [], [], set()
+    fn = function
+    while isinstance(fn, _ft.partial):
+        objs.extend(fn.args)
+        objs.extend(fn.keywords.values())
+        fn = fn.func
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            objs.append(cell.cell_contents)
+        except ValueError:
+            pass
+
+    def visit(o, depth=0):
+        if id(o) in seen or depth > 2:
+            return
+        seen.add(id(o))
+        if isinstance(o, Layer):
+            layers.append(o)
+        elif isinstance(o, (list, tuple)):
+            for v in o:
+                visit(v, depth + 1)
+        elif isinstance(o, dict):
+            for v in o.values():
+                visit(v, depth + 1)
+
+    for o in objs:
+        visit(o)
+    params, pseen = [], set()
+    for layer in layers:
+        for p in layer.parameters():
+            if p is not None and id(p) not in pseen:
+                pseen.add(id(p))
+                params.append(p)
+    return params
+
+
+def _discover_params(function, args, kwargs, explicit_tensors):
+    """Fallback for callables whose layers are not visible in the closure:
+    find trainable leaves by running the callable once under a throwaway
+    tape (the eager analog of the reference PyLayer re-running arbitrary
+    callables with autograd on, `fleet/utils/recompute.py:130`). The RNG
+    stream is restored afterwards so dropout draws are not consumed; note
+    in-place buffer updates (BN running stats) would still apply twice —
+    prefer passing a Layer, bound method, or closure-visible model. Under
+    jit the discovery forward is dead code and XLA eliminates it."""
+    from ..core.random import default_generator
+
+    explicit = {id(t) for t in explicit_tensors}
+    seen, found = set(), []
+    gen = default_generator()
+    rng_state = gen.get_state()
+    try:
+        with autograd.fresh_tape():
+            function(*args, **kwargs)
+            for node in autograd.current_tape():
+                for inp in node.inputs:
+                    if (not inp.stop_gradient and not inp._has_producer
+                            and id(inp) not in explicit
+                            and id(inp) not in seen):
+                        seen.add(id(inp))
+                        found.append(inp)
+    finally:
+        gen.set_state(rng_state)
+    return found
+
+
 def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
               **kwargs):
-    """Run `function(*args)` under rematerialization. If `function` is a
-    Layer (or bound Layer method), its parameters are threaded as
+    """Run `function(*args)` under rematerialization. Parameters used by
+    `function` — whether it is a Layer, a bound Layer method, or an
+    arbitrary callable closing over layers — are threaded as
     differentiable inputs so their grads flow."""
     from ..nn import Layer
     layer = None
@@ -26,11 +100,16 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
         layer = function
     elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
         layer = function.__self__
-    params = [p for p in layer.parameters() if p is not None] if layer else []
 
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     tensor_args = [args[i] for i in tensor_idx]
     n_args = len(tensor_args)
+
+    if layer is not None:
+        params = [p for p in layer.parameters() if p is not None]
+    else:
+        params = _closure_params(function) or \
+            _discover_params(function, args, kwargs, tensor_args)
 
     def fn(*vals):
         arg_vals, pvals = vals[:n_args], vals[n_args:]

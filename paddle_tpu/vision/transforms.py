@@ -88,6 +88,7 @@ class RandomCrop(BaseTransform):
     def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
         self.size = _size_pair(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
 
     def _apply_image(self, img):
         h, w = self.size
@@ -98,6 +99,11 @@ class RandomCrop(BaseTransform):
                 [(0, 0)] * (img.ndim - 2)
             img = np.pad(img, pads)
         ih, iw = img.shape[:2]
+        if self.pad_if_needed and (ih < h or iw < w):
+            ph, pw = max(h - ih, 0), max(w - iw, 0)
+            pads = [(ph, ph), (pw, pw)] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads)
+            ih, iw = img.shape[:2]
         top = np.random.randint(0, max(ih - h, 0) + 1)
         left = np.random.randint(0, max(iw - w, 0) + 1)
         return img[top:top + h, left:left + w]
@@ -205,13 +211,101 @@ class BrightnessTransform(BaseTransform):
                        ).astype(img.dtype)
 
 
-class ColorJitter(BaseTransform):
-    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
-                 keys=None):
-        self.brightness = BrightnessTransform(brightness)
+def _clip_like(img, ref):
+    hi = 255 if np.issubdtype(ref.dtype, np.integer) else None
+    return np.clip(img, 0, hi).astype(ref.dtype)
+
+
+def _gray(img):
+    """Luminance of an HWC image (channels-last); grayscale passthrough."""
+    if img.ndim == 2 or img.shape[-1] == 1:
+        return img.astype(np.float32)
+    return (img[..., :3].astype(np.float32) @
+            np.asarray([0.299, 0.587, 0.114], np.float32))[..., None]
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
 
     def _apply_image(self, img):
-        return self.brightness._apply_image(img)
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = _gray(img).mean()
+        return _clip_like(mean + (img.astype(np.float32) - mean) * f, img)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0 or img.ndim == 2 or img.shape[-1] == 1:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = _gray(img)
+        return _clip_like(gray + (img.astype(np.float32) - gray) * f, img)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0 or img.ndim == 2 or img.shape[-1] == 1:
+            return img
+        shift = np.random.uniform(-self.value, self.value)
+        scale = 255.0 if np.issubdtype(img.dtype, np.integer) else 1.0
+        rgb = img[..., :3].astype(np.float32) / scale
+        maxc = rgb.max(-1)
+        minc = rgb.min(-1)
+        v = maxc
+        d = maxc - minc
+        s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+        dsafe = np.maximum(d, 1e-12)
+        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+        h = np.where(maxc == r, (g - b) / dsafe % 6,
+                     np.where(maxc == g, (b - r) / dsafe + 2,
+                              (r - g) / dsafe + 4)) / 6.0
+        h = np.where(d == 0, 0.0, h)
+        h = (h + shift) % 1.0
+        i = np.floor(h * 6.0)
+        f = h * 6.0 - i
+        p = v * (1 - s)
+        q = v * (1 - s * f)
+        t = v * (1 - s * (1 - f))
+        i = i.astype(np.int32) % 6
+        out = np.stack([
+            np.choose(i, [v, q, p, p, t, v]),
+            np.choose(i, [t, v, v, q, p, p]),
+            np.choose(i, [p, p, t, v, v, q]),
+        ], axis=-1) * scale
+        if img.shape[-1] > 3:  # preserve alpha/extra channels
+            out = np.concatenate(
+                [out, img[..., 3:].astype(np.float32)], axis=-1)
+        return _clip_like(out, img)
+
+
+class ColorJitter(BaseTransform):
+    """Randomly-ordered brightness/contrast/saturation/hue jitter
+    (reference `python/paddle/vision/transforms/transforms.py` ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self._transforms = [
+            BrightnessTransform(brightness),
+            ContrastTransform(contrast),
+            SaturationTransform(saturation),
+            HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self._transforms)):
+            img = self._transforms[i]._apply_image(img)
+        return img
 
 
 def to_tensor(pic, data_format="CHW"):

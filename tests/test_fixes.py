@@ -1,0 +1,156 @@
+"""Regression tests for review findings: MoE slot collision, recompute with
+arbitrary callables, pipeline train_batch accumulation, all_gather world
+group, sharded checkpoint restore, RandomCrop pad_if_needed, ColorJitter."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import env as dist_env
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    dist_env.clear_mesh()
+
+
+def test_moe_no_drop_matches_dense_top2():
+    """With capacity >> tokens, MoE output must equal the dense top-2
+    mixture — 1st/2nd-choice tokens of one expert must not collide."""
+    paddle.seed(11)
+    d, dff, E = 8, 16, 4
+    moe = dist.MoELayer(d_model=d, d_ff=dff, num_experts=E, k=2,
+                        capacity_factor=100.0)
+    x = paddle.randn([16, d])
+    out = moe(x).numpy()
+
+    xv = x.numpy()
+    wg = moe.w_gate.numpy()
+    wi = moe.w_in.numpy()
+    wo = moe.w_out.numpy()
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(xv @ wg), axis=-1))
+    top2 = np.argsort(-probs, axis=-1)[:, :2]
+    expect = np.zeros_like(xv)
+    for t in range(xv.shape[0]):
+        for e in top2[t]:
+            h = np.asarray(jax.nn.gelu(jnp.asarray(xv[t] @ wi[e])))
+            expect[t] += probs[t, e] * (h @ wo[e])
+    assert np.allclose(out, expect, atol=1e-4), np.abs(out - expect).max()
+
+
+def test_recompute_arbitrary_callable_grads():
+    """recompute(lambda, ...) must still produce parameter grads."""
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 8))
+    x = paddle.randn([4, 8])
+    out = model(x)
+    out.sum().backward()
+    g_plain = model[0].weight.grad.numpy().copy()
+    for p in model.parameters():
+        p.clear_grad()
+
+    out2 = dist.recompute(lambda t: model(t), x)
+    out2.sum().backward()
+    assert model[0].weight.grad is not None
+    assert np.allclose(model[0].weight.grad.numpy(), g_plain, atol=1e-5)
+
+
+def test_pipeline_train_batch_accumulation():
+    """train_batch with accumulate_steps=2 must equal one full-batch step."""
+    paddle.seed(9)
+    def build():
+        paddle.seed(9)
+        return dist.PipelineLayer(
+            [nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2)],
+            num_stages=1, loss_fn=lambda out, y: F.cross_entropy(out, y))
+
+    x = paddle.randn([8, 4])
+    y = paddle.randint(0, 2, [8])
+
+    m1 = build()
+    opt1 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=m1.parameters())
+    loss_full = F.cross_entropy(m1(x), y)
+    loss_full.backward()
+    opt1.step()
+    opt1.clear_grad()
+
+    m2 = build()
+    strategy = dist.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    pp = dist.PipelineParallel(m2, strategy=strategy)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=m2.parameters())
+    total = pp.train_batch((x, y), opt2)
+    assert np.allclose(total.item(), loss_full.item(), rtol=1e-4)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        assert np.allclose(p1.numpy(), p2.numpy(), atol=1e-5)
+
+
+def test_pipeline_train_batch_requires_loss_fn():
+    layer = dist.PipelineLayer([nn.Linear(4, 4)], num_stages=1)
+    pp = dist.PipelineParallel(layer)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    with pytest.raises(ValueError, match="loss_fn"):
+        pp.train_batch((paddle.randn([4, 4]), paddle.zeros([4])), opt)
+
+
+def test_all_gather_default_group_world_size():
+    lst = []
+    dist.all_gather(lst, paddle.ones([2]))
+    assert len(lst) == jax.device_count()
+
+
+def test_checkpoint_roundtrip_preserves_sharding(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mesh = dist.build_mesh(dp=8)
+    paddle.seed(3)
+    model = nn.Linear(16, 32)
+    model.weight.mesh_axes = (None, "dp")
+    dist.shard_model(model)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    step = dist.ShardedTrainStep(
+        model, lambda a, b: F.mse_loss(model(a), b), opt, zero_stage=1)
+    step(paddle.randn([8, 16]), paddle.randn([8, 32]))
+    w_before = model.weight.numpy().copy()
+    sh_before = model.weight._value.sharding
+
+    ck = dist.save_checkpoint(str(tmp_path / "ck"), model, opt,
+                              async_save=False)
+    # perturb then restore
+    model.weight.set_value(np.zeros_like(w_before))
+    dist.load_checkpoint(str(tmp_path / "ck"), model, opt)
+    assert np.allclose(model.weight.numpy(), w_before)
+    assert model.weight._value.sharding.spec == sh_before.spec
+
+
+def test_random_crop_pad_if_needed():
+    from paddle_tpu.vision import transforms as T
+    img = np.random.randint(0, 255, (32, 32, 3), np.uint8)
+    out = T.RandomCrop(40, pad_if_needed=True)._apply_image(img)
+    assert out.shape == (40, 40, 3)
+    out2 = T.RandomCrop(16)._apply_image(img)
+    assert out2.shape == (16, 16, 3)
+
+
+def test_color_jitter_full():
+    from paddle_tpu.vision import transforms as T
+    img = np.random.randint(0, 255, (16, 16, 3), np.uint8)
+    jit = T.ColorJitter(0.4, 0.4, 0.4, 0.1)
+    out = jit._apply_image(img)
+    assert out.shape == img.shape and out.dtype == img.dtype
+    # each component transform actually changes the image
+    for tr in (T.ContrastTransform(0.9), T.SaturationTransform(0.9),
+               T.HueTransform(0.5)):
+        o = tr._apply_image(img)
+        assert o.shape == img.shape
+        assert not np.array_equal(o, img)
+    # hue with value 0 is identity
+    assert np.array_equal(T.HueTransform(0)._apply_image(img), img)
