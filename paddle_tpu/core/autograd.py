@@ -92,6 +92,11 @@ def current_tape():
     return _state.nodes
 
 
+def truncate_tape(size):
+    """Drop nodes recorded after `size` (a tape_size() snapshot)."""
+    del _state.nodes[size:]
+
+
 @contextlib.contextmanager
 def fresh_tape():
     """Push a fresh tape (used when tracing a compiled step so recorded nodes

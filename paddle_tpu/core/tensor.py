@@ -257,6 +257,24 @@ class Parameter(Tensor):
 # eager dispatch
 # ---------------------------------------------------------------------------
 
+# static-graph capture: when a recorder is pushed (paddle_tpu.static
+# program_guard), every apply() also logs a replayable forward op — the
+# ProgramDesc analog (reference `framework.proto:225`)
+_capture_stack = []
+
+
+def push_capture(recorder):
+    _capture_stack.append(recorder)
+
+
+def pop_capture():
+    return _capture_stack.pop()
+
+
+def active_capture():
+    return _capture_stack[-1] if _capture_stack else None
+
+
 def apply(fn, *tensors):
     """Run `fn` over the raw values of `tensors`; record vjp on the tape when
     gradient is required. fn takes/returns jax values (single or tuple)."""
@@ -272,6 +290,8 @@ def apply(fn, *tensors):
     wrapped = [Tensor(o, stop_gradient=not requires) for o in out_list]
     if requires:
         autograd.record(autograd.Node(tensors, tuple(wrapped), vjp_fn, multi))
+    if _capture_stack:
+        _capture_stack[-1].record_op(fn, tensors, tuple(wrapped), multi)
     return wrapped if multi else wrapped[0]
 
 

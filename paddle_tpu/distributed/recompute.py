@@ -108,8 +108,14 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
     if layer is not None:
         params = [p for p in layer.parameters() if p is not None]
     else:
-        params = _closure_params(function) or \
-            _discover_params(function, args, kwargs, tensor_args)
+        # union: closure inspection catches the common cases cheaply, the
+        # tape discovery pass catches layers it cannot see (globals,
+        # deeply nested containers) — grads must never silently drop
+        params = _closure_params(function)
+        known = {id(p) for p in params}
+        for p in _discover_params(function, args, kwargs, tensor_args):
+            if id(p) not in known:
+                params.append(p)
 
     def fn(*vals):
         arg_vals, pvals = vals[:n_args], vals[n_args:]

@@ -1,0 +1,13 @@
+"""paddle_tpu.hapi — the high-level training API.
+
+Parity: `python/paddle/hapi/` (`Model hapi/model.py:876`, `fit:1521`,
+callbacks `hapi/callbacks.py`, `summary hapi/summary.py`). TPU-native: the
+Model wraps the fused jitted TrainStep, so `fit` runs one XLA program per
+step instead of the reference's per-mode dygraph/static adapters
+(`model.py:247,657`).
+"""
+from .model import Model  # noqa: F401
+from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint,  # noqa: F401
+                        EarlyStopping, LRScheduler, ReduceLROnPlateau,
+                        VisualDL)
+from .summary import summary  # noqa: F401

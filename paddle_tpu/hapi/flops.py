@@ -1,0 +1,59 @@
+"""FLOPs estimation (reference `python/paddle/hapi/dynamic_flops.py`):
+per-layer multiply-add counts via hooked dry-run forward."""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+
+
+def _linear_flops(layer, inp, out):
+    return int(np.prod(inp.shape)) * layer.weight.shape[-1]
+
+
+def _conv_flops(layer, inp, out):
+    kh_kw_cin = int(np.prod(layer.weight.shape[1:]))
+    return int(np.prod(out.shape)) * kh_kw_cin
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total forward multiply-accumulate count for one input of
+    `input_size`."""
+    from ..nn.layer.layers import Layer
+    from ..nn import Linear, Conv2D
+
+    custom_ops = custom_ops or {}
+    total = [0]
+    hooks = []
+
+    def make_hook(layer):
+        def hook(lyr, inp, out):
+            inp0 = inp[0] if isinstance(inp, (list, tuple)) else inp
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            fn = custom_ops.get(type(lyr))
+            if fn is not None:
+                total[0] += int(fn(lyr, inp0, out0))
+            elif isinstance(lyr, Linear):
+                total[0] += _linear_flops(lyr, inp0, out0)
+            elif isinstance(lyr, Conv2D):
+                total[0] += _conv_flops(lyr, inp0, out0)
+        return hook
+
+    for _, layer in net.named_sublayers():
+        if not list(layer.children()):
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+
+    shape = tuple(1 if d in (None, -1) else int(d) for d in input_size)
+    x = Tensor(np.random.rand(*shape).astype(np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        with autograd.no_grad():
+            net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs (MACs): {total[0]:,}")
+    return total[0]

@@ -1,0 +1,290 @@
+"""High-level Model API.
+
+Parity: `python/paddle/hapi/model.py:876` (Model), `fit:1521`, evaluate,
+predict, save/load, train_batch/eval_batch. TPU-native: a single fused
+jitted TrainStep replaces the reference's dual dygraph/static adapters
+(`hapi/model.py:247,657`) — one code path, one XLA program per step.
+"""
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import TrainStep
+from ..io.dataloader import DataLoader, Dataset
+from ..io import serialization
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _metric_items(m):
+    """paddle Metric.name()/accumulate() may return lists — zip them."""
+    names = m.name()
+    names = names if isinstance(names, (list, tuple)) else [names]
+    vals = m.accumulate()
+    vals = vals if isinstance(vals, (list, tuple)) else [vals]
+    return list(zip(names, vals))
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class Model:
+    """`Model(network)` then `prepare(optimizer, loss, metrics)` then
+    `fit/evaluate/predict`. inputs/labels InputSpecs are accepted for API
+    parity and used for `save(training=False)` export."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs_spec = _as_tuple(inputs)
+        self._labels_spec = _as_tuple(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        self._metrics = list(metrics) if isinstance(
+            metrics, (list, tuple)) else [metrics]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle Metric")
+        self._train_step = None
+
+    def _split_batch(self, batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if self._loss is None or len(batch) == 1:
+            return batch, []
+        n_lab = max(1, len(self._labels_spec)) if self._labels_spec else 1
+        return batch[:-n_lab], batch[-n_lab:]
+
+    def _loss_value(self, outputs, labels):
+        outs = _as_tuple(outputs)
+        loss = self._loss(*outs, *labels)
+        if isinstance(loss, (list, tuple)):
+            loss = sum(loss[1:], loss[0])
+        return loss
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True,
+                    loss_scale=1.0):
+        """One training step. update=True runs the fused jitted
+        fwd+bwd+update program; update=False accumulates grads eagerly
+        (loss scaled by `loss_scale`) for gradient accumulation."""
+        inputs = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+                  for t in _as_tuple(inputs)]
+        labels = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+                  for t in _as_tuple(labels)]
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss) before "
+                               "train_batch")
+        n_in = len(inputs)
+
+        if not update or loss_scale != 1.0:
+            # eager accumulate path: grads sum into .grad across calls;
+            # the optimizer steps only when update=True
+            outs = self.network(*inputs)
+            loss = self._loss_value(outs, labels)
+            if loss_scale != 1.0:
+                loss = loss * loss_scale
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            return [loss.numpy()]
+
+        if self._train_step is None:
+            self._n_in = n_in
+
+            def loss_fn(*batch):
+                outs = self.network(*batch[:self._n_in])
+                return self._loss_value(outs, list(batch[self._n_in:]))
+
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer)
+        loss = self._train_step(*inputs, *labels)
+        return [loss.numpy()]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core import autograd
+        inputs = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+                  for t in _as_tuple(inputs)]
+        labels = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+                  for t in _as_tuple(labels)]
+        self.network.eval()
+        try:
+            with autograd.no_grad():
+                outs = self.network(*inputs)
+                metrics = {}
+                if self._loss is not None and labels:
+                    loss = self._loss_value(outs, labels)
+                    metrics["loss"] = loss.numpy()
+                for m in self._metrics:
+                    res = m.compute(*_as_tuple(outs), *labels)
+                    m.update(*[np.asarray(r.numpy() if isinstance(r, Tensor)
+                                          else r) for r in _as_tuple(res)])
+                    metrics.update(_metric_items(m))
+        finally:
+            self.network.train()
+        return metrics
+
+    def predict_batch(self, inputs):
+        from ..core import autograd
+        inputs = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+                  for t in _as_tuple(inputs)]
+        self.network.eval()
+        try:
+            with autograd.no_grad():
+                outs = self.network(*inputs)
+        finally:
+            self.network.train()
+        return [o.numpy() for o in _as_tuple(outs)]
+
+    # ------------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._loader(train_data, batch_size, shuffle)
+        eval_loader = self._loader(eval_data, batch_size, False)
+        cbks = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
+            cbks.insert(0, ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbks, model=self,
+                              params={"epochs": epochs, "steps": steps,
+                                      "verbose": verbose})
+        self.stop_training = False
+        cblist.on_train_begin()
+        history = []
+        it_count = 0
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            self.network.train()
+            logs = {}
+            accum = max(1, accumulate_grad_batches)
+            for step, batch in enumerate(train_loader):
+                cblist.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                if accum > 1:
+                    loss = self.train_batch(
+                        inputs, labels, update=(step + 1) % accum == 0,
+                        loss_scale=1.0 / accum)
+                else:
+                    loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                cblist.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch % eval_freq == 0 or
+                                            epoch == epochs - 1):
+                eval_logs = self._run_eval(eval_loader, cblist)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cblist.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if self.stop_training:
+                break
+        cblist.on_train_end(logs if history else {})
+        return history
+
+    def _run_eval(self, eval_loader, cblist):
+        cblist.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(eval_loader):
+            cblist.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            metrics = self.eval_batch(inputs, labels)
+            if "loss" in metrics:
+                losses.append(np.ravel(metrics["loss"])[0])
+            cblist.on_eval_batch_end(step, metrics)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs.update(_metric_items(m))
+        cblist.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False)
+        cblist = CallbackList(callbacks or [], model=self, params={})
+        return self._run_eval(loader, cblist)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False)
+        outputs = None
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs = self.predict_batch(batch)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for acc, o in zip(outputs, outs):
+                acc.append(o)
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(o, axis=0) for o in outputs]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """training=True: params (+ opt state) for resume; training=False:
+        inference export (reference `hapi/model.py` save semantics)."""
+        if not training:
+            from ..inference.export import save_inference_model
+            spec = list(self._inputs_spec) or None
+            save_inference_model(path, self.network, input_spec=spec)
+            return
+        dirname = os.path.dirname(os.path.abspath(path))
+        os.makedirs(dirname, exist_ok=True)
+        serialization.save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            serialization.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = serialization.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if (self._optimizer is not None and not reset_optimizer and
+                os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(serialization.load(opt_path))
+        self._train_step = None
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        if input_size is None and self._inputs_spec:
+            input_size = [tuple(s.shape) for s in self._inputs_spec]
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,77 @@
+"""Model summary (reference `python/paddle/hapi/summary.py`): per-layer
+output shapes and parameter counts via a hooked dry-run forward."""
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+from ..core import autograd
+
+
+def summary(net, input_size, dtypes=None, input=None):
+    from ..nn.layer.layers import Layer
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        sizes = [s if isinstance(s, (list, tuple)) else (s,) for s in sizes]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(sizes)
+        inputs = []
+        for s, dt in zip(sizes, dts):
+            shape = tuple(1 if d in (None, -1) else int(d) for d in s)
+            if "int" in str(dt):
+                inputs.append(Tensor(np.zeros(shape, dtype=str(dt))))
+            else:
+                inputs.append(Tensor(np.random.rand(*shape).astype(str(dt))))
+    else:
+        inputs = [input] if isinstance(input, Tensor) else list(input)
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inp, out):
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            shape = [list(o.shape) for o in outs
+                     if isinstance(o, Tensor)]
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         shape[0] if len(shape) == 1 else shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not list(layer.children()):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with autograd.no_grad():
+            net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    w = 30
+    lines = ["-" * (w * 3),
+             f"{'Layer (type)':<{w}}{'Output Shape':<{w}}{'Param #':<{w}}",
+             "=" * (w * 3)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{w}}{str(shape):<{w}}{n:<{w}}")
+    lines += ["=" * (w * 3),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * (w * 3)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

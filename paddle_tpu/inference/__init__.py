@@ -1,0 +1,16 @@
+"""paddle_tpu.inference — the deployment path.
+
+TPU-native replacement for the reference inference engine
+(`paddle/fluid/inference/api/analysis_predictor.cc:172,674,973` and the
+`paddle.inference` python wrapper): instead of a saved ProgramDesc run by a
+NaiveExecutor after IR fusion passes, the exported artifact is a serialized
+StableHLO module (`jax.export`) with the parameters baked in as constants —
+XLA already performs the fusions the reference's 40+ analysis passes
+hand-code, so the "optimization pipeline" is the compiler itself. The
+Predictor API mirrors paddle.inference (Config / create_predictor /
+input-output handles) so serving code ports unchanged.
+"""
+from .export import (save_inference_model, load_inference_model,  # noqa: F401
+                     ExportedModel)
+from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
+                        PredictorHandle)

@@ -1,0 +1,134 @@
+"""Model export to serialized StableHLO.
+
+Reference analog: `save_inference_model` (`python/paddle/fluid/io.py:1246` —
+prunes the program to the inference subgraph and saves program+params) and
+`paddle.jit.save` (`fluid/dygraph/jit.py:529`). Here the traced forward IS
+the program: parameters are closed over as constants, the function is
+exported with `jax.export` (optionally with a symbolic batch dimension), and
+the artifact is two files: `<path>.stablehlo` (serialized module) and
+`<path>.json` (io signature metadata).
+"""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jexport
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..core.dtype import convert_dtype
+from ..jit import InputSpec, bind_tensors
+
+
+def _specs_from(layer, input_spec, example_inputs):
+    if input_spec is not None:
+        specs = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                specs.append(s)
+            elif isinstance(s, Tensor):
+                specs.append(InputSpec(s.shape, str(s.dtype)))
+            else:
+                raise TypeError(f"unsupported input_spec entry {s!r}")
+        return specs
+    if example_inputs is not None:
+        return [InputSpec(t.shape, str(t.dtype)) for t in example_inputs]
+    raise ValueError("provide input_spec or example inputs to export")
+
+
+def _shape_dtype(spec, scope, idx):
+    """ShapeDtypeStruct from an InputSpec; None/-1 dims become symbolic
+    (shared SymbolicScope so equal-named dims unify across inputs)."""
+    dims = [f"b{idx}_{i}" if d is None or d == -1 else d
+            for i, d in enumerate(spec.shape)]
+    if any(isinstance(d, str) for d in dims):
+        if scope[0] is None:
+            scope[0] = jexport.SymbolicScope()
+        shape = jexport.symbolic_shape(
+            ",".join(str(d) for d in dims), scope=scope[0])
+        return jax.ShapeDtypeStruct(shape, convert_dtype(spec.dtype))
+    return jax.ShapeDtypeStruct(tuple(dims), convert_dtype(spec.dtype))
+
+
+class ExportedModel:
+    """A loaded inference module: callable, shape-checked, jit-cached."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self._meta = meta
+        self._call = jax.jit(exported.call)
+
+    @property
+    def input_names(self):
+        return list(self._meta["inputs"].keys())
+
+    @property
+    def output_names(self):
+        return list(self._meta["outputs"].keys())
+
+    def __call__(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._call(*vals)
+        if isinstance(out, (list, tuple)):
+            return [Tensor(o) for o in out]
+        return Tensor(out)
+
+
+def save_inference_model(path, layer, input_spec=None, example_inputs=None,
+                         **configs):
+    """Export `layer`'s forward (params baked in) for serving."""
+    from ..nn.layer.layers import Layer
+    if not isinstance(layer, Layer):
+        raise TypeError("save_inference_model expects a Layer")
+    specs = _specs_from(layer, input_spec, example_inputs)
+    params = [p for _, p in layer.named_parameters()]
+    buffers = [b for _, b in layer.named_buffers() if b is not None]
+    param_vals = [p._value for p in params]
+    buffer_vals = [b._value for b in buffers]
+    was_training = layer.training
+    layer.eval()
+    try:
+        def fn(*arg_vals):
+            with autograd.fresh_tape(), autograd.no_grad(), \
+                    bind_tensors(params, param_vals), \
+                    bind_tensors(buffers, buffer_vals):
+                out = layer(*[Tensor(v) for v in arg_vals])
+            if isinstance(out, (list, tuple)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value if isinstance(out, Tensor) else out
+
+        scope = [None]
+        in_shapes = [_shape_dtype(s, scope, i) for i, s in enumerate(specs)]
+        exported = jexport.export(jax.jit(fn))(*in_shapes)
+    finally:
+        if was_training:
+            layer.train()
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    meta = {
+        "inputs": {f"x{i}": {"shape": [d if isinstance(d, int) else -1
+                                       for d in s.shape],
+                             "dtype": str(s.dtype)}
+                   for i, s in enumerate(specs)},
+        "outputs": {f"out{i}": {} for i in range(len(exported.out_avals))},
+        "format": "stablehlo",
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+def load_inference_model(path, **configs):
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    meta = {"inputs": {}, "outputs": {}}
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            meta = json.load(f)
+    return ExportedModel(exported, meta)

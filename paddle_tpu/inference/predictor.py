@@ -1,0 +1,116 @@
+"""paddle.inference-compatible serving API.
+
+Reference analog: `AnalysisConfig` + `AnalysisPredictor`
+(`paddle/fluid/inference/api/analysis_predictor.cc:973` ZeroCopyRun and the
+python wrapper `python/paddle/inference/__init__.py`). The handle-based
+zero-copy surface is preserved (get_input_handle / copy_from_cpu / run /
+copy_to_cpu); the engine underneath is the XLA-compiled StableHLO module, so
+config knobs that select the reference's GPU/TensorRT/MKLDNN backends are
+accepted for compatibility and ignored.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .export import load_inference_model
+
+
+class Config:
+    """AnalysisConfig analog. `Config(model_path)` points at the artifact
+    written by save_inference_model (without extension)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self.model_path = prog_file
+        self._params_file = params_file
+        self._use_tpu = True
+        self._memory_pool_mb = 0
+
+    # --- compatibility switches (engine selection is XLA's job) ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def enable_tensorrt_engine(self, **kwargs):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def model_dir(self):
+        return self.model_path
+
+
+class PredictorHandle:
+    """Zero-copy input/output handle (ZeroCopyTensor analog)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shape comes from the copied array
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    def __init__(self, config):
+        if isinstance(config, str):
+            config = Config(config)
+        self._config = config
+        self._model = load_inference_model(config.model_path)
+        self._inputs = {n: PredictorHandle(n) for n in self._model.input_names}
+        self._outputs = {n: PredictorHandle(n)
+                         for n in self._model.output_names}
+
+    def get_input_names(self):
+        return list(self._inputs.keys())
+
+    def get_output_names(self):
+        return list(self._outputs.keys())
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Handle-protocol run; also accepts a list of numpy arrays and
+        returns numpy outputs (the newer paddle.inference convenience)."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(a)
+        args = [self._inputs[n]._value for n in self._inputs]
+        if any(a is None for a in args):
+            missing = [n for n in self._inputs
+                       if self._inputs[n]._value is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._model(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for h, o in zip(self._outputs.values(), outs):
+            h._value = o._value
+        if inputs is not None:
+            return [np.asarray(o._value) for o in outs]
+        return None
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -189,6 +189,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core.tensor import active_capture
+        recorder = active_capture()
+        if recorder is not None and hasattr(recorder, "add_train_hook"):
+            # static build (reference: minimize appends backward+update ops
+            # into the program, `backward.py:1390`); executed per
+            # Executor.run, not at build time
+            recorder.add_train_hook(self, loss)
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]

@@ -1,0 +1,263 @@
+"""paddle_tpu.static — static-graph API parity.
+
+Reference: `python/paddle/static/` over fluid's Program/Executor world
+(`framework.py:4307` Program, `executor.py:606,1055` Executor.run,
+`backward.py:1390` append_backward). TPU-native design: there is no second
+execution engine — building "static" ops just runs the same eager ops while
+a Program recorder captures each `apply` as a replayable forward node (the
+ProgramDesc analog). `Executor.run` re-binds the feed into the placeholder
+tensors, replays the nodes in place (re-taping them so autograd works), then
+runs any `optimizer.minimize` hooks recorded at build time. `CompiledProgram`
+jit-compiles the same replay into one fused XLA program.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core import tensor as core_tensor
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from ..inference.export import (save_inference_model,  # noqa: F401
+                                load_inference_model)
+from . import nn  # noqa: F401
+
+
+class _ProgramOp:
+    __slots__ = ("fn", "inputs", "outputs", "multi")
+
+    def __init__(self, fn, inputs, outputs, multi):
+        self.fn = fn
+        self.inputs = inputs
+        self.outputs = outputs
+        self.multi = multi
+
+
+class Program:
+    """Recorded forward ops + feed placeholders + train hooks."""
+
+    def __init__(self):
+        self.ops = []
+        self.placeholders = {}
+        self.train_hooks = []  # [(optimizer, loss_tensor)]
+        self.random_seed = None
+
+    # recorder protocol (core.tensor capture)
+    def record_op(self, fn, inputs, outputs, multi):
+        self.ops.append(_ProgramOp(fn, inputs, outputs, multi))
+
+    def add_train_hook(self, optimizer, loss):
+        self.train_hooks.append((optimizer, loss))
+
+    def add_placeholder(self, name, t):
+        self.placeholders[name] = t
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.placeholders = dict(self.placeholders)
+        if not for_test:
+            p.train_hooks = list(self.train_hooks)
+        return p
+
+    def list_vars(self):
+        seen, out = set(), []
+        for op in self.ops:
+            for t in list(op.inputs) + list(op.outputs):
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    def all_parameters(self):
+        """Trainable leaf tensors (reference Program.all_parameters) — the
+        default parameter list for optimizers built in pure static.nn
+        flows."""
+        return [t for t in self.list_vars()
+                if not t.stop_gradient and not t._has_producer]
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, "
+                f"placeholders={list(self.placeholders)}, "
+                f"train_hooks={len(self.train_hooks)})")
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    core_tensor.push_capture(main_program)
+    try:
+        yield
+    finally:
+        core_tensor.pop_capture()
+        _default_main, _default_startup = prev
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference `static/input.py` paddle.static.data).
+    Holds zeros until Executor.run binds the feed."""
+    from ..core.dtype import convert_dtype
+    concrete = tuple(1 if d in (None, -1) else int(d) for d in shape)
+    t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)), stop_gradient=True)
+    t.name = name
+    t._is_placeholder = True
+    prog = core_tensor.active_capture() or _default_main
+    prog.add_placeholder(name, t)
+    return t
+
+
+class Executor:
+    """Replays a Program (reference `executor.py:1055` Executor.run — the
+    op loop `framework/executor.cc:485` becomes an in-place node replay)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if program is None:
+            program = _default_main
+        if not isinstance(program, Program):
+            raise TypeError(f"not a static Program: {program!r}")
+        feed = feed or {}
+        for name, val in feed.items():
+            t = program.placeholders.get(name)
+            if t is None:
+                raise KeyError(
+                    f"feed '{name}' is not a placeholder of this program "
+                    f"(have {list(program.placeholders)})")
+            t._value = jnp.asarray(val).astype(t._value.dtype)
+
+        # replay only re-tapes when there are train hooks to backprop;
+        # pure-inference replays skip the vjp cost entirely
+        taping = bool(program.train_hooks) and autograd.grad_enabled()
+        tape_mark = autograd.tape_size()
+        for op in program.ops:
+            vals = tuple(t._value for t in op.inputs)
+            requires = taping and any(
+                not t.stop_gradient for t in op.inputs)
+            if requires:
+                outs, vjp_fn = jax.vjp(op.fn, *vals)
+            else:
+                outs = op.fn(*vals)
+            out_list = list(outs) if op.multi else [outs]
+            for t, v in zip(op.outputs, out_list):
+                t._value = v
+                t.grad = None
+            if requires:
+                autograd.record(autograd.Node(op.inputs, op.outputs,
+                                              vjp_fn, op.multi))
+
+        for optimizer, loss in program.train_hooks:
+            if optimizer._parameter_list is None:
+                # parameterless optimizer (standard static style): train
+                # every trainable leaf of the program
+                optimizer._parameter_list = program.all_parameters()
+            loss.backward(retain_graph=True)
+            optimizer._apply_params_grads(
+                [(p, p.grad) for p in optimizer._parameter_list
+                 if not p.stop_gradient and p.grad is not None])
+            optimizer.clear_grad()
+        # drop only the nodes this replay recorded — a caller's in-flight
+        # eager graph on the same tape stays intact
+        autograd.truncate_tape(tape_mark)
+
+        if fetch_list is None:
+            return []
+        outs = []
+        for f in fetch_list:
+            t = program.placeholders.get(f) if isinstance(f, str) else f
+            if not isinstance(t, Tensor):
+                raise TypeError(f"cannot fetch {f!r}")
+            outs.append(np.asarray(t._value) if return_numpy else t)
+        return outs
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """Fused-XLA execution of a recorded Program (the ParallelExecutor /
+    BuildStrategy analog — here simply one jit over the replay)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self._jit_cache = {}
+        self._leaves = None
+
+    def _build(self, feed_names):
+        program = self.program
+
+        if self._leaves is None:
+            # leaf inputs: tensors consumed before being produced
+            produced, leaves = set(), []
+            ph_ids = {id(t) for t in program.placeholders.values()}
+            for op in program.ops:
+                for t in op.inputs:
+                    if id(t) not in produced and id(t) not in ph_ids and \
+                            not any(t is l for l in leaves):
+                        leaves.append(t)
+                for t in op.outputs:
+                    produced.add(id(t))
+            self._leaves = leaves
+        leaves = self._leaves
+
+        def replay(feed_vals, leaf_vals, fetch_ids):
+            env = {}
+            for name, v in zip(feed_names, feed_vals):
+                env[id(program.placeholders[name])] = v
+            for t, v in zip(leaves, leaf_vals):
+                env[id(t)] = v
+            for op in program.ops:
+                vals = tuple(env.get(id(t), t._value) for t in op.inputs)
+                outs = op.fn(*vals)
+                out_list = list(outs) if op.multi else [outs]
+                for t, v in zip(op.outputs, out_list):
+                    env[id(t)] = v
+            return [env[i] for i in fetch_ids]
+
+        return replay
+
+    def run(self, feed, fetch_list):
+        feed_names = sorted(feed)
+        fetch_ids = tuple(id(t) for t in fetch_list)
+        key = (tuple(feed_names), fetch_ids)
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            replay = self._build(feed_names)
+            jitted = jax.jit(lambda fv, lv: replay(fv, lv, fetch_ids))
+            self._jit_cache[key] = jitted
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+        leaf_vals = [t._value for t in self._leaves]
+        return [np.asarray(v) for v in jitted(feed_vals, leaf_vals)]
+
+
+# re-exported conveniences (paddle.static namespace surface)
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+class WeightNormParamAttr:
+    def __init__(self, *a, **k):
+        pass

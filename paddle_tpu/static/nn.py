@@ -1,0 +1,56 @@
+"""paddle.static.nn op-builders (reference `python/paddle/static/nn/` over
+`fluid/layers/nn.py`): thin wrappers that create the corresponding Layer and
+apply it, so legacy static model code builds under program_guard."""
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..nn import Linear
+    from ..nn import functional as F
+    from ..tensor.manipulation import reshape
+    import numpy as np
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    if len(x.shape) > num_flatten_dims + 1:
+        x = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    layer = Linear(in_dim, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    from ..nn import Conv2D
+    from ..nn import functional as F
+    layer = Conv2D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from ..nn import BatchNorm2D
+    from ..nn import functional as F
+    layer = BatchNorm2D(input.shape[1], momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)

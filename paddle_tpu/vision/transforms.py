@@ -224,6 +224,15 @@ def _gray(img):
             np.asarray([0.299, 0.587, 0.114], np.float32))[..., None]
 
 
+def _blend_rgb(img, fn):
+    """Apply fn to the RGB channels only, passing alpha/extras through."""
+    if img.ndim == 2 or img.shape[-1] <= 3:
+        return _clip_like(fn(img.astype(np.float32)), img)
+    out = fn(img[..., :3].astype(np.float32))
+    out = np.concatenate([out, img[..., 3:].astype(np.float32)], axis=-1)
+    return _clip_like(out, img)
+
+
 class ContrastTransform(BaseTransform):
     def __init__(self, value, keys=None):
         self.value = value
@@ -233,7 +242,7 @@ class ContrastTransform(BaseTransform):
             return img
         f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         mean = _gray(img).mean()
-        return _clip_like(mean + (img.astype(np.float32) - mean) * f, img)
+        return _blend_rgb(img, lambda rgb: mean + (rgb - mean) * f)
 
 
 class SaturationTransform(BaseTransform):
@@ -245,7 +254,7 @@ class SaturationTransform(BaseTransform):
             return img
         f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         gray = _gray(img)
-        return _clip_like(gray + (img.astype(np.float32) - gray) * f, img)
+        return _blend_rgb(img, lambda rgb: gray + (rgb - gray) * f)
 
 
 class HueTransform(BaseTransform):

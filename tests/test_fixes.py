@@ -154,3 +154,27 @@ def test_color_jitter_full():
         assert not np.array_equal(o, img)
     # hue with value 0 is identity
     assert np.array_equal(T.HueTransform(0)._apply_image(img), img)
+
+
+def test_recompute_global_layer_grads():
+    """Layers invisible to closure inspection (module-level) still get
+    grads via the tape-discovery union."""
+    import tests.test_fixes as self_mod
+    paddle.seed(6)
+    self_mod._GLOBAL_HEAD = nn.Linear(8, 8)
+    enc = nn.Linear(8, 8)
+    x = paddle.randn([4, 8])
+    out = dist.recompute(lambda t: self_mod._GLOBAL_HEAD(enc(t)), x)
+    out.sum().backward()
+    assert enc.weight.grad is not None
+    assert self_mod._GLOBAL_HEAD.weight.grad is not None
+
+
+def test_contrast_saturation_preserve_alpha():
+    from paddle_tpu.vision import transforms as T
+    img = np.random.randint(0, 255, (8, 8, 4), np.uint8)
+    img[..., 3] = 255
+    for tr in (T.ContrastTransform(0.9), T.SaturationTransform(0.9)):
+        o = tr._apply_image(img)
+        assert o.shape == (8, 8, 4)
+        assert (o[..., 3] == 255).all(), type(tr).__name__

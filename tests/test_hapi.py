@@ -1,0 +1,136 @@
+"""hapi Model tests (reference `python/paddle/tests/test_model.py` pattern:
+fit/evaluate/predict on a tiny dataset, checkpoint callbacks, summary)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, hapi
+from paddle_tpu.io.dataloader import Dataset
+
+
+class _ToyDataset(Dataset):
+    def __init__(self, n=64, c=4):
+        rs = np.random.RandomState(0)
+        self.x = rs.randn(n, 8).astype(np.float32)
+        w = rs.randn(8, c)
+        self.y = np.argmax(self.x @ w, axis=1).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    m = hapi.Model(net)
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters()),
+              nn.CrossEntropyLoss(),
+              paddle.metric.Accuracy())
+    return m
+
+
+def test_fit_learns_and_evaluates():
+    m = _model()
+    ds = _ToyDataset()
+    hist = m.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0)
+    assert len(hist) == 10
+    final = m.evaluate(ds, batch_size=16, verbose=0)
+    assert final["acc"] > 0.9, final
+    assert final["loss"] < 0.5
+
+
+def test_predict_shapes():
+    m = _model()
+    ds = _ToyDataset(n=20)
+    outs = m.predict([(ds.x[:10],)], stack_outputs=True)
+    assert outs[0].shape == (10, 4)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = _model()
+    ds = _ToyDataset()
+    m.fit(ds, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ck" / "model")
+    m.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+    ref = m.predict_batch([paddle.to_tensor(ds.x[:4])])[0]
+
+    m2 = _model()
+    m2.load(path)
+    got = m2.predict_batch([paddle.to_tensor(ds.x[:4])])[0]
+    assert np.allclose(got, ref, atol=1e-6)
+
+
+def test_save_inference(tmp_path):
+    m = _model()
+    path = str(tmp_path / "infer" / "model")
+    m._inputs_spec = (paddle.jit.InputSpec([None, 8], "float32"),)
+    m.save(path, training=False)
+    assert os.path.exists(path + ".stablehlo")
+    loaded = paddle.jit.load(path)
+    x = paddle.randn([3, 8])
+    assert np.allclose(loaded(x).numpy(),
+                       m.predict_batch([x])[0], atol=1e-5)
+
+
+def test_early_stopping():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 4))
+    m = hapi.Model(net)
+    # lr=0: loss can never improve, so patience=1 stops at epoch 2
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    ds = _ToyDataset()
+    es = hapi.EarlyStopping(monitor="loss", patience=1, mode="min")
+    hist = m.fit(ds, eval_data=ds, batch_size=16, epochs=50, verbose=0,
+                 callbacks=[es])
+    assert len(hist) <= 3
+    assert es.stop_training
+
+
+def test_summary(capsys):
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    info = hapi.summary(net, (1, 8))
+    out = capsys.readouterr().out
+    assert "Linear" in out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accumulate_grad_batches=2 over half-batches == one full-batch step."""
+    ds = _ToyDataset(n=32)
+
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m = hapi.Model(net)
+        m.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        return m
+
+    m1 = build()
+    m1.fit([(ds.x, ds.y)], batch_size=32, epochs=1, verbose=0)
+
+    m2 = build()
+    m2.fit([(ds.x[:16], ds.y[:16]), (ds.x[16:], ds.y[16:])],
+           batch_size=16, epochs=1, verbose=0, accumulate_grad_batches=2)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        assert np.allclose(p1.numpy(), p2.numpy(), atol=1e-5)
+
+
+def test_train_batch_update_false_accumulates():
+    m = _model()
+    ds = _ToyDataset(n=16)
+    w0 = m.network[0].weight.numpy().copy()
+    m.train_batch([ds.x], [ds.y], update=False)
+    assert np.array_equal(m.network[0].weight.numpy(), w0)  # no step
+    assert m.network[0].weight.grad is not None

@@ -1,0 +1,87 @@
+"""Inference export/predictor tests (reference pattern:
+`test_inference_model_io.py` + `analysis_predictor_tester.cc`)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _mlp():
+    paddle.seed(4)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    model = _mlp()
+    x = paddle.randn([3, 8])
+    ref = model(x).numpy()
+    p = str(tmp_path / "mlp")
+    paddle.jit.save(model, p,
+                    input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+    assert os.path.exists(p + ".stablehlo")
+    m2 = paddle.jit.load(p)
+    assert np.allclose(m2(x).numpy(), ref, atol=1e-5)
+    # symbolic batch: different size works without re-export
+    y = m2(paddle.randn([7, 8]))
+    assert y.shape == [7, 4]
+
+
+def test_static_shape_export(tmp_path):
+    model = _mlp()
+    p = str(tmp_path / "mlp_static")
+    from paddle_tpu.inference import save_inference_model, load_inference_model
+    save_inference_model(p, model,
+                         input_spec=[paddle.jit.InputSpec([3, 8], "float32")])
+    m2 = load_inference_model(p)
+    x = paddle.randn([3, 8])
+    assert np.allclose(m2(x).numpy(), model(x).numpy(), atol=1e-5)
+
+
+def test_predictor_handle_protocol(tmp_path):
+    from paddle_tpu import inference
+    model = _mlp()
+    x = paddle.randn([2, 8])
+    ref = model(x).numpy()
+    p = str(tmp_path / "mlp")
+    paddle.jit.save(model, p,
+                    input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(p))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x.numpy())
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    assert np.allclose(out, ref, atol=1e-5)
+    # convenience list API
+    outs = pred.run([x.numpy()])
+    assert np.allclose(outs[0], ref, atol=1e-5)
+
+
+def test_predictor_missing_input_errors(tmp_path):
+    from paddle_tpu import inference
+    model = _mlp()
+    p = str(tmp_path / "mlp")
+    paddle.jit.save(model, p,
+                    input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(p))
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        pred.run()
+
+
+def test_export_eval_mode_dropout(tmp_path):
+    """Export must run in eval mode: dropout is deterministic identity."""
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9))
+    model.train()
+    p = str(tmp_path / "drop")
+    paddle.jit.save(model, p,
+                    input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+    assert model.training  # training flag restored
+    m2 = paddle.jit.load(p)
+    x = paddle.randn([4, 8])
+    a, b = m2(x).numpy(), m2(x).numpy()
+    assert np.array_equal(a, b)
+    model.eval()
+    assert np.allclose(a, model(x).numpy(), atol=1e-6)

@@ -1,0 +1,141 @@
+"""Static-graph API tests (reference pattern: program-structure tests that
+need no devices, `test_fleet_sharding_meta_optimizer.py` style, plus
+numeric Executor.run parity with the eager path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.nn import functional as F
+
+
+def test_static_forward_matches_eager():
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        y = static.nn.fc(x, 16, activation="relu")
+        out = static.nn.fc(y, 4)
+    assert len(main.ops) > 0 and "x" in main.placeholders
+
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    assert got.shape == (3, 4)
+    # replay with a second feed gives different results (not baked)
+    (got2,) = exe.run(main, feed={"x": xv * 2}, fetch_list=[out])
+    assert not np.allclose(got, got2)
+
+
+def test_static_training_minimize():
+    """Build loss + minimize under program_guard; exe.run steps params."""
+    paddle.seed(1)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [16, 8], "float32")
+        label = static.data("label", [16], "int64")
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        out = model(x)
+        loss = F.cross_entropy(out, label)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=model.parameters())
+        opt.minimize(loss)
+    assert len(main.train_hooks) == 1
+
+    exe = static.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(16, 8).astype(np.float32)
+    w = rs.randn(8, 4)
+    yv = np.argmax(xv @ w, axis=1).astype(np.int64)
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(main, feed={"x": xv, "label": yv},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_compiled_program_matches_executor():
+    paddle.seed(2)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        model = nn.Linear(8, 4)
+        out = model(x)
+    exe = static.Executor()
+    xv = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    comp = static.CompiledProgram(main)
+    (got,) = comp.run({"x": xv}, [out])
+    assert np.allclose(got, ref, atol=1e-6)
+
+
+def test_executor_bad_feed_errors():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1
+    exe = static.Executor()
+    with pytest.raises(KeyError, match="not a placeholder"):
+        exe.run(main, feed={"bogus": np.zeros((2, 2), np.float32)},
+                fetch_list=[])
+
+
+def test_flops():
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    n = paddle.flops(net, (1, 8))
+    assert n == 8 * 32 + 32 * 4
+
+
+def test_executor_preserves_caller_tape():
+    """exe.run must not destroy an in-flight eager autograd graph."""
+    paddle.seed(3)
+    layer = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    loss = layer(x).sum()  # eager nodes on the tape
+
+    main = static.Program()
+    with static.program_guard(main):
+        d = static.data("d", [2, 2], "float32")
+        _ = d * 2
+    static.Executor().run(main, feed={"d": np.ones((2, 2), np.float32)},
+                          fetch_list=[])
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert not np.allclose(layer.weight.grad.numpy(), 0)
+
+
+def test_compiled_program_different_fetches():
+    paddle.seed(4)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        a = x * 2
+        b = x + 10
+    comp = static.CompiledProgram(main)
+    xv = np.ones((2, 4), np.float32)
+    (ga,) = comp.run({"x": xv}, [a])
+    (gb,) = comp.run({"x": xv}, [b])
+    assert np.allclose(ga, 2) and np.allclose(gb, 11)
+
+
+def test_parameterless_optimizer_trains():
+    """Static style: SGD() with no parameters trains program leaves."""
+    paddle.seed(5)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 2], "float32")
+        out = static.nn.fc(x, 2)
+        loss = ((out - y) * (out - y)).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    rs = np.random.RandomState(0)
+    xv = rs.randn(8, 4).astype(np.float32)
+    yv = rs.randn(8, 2).astype(np.float32)
+    l0 = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+    for _ in range(20):
+        l1 = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+    assert float(l1) < float(l0) * 0.8
