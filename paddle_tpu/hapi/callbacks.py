@@ -132,6 +132,7 @@ class EarlyStopping(Callback):
         super().__init__()
         self.monitor = monitor
         self.patience = patience
+        self.verbose = verbose
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.save_best_model = save_best_model
@@ -139,14 +140,21 @@ class EarlyStopping(Callback):
         if mode == "auto":
             mode = "min" if "loss" in monitor or "err" in monitor else "max"
         self.mode = mode
-        self.best = np.inf if mode == "min" else -np.inf
+        if baseline is not None:
+            self.best = baseline
+        else:
+            self.best = np.inf if mode == "min" else -np.inf
         self.wait = 0
         self.stop_training = False
+        self._epoch = 0
 
     def _better(self, cur):
         if self.mode == "min":
             return cur < self.best - self.min_delta
         return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
 
     def on_eval_end(self, logs=None):
         cur = (logs or {}).get(self.monitor)
@@ -156,11 +164,20 @@ class EarlyStopping(Callback):
         if self._better(cur):
             self.best = cur
             self.wait = 0
+            save_dir = self.params.get("save_dir")
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
                 self.stop_training = True
+                self.stopped_epoch = self._epoch
                 self.model.stop_training = True
+                if self.verbose:
+                    import sys
+                    print(f"Epoch {self._epoch}: early stopping "
+                          f"(best {self.monitor}={self.best:.5f})",
+                          file=sys.stderr)
 
 
 class LRScheduler(Callback):

@@ -91,9 +91,14 @@ class Model:
                                "train_batch")
         n_in = len(inputs)
 
-        if not update or loss_scale != 1.0:
+        has_pending = any(
+            p.grad is not None for p in self.network.parameters()
+            if not p.stop_gradient)
+        if not update or loss_scale != 1.0 or has_pending:
             # eager accumulate path: grads sum into .grad across calls;
-            # the optimizer steps only when update=True
+            # the optimizer steps only when update=True. Also taken when
+            # grads are already pending so a fused step never discards an
+            # accumulation in progress.
             outs = self.network(*inputs)
             loss = self._loss_value(outs, labels)
             if loss_scale != 1.0:
@@ -177,7 +182,8 @@ class Model:
             steps = None
         cblist = CallbackList(cbks, model=self,
                               params={"epochs": epochs, "steps": steps,
-                                      "verbose": verbose})
+                                      "verbose": verbose,
+                                      "save_dir": save_dir})
         self.stop_training = False
         cblist.on_train_begin()
         history = []
@@ -187,6 +193,7 @@ class Model:
             self.network.train()
             logs = {}
             accum = max(1, accumulate_grad_batches)
+            step = -1
             for step, batch in enumerate(train_loader):
                 cblist.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
@@ -198,6 +205,11 @@ class Model:
                     loss = self.train_batch(inputs, labels)
                 logs = {"loss": loss}
                 cblist.on_train_batch_end(step, logs)
+            if accum > 1 and (step + 1) % accum != 0:
+                # flush tail micro-batches so no gradient is dropped or
+                # leaks into the next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     self.stop_training = True

@@ -1,8 +1,11 @@
-"""Flagship model implementations (GPT pretraining, BERT)."""
+"""Flagship model implementations (GPT pretraining, BERT, OCR det+rec)."""
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForPretraining, GPTBlock, GPTAttention, GPTMLP,
     gpt_tiny_config,
 )
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+)
+from .ocr import (  # noqa: F401
+    CRNN, DBNet, db_loss, ctc_greedy_decode,
 )

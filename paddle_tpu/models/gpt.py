@@ -33,7 +33,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.0, attn_dropout=0.0, initializer_range=0.02,
-                 use_flash_attention=True, dtype="float32"):
+                 use_flash_attention=True, sequence_parallel=None,
+                 dtype="float32"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -44,6 +45,8 @@ class GPTConfig:
         self.attn_dropout = attn_dropout
         self.initializer_range = initializer_range
         self.use_flash_attention = use_flash_attention
+        # None | "ring" | "ulysses": context parallelism over the sp axis
+        self.sequence_parallel = sequence_parallel
         self.dtype = dtype
 
     @staticmethod
@@ -87,6 +90,21 @@ class GPTAttention(Layer):
         _tag(self.out_proj.weight, ("mp", None))
         self.attn_dropout = c.attn_dropout
         self.use_flash = c.use_flash_attention
+        self.sequence_parallel = c.sequence_parallel
+        if c.sequence_parallel and c.attn_dropout > 0:
+            import warnings
+            warnings.warn(
+                "attn_dropout is not applied on the sequence-parallel "
+                "attention path (ring/ulysses); set attn_dropout=0 or "
+                "sequence_parallel=None for identical regularization")
+
+    def _sp_active(self):
+        if not self.sequence_parallel:
+            return False
+        from ..distributed import env as dist_env
+        mesh = dist_env.current_mesh()
+        return (mesh is not None and "sp" in mesh.axis_names and
+                mesh.shape["sp"] > 1)
 
     def forward(self, x, cache=None):
         b, s = x.shape[0], x.shape[1]
@@ -100,8 +118,14 @@ class GPTAttention(Layer):
             new_cache = (k, v)
         else:
             new_cache = None
-        out = flash_attention(q, k, v, dropout=self.attn_dropout,
-                              causal=True, training=self.training)
+        if self._sp_active() and cache is None:
+            from ..ops.ring_attention import ring_attention, ulysses_attention
+            attn = ring_attention if self.sequence_parallel == "ring" \
+                else ulysses_attention
+            out = attn(q, k, v, causal=True)
+        else:
+            out = flash_attention(q, k, v, dropout=self.attn_dropout,
+                                  causal=True, training=self.training)
         out = reshape(out, [b, s, self.hidden_size])
         out = self.out_proj(out)
         if new_cache is not None:

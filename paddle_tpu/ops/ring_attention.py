@@ -1,0 +1,169 @@
+"""Ring attention and Ulysses attention — sequence/context parallelism.
+
+NEW capability relative to the reference (SURVEY §5: the 2021-era tree has
+no ring attention / sequence parallelism; `operators/fused/fmha_ref.h`
+materializes O(s^2)). TPU-native design:
+
+- **ring_attention**: q/k/v are sequence-sharded over the `sp` mesh axis.
+  Inside a `shard_map` manual over sp, each device attends its local query
+  block against every kv block, accumulating an online softmax
+  (num/den/max carry) while kv blocks rotate around the ICI ring via
+  `lax.ppermute` — compute overlaps the permute thanks to XLA's
+  latency-hiding scheduler. HBM stays O(s/sp) per chip, enabling context
+  lengths proportional to the ring size.
+- **ulysses_attention**: `lax.all_to_all` reshards seq-sharded activations
+  to head-sharded, runs dense/flash attention on full sequences for the
+  local head subset, and reshards back (DeepSpeed-Ulysses pattern mapped
+  onto one all-to-all pair over ICI). Requires heads % sp == 0.
+
+Both are differentiable (vjp flows through ppermute/all_to_all), usable
+eagerly via the Tensor wrappers or inside a GSPMD-jitted train step.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, apply
+
+_NEG_INF = -1e30
+
+
+def _ring_inner(ql, kl, vl, *, sp, causal, scale, axis_name):
+    """ql/kl/vl: [B, S_loc, N, H] local blocks. Online-softmax over the
+    kv ring. Internal layout [B, N, Sq, H]."""
+    i = jax.lax.axis_index(axis_name)
+    b, s_loc, n, h = ql.shape
+    q = ql.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B, N, Sq, H]
+    kc = kl.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vc = vl.transpose(0, 2, 1, 3).astype(jnp.float32)
+
+    m0 = jnp.full((b, n, s_loc), _NEG_INF, jnp.float32)
+    num0 = jnp.zeros((b, n, s_loc, h), jnp.float32)
+    den0 = jnp.zeros((b, n, s_loc), jnp.float32)
+    # carries become device-varying once mixed with axis_index-derived
+    # masks/permuted kv; mark them so scan's carry types line up
+    m0, num0, den0 = jax.lax.pcast((m0, num0, den0), (axis_name,),
+                                   to="varying")
+    perm = [(r, (r + 1) % sp) for r in range(sp)]
+    qpos = i * s_loc + jnp.arange(s_loc)               # global q positions
+
+    def step(carry, t):
+        kc, vc, m, num, den = carry
+        j = (i - t) % sp                               # held kv chunk index
+        s = jnp.einsum("bnqh,bnkh->bnqk", q, kc,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * s_loc + jnp.arange(s_loc)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+        cm = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, cm)
+        # rows with every position masked keep m = -inf; guard the exp
+        safe_m = jnp.where(new_m == _NEG_INF, 0.0, new_m)
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - safe_m))
+        den = den * corr + jnp.sum(p, axis=-1)
+        num = num * corr[..., None] + jnp.einsum(
+            "bnqk,bnkh->bnqh", p, vc, preferred_element_type=jnp.float32)
+        kc, vc = jax.lax.ppermute((kc, vc), axis_name, perm)
+        return (kc, vc, new_m, num, den), None
+
+    (kc, vc, m, num, den), _ = jax.lax.scan(
+        step, (kc, vc, m0, num0, den0), jnp.arange(sp))
+    out = num / jnp.maximum(den, 1e-20)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(ql.dtype)  # [B, Sq, N, H]
+
+
+def ring_attention_values(q, k, v, causal=False, scale=None,
+                          axis_name="sp", mesh=None):
+    """jax-value level. q/k/v: GLOBAL [B, S, N, H], S sharded over sp."""
+    from ..distributed import env
+    mesh = mesh or env.current_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] == 1:
+        from .attention import _composed_attention
+        return _composed_attention(q, k, v, causal=causal, scale=scale)
+    sp = mesh.shape[axis_name]
+    if q.shape[1] % sp or k.shape[1] % sp:
+        raise ValueError(
+            f"ring attention needs seq lengths (q={q.shape[1]}, "
+            f"k={k.shape[1]}) divisible by the '{axis_name}' mesh size {sp}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    inner = functools.partial(_ring_inner, sp=sp, causal=causal,
+                              scale=scale, axis_name=axis_name)
+    spec = P(None, axis_name, None, None)
+    shard = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, axis_names={axis_name})
+    return shard(q, k, v)
+
+
+def ring_attention(query, key, value, causal=False, scale=None,
+                   axis_name="sp", mesh=None):
+    """Tensor-level ring attention (autograd-recorded)."""
+    from ..tensor._helpers import ensure_tensor
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    return apply(lambda a, b_, c: ring_attention_values(
+        a, b_, c, causal=causal, scale=scale, axis_name=axis_name,
+        mesh=mesh), q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses: seq-shard <-> head-shard via all_to_all
+# ---------------------------------------------------------------------------
+
+def _ulysses_inner(ql, kl, vl, *, causal, scale, axis_name):
+    """local [B, S/sp, N, H] -> all_to_all -> [B, S, N/sp, H] -> attention
+    -> all_to_all back."""
+    def seq_to_head(x):
+        # split heads (dim 2) across sp, concat seq (dim 1)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_head(ql), seq_to_head(kl), seq_to_head(vl)
+    from .attention import _composed_attention
+    from .pallas_attention import flash_attention_fwd
+    from .attention import _use_pallas
+    if _use_pallas(qh):
+        out = flash_attention_fwd(qh, kh, vh, causal, scale)
+    else:
+        out = _composed_attention(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(out)
+
+
+def ulysses_attention_values(q, k, v, causal=False, scale=None,
+                             axis_name="sp", mesh=None):
+    from ..distributed import env
+    mesh = mesh or env.current_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] == 1:
+        from .attention import _composed_attention
+        return _composed_attention(q, k, v, causal=causal, scale=scale)
+    sp = mesh.shape[axis_name]
+    if q.shape[2] % sp != 0:
+        raise ValueError(f"ulysses needs heads ({q.shape[2]}) divisible by "
+                         f"sp ({sp}); use ring_attention instead")
+    inner = functools.partial(_ulysses_inner, causal=causal, scale=scale,
+                              axis_name=axis_name)
+    spec = P(None, axis_name, None, None)
+    shard = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, axis_names={axis_name})
+    return shard(q, k, v)
+
+
+def ulysses_attention(query, key, value, causal=False, scale=None,
+                      axis_name="sp", mesh=None):
+    from ..tensor._helpers import ensure_tensor
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    return apply(lambda a, b_, c: ulysses_attention_values(
+        a, b_, c, causal=causal, scale=scale, axis_name=axis_name,
+        mesh=mesh), q, k, v)

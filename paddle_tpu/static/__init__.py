@@ -240,6 +240,14 @@ class CompiledProgram:
         return replay
 
     def run(self, feed, fetch_list):
+        if self.program.train_hooks:
+            raise NotImplementedError(
+                "CompiledProgram replays forward ops only; run training "
+                "programs (optimizer.minimize) through static.Executor")
+        missing = [n for n in self.program.placeholders if n not in feed]
+        if missing:
+            raise KeyError(f"feed missing placeholders {missing}; their "
+                           "build-time values would be baked in as constants")
         feed_names = sorted(feed)
         fetch_ids = tuple(id(t) for t in fetch_list)
         key = (tuple(feed_names), fetch_ids)

@@ -134,3 +134,42 @@ def test_train_batch_update_false_accumulates():
     m.train_batch([ds.x], [ds.y], update=False)
     assert np.array_equal(m.network[0].weight.numpy(), w0)  # no step
     assert m.network[0].weight.grad is not None
+
+
+def test_accumulation_tail_flush():
+    """Odd batch count with accum=2: the tail batch still trains."""
+    ds = _ToyDataset(n=48)
+    m = _model()
+    batches = [(ds.x[i:i+16], ds.y[i:i+16]) for i in (0, 16, 32)]  # 3
+    w0 = m.network[0].weight.numpy().copy()
+    m.fit(batches, batch_size=16, epochs=1, verbose=0,
+          accumulate_grad_batches=2)
+    # tail flushed: no pending grads, weights moved
+    assert all(p.grad is None for p in m.network.parameters())
+    assert not np.allclose(m.network[0].weight.numpy(), w0)
+
+
+def test_update_true_honors_pending_accumulation():
+    """update=False then update=True must apply BOTH batches' grads."""
+    ds = _ToyDataset(n=32)
+
+    def run(split):
+        m = _model()
+        if split:
+            m.train_batch([ds.x[:16]], [ds.y[:16]], update=False,
+                          loss_scale=0.5)
+            m.train_batch([ds.x[16:]], [ds.y[16:]], update=True,
+                          loss_scale=0.5)
+        else:
+            m.train_batch([ds.x], [ds.y])
+        return m.network[0].weight.numpy()
+
+    # Adam is not linear in grads, so compare split vs an explicit
+    # two-batch accumulation, not the full batch
+    w_split = run(True)
+    m2 = _model()
+    m2.train_batch([ds.x[:16]], [ds.y[:16]], update=False, loss_scale=0.5)
+    m2.train_batch([ds.x[16:]], [ds.y[16:]], update=False, loss_scale=0.5)
+    m2._optimizer.step()
+    m2._optimizer.clear_grad()
+    assert np.allclose(w_split, m2.network[0].weight.numpy(), atol=1e-6)

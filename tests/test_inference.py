@@ -85,3 +85,24 @@ def test_export_eval_mode_dropout(tmp_path):
     assert np.array_equal(a, b)
     model.eval()
     assert np.allclose(a, model(x).numpy(), atol=1e-6)
+
+
+def test_multi_input_shared_batch_dim(tmp_path):
+    """Two dynamic-batch inputs must unify on the same symbolic dim."""
+    class Add(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 8)
+
+        def forward(self, a, b):
+            return self.lin(a) + b
+
+    paddle.seed(0)
+    model = Add()
+    p = str(tmp_path / "add")
+    paddle.jit.save(model, p, input_spec=[
+        paddle.jit.InputSpec([None, 8], "float32"),
+        paddle.jit.InputSpec([None, 8], "float32")])
+    m2 = paddle.jit.load(p)
+    a, b = paddle.randn([5, 8]), paddle.randn([5, 8])
+    assert np.allclose(m2(a, b).numpy(), model(a, b).numpy(), atol=1e-5)

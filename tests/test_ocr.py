@@ -1,0 +1,78 @@
+"""OCR family tests (capability config 4): CTC vs torch reference, CRNN
+overfit + greedy decode, DBNet det forward/loss."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.models.ocr import (CRNN, DBNet, db_loss, ctc_greedy_decode)
+
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    T, B, C, L = 12, 3, 7, 5
+    logits = rs.randn(T, B, C).astype(np.float32)
+    labels = rs.randint(1, C, (B, L)).astype(np.int64)
+    in_len = np.array([12, 10, 8])
+    lb_len = np.array([5, 3, 0])
+    got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len), paddle.to_tensor(lb_len),
+                     blank=0, reduction="none").numpy()
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels), torch.tensor(in_len),
+        torch.tensor(lb_len), blank=0, reduction="none",
+        zero_infinity=False).numpy()
+    assert np.allclose(got, ref, atol=1e-4)
+
+    x = paddle.to_tensor(logits)
+    x.stop_gradient = False
+    F.ctc_loss(x, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+               paddle.to_tensor(lb_len), reduction="sum").backward()
+    tl = torch.tensor(logits, requires_grad=True)
+    torch.nn.functional.ctc_loss(
+        torch.log_softmax(tl, -1), torch.tensor(labels),
+        torch.tensor(in_len), torch.tensor(lb_len), blank=0,
+        reduction="sum").backward()
+    assert np.allclose(x.grad.numpy(), tl.grad.numpy(), atol=1e-4)
+
+
+def test_crnn_shapes_and_overfit():
+    paddle.seed(0)
+    model = CRNN(in_channels=1, num_classes=11, hidden=16, rnn_hidden=24)
+    imgs = paddle.randn([2, 1, 32, 64])
+    logits = model(imgs)
+    assert logits.shape == [2, 16, 11]  # W/4 = 16 time steps
+
+    # overfit one sample: label should be recoverable by greedy decode
+    labels = paddle.to_tensor(np.array([[1, 2, 3], [4, 5, 6]]), "int64")
+    lb_len = paddle.to_tensor(np.array([3, 3]))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda im, lb, ll: model.loss(im, lb, ll), opt)
+    losses = [step(imgs, labels, lb_len).item() for _ in range(250)]
+    assert losses[-1] < 0.1, (losses[0], losses[-1])
+    model.eval()
+    decoded = ctc_greedy_decode(model(imgs))
+    assert decoded[0] == [1, 2, 3] and decoded[1] == [4, 5, 6], decoded
+
+
+def test_dbnet_forward_and_loss():
+    paddle.seed(1)
+    model = DBNet(in_channels=3, base=8, fpn_channels=32)
+    x = paddle.randn([2, 3, 64, 64])
+    pred = model(x)
+    assert isinstance(pred, tuple) and len(pred) == 3  # train mode
+    p, t, binary = pred
+    assert p.shape == t.shape == binary.shape
+    gt = paddle.to_tensor(
+        (np.random.RandomState(0).rand(*p.shape) > 0.7).astype(np.float32))
+    loss = db_loss(pred, gt)
+    loss.backward()
+    assert model.backbone.stage1.conv.weight.grad is not None
+    model.eval()
+    p_only = model(x)
+    assert not isinstance(p_only, tuple)

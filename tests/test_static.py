@@ -139,3 +139,21 @@ def test_parameterless_optimizer_trains():
     for _ in range(20):
         l1 = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
     assert float(l1) < float(l0) * 0.8
+
+
+def test_compiled_program_rejects_training_and_partial_feed():
+    paddle.seed(6)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = static.data("y", [2, 2], "float32")
+        out = x + y
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(out.sum())
+    with pytest.raises(NotImplementedError, match="Executor"):
+        static.CompiledProgram(main).run({"x": np.zeros((2, 2))}, [out])
+
+    infer = main.clone(for_test=True)
+    comp = static.CompiledProgram(infer)
+    with pytest.raises(KeyError, match="missing placeholders"):
+        comp.run({"x": np.zeros((2, 2), np.float32)}, [out])
