@@ -1,0 +1,131 @@
+"""Elastic training manager.
+
+Reference analog: `fleet/elastic/manager.py:103` — etcd3-backed node
+registry with scale-in/out vs fault classification
+(PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL, `manager.py:118`) and the
+ELASTIC_EXIT_CODE=101 relaunch protocol. TPU-native substitution: the
+registry is a shared filesystem directory of heartbeat files (GCS/NFS on a
+pod; etcd adds nothing once the scheduler owns pod lifecycle), and recovery
+is checkpoint-restart — on TPU a lost host invalidates the ICI mesh, so the
+manager's job is detection + relaunch decision, not in-place repair.
+"""
+import json
+import os
+import time
+
+from .launch import ELASTIC_EXIT_CODE  # noqa: F401  (protocol re-export)
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Register this host in a shared dir; watch membership.
+
+    fault_tolerance_level 0: any change -> EXIT (job-level restart);
+    level >= 1: missing host -> RESTART (relaunch protocol), new host ->
+    RESTART with the larger world.
+    """
+
+    def __init__(self, registry_dir, np=None, host_id=None,  # noqa: A002
+                 heartbeat_interval=1.0, timeout=5.0,
+                 fault_tolerance_level=None):
+        self.dir = registry_dir
+        os.makedirs(self.dir, exist_ok=True)
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.host_id = host_id if host_id is not None else \
+            os.environ.get("PADDLE_TRAINER_ID", "0")
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        if fault_tolerance_level is None:
+            fault_tolerance_level = int(os.environ.get(
+                "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
+        self.level = fault_tolerance_level
+        self._stop = False
+
+    # ---- registry ----
+    def _path(self, host_id):
+        return os.path.join(self.dir, f"host-{host_id}.json")
+
+    def register(self):
+        self.heartbeat()
+        return self
+
+    def heartbeat(self):
+        tmp = self._path(self.host_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": self.host_id, "ts": time.time(),
+                       "np": self.np}, f)
+        os.replace(tmp, self._path(self.host_id))
+
+    def deregister(self):
+        try:
+            os.remove(self._path(self.host_id))
+        except FileNotFoundError:
+            pass
+
+    def alive_hosts(self):
+        now = time.time()
+        alive = []
+        for name in os.listdir(self.dir):
+            if not name.startswith("host-") or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if now - rec.get("ts", 0) <= self.timeout:
+                alive.append(str(rec["host"]))
+        return sorted(alive)
+
+    # ---- watch ----
+    def check(self):
+        """One membership check -> ElasticStatus."""
+        alive = self.alive_hosts()
+        if len(alive) == self.np:
+            return ElasticStatus.HOLD
+        if self.level == 0:
+            return ElasticStatus.EXIT
+        return ElasticStatus.RESTART
+
+    def watch(self, max_checks=None):
+        """Heartbeat + check loop; returns the first non-HOLD status."""
+        checks = 0
+        while not self._stop:
+            self.heartbeat()
+            status = self.check()
+            if status != ElasticStatus.HOLD:
+                return status
+            checks += 1
+            if max_checks is not None and checks >= max_checks:
+                return ElasticStatus.HOLD
+            time.sleep(self.interval)
+        return ElasticStatus.COMPLETED
+
+    def stop(self):
+        self._stop = True
+
+
+def elastic_run(train_fn, manager=None):
+    """Run train_fn under the elastic exit-code protocol: any unhandled
+    collective/runtime error becomes SystemExit(ELASTIC_EXIT_CODE) so the
+    launcher relaunches (reference exit-code contract, `manager.py:26`)."""
+    try:
+        result = train_fn()
+        if manager is not None:
+            manager.deregister()
+        return result
+    except SystemExit:
+        raise
+    except Exception:
+        if manager is not None:
+            status = manager.check()
+            if status == ElasticStatus.EXIT:
+                raise
+        raise SystemExit(ELASTIC_EXIT_CODE)

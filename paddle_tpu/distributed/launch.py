@@ -14,7 +14,11 @@ protocol kept from the reference: ELASTIC_EXIT_CODE=101,
 import argparse
 import os
 import runpy
+import signal
+import socket
+import subprocess
 import sys
+import time
 
 ELASTIC_EXIT_CODE = 101
 
@@ -27,6 +31,9 @@ def _parse_args(argv=None):
                    default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     p.add_argument("--master", type=str,
                    default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="spawn N local processes (multi-host emulation / "
+                        "CPU tests; one process per host is the TPU norm)")
     p.add_argument("--devices", "--gpus", "--xpus", type=str, default="",
                    help="accepted for CLI parity; chip selection is "
                         "topology-driven on TPU")
@@ -38,8 +45,73 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def start_local_trainers(nproc, script, script_args, master=None,
+                         base_env=None):
+    """Spawn one training process per local rank (reference
+    `launch_utils.py:464` start_local_trainers)."""
+    master = master or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ if base_env is None else base_env)
+        env.update({
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ENDPOINTS": master,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script] + list(script_args), env=env))
+    return procs
+
+
+def watch_local_trainers(procs, poll_interval=0.5):
+    """Wait for all trainers; on any failure terminate the pod and return
+    that exit code (reference `launch_utils.py:573`)."""
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            for c in codes:
+                if c not in (None, 0):
+                    for p in procs:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                    for p in procs:
+                        try:
+                            p.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                    return c
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        raise
+
+
 def launch(argv=None):
     args = _parse_args(argv)
+    if args.nproc_per_node > 1:
+        restarts = 0
+        while True:
+            procs = start_local_trainers(args.nproc_per_node,
+                                         args.training_script,
+                                         args.training_script_args,
+                                         master=args.master or None)
+            rc = watch_local_trainers(procs)
+            if rc == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
+                    restarts < args.max_restarts:
+                restarts += 1
+                continue
+            return rc
     os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
     os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
     if args.master:
@@ -58,6 +130,8 @@ def launch(argv=None):
             runpy.run_path(args.training_script, run_name="__main__")
             return 0
         except SystemExit as e:
+            if e.code in (0, None):
+                return 0
             if e.code == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
                     restarts < args.max_restarts:
                 restarts += 1

@@ -153,7 +153,7 @@ class EarlyStopping(Callback):
             return cur < self.best - self.min_delta
         return cur > self.best + self.min_delta
 
-    def on_epoch_end(self, epoch, logs=None):
+    def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
 
     def on_eval_end(self, logs=None):

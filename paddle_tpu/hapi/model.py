@@ -205,15 +205,15 @@ class Model:
                     loss = self.train_batch(inputs, labels)
                 logs = {"loss": loss}
                 cblist.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
             if accum > 1 and (step + 1) % accum != 0:
                 # flush tail micro-batches so no gradient is dropped or
                 # leaks into the next epoch
                 self._optimizer.step()
                 self._optimizer.clear_grad()
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    self.stop_training = True
-                    break
             if eval_loader is not None and (epoch % eval_freq == 0 or
                                             epoch == epochs - 1):
                 eval_logs = self._run_eval(eval_loader, cblist)

@@ -40,10 +40,12 @@ def _specs_from(layer, input_spec, example_inputs):
 
 def _shape_dtype(spec, scope, idx):
     """ShapeDtypeStruct from an InputSpec; None/-1 dims become symbolic.
-    Dims are named by POSITION (d0, d1, ...) in one shared SymbolicScope,
-    so dynamic dims at the same position unify across inputs — the usual
-    shared-batch-dim contract for multi-input models."""
-    dims = [f"d{i}" if d is None or d == -1 else d
+    A dynamic dim 0 is the shared symbol "batch" across all inputs (the
+    usual multi-input contract); other dynamic dims stay independent
+    per-input symbols so e.g. encoder/decoder sequence lengths may
+    differ."""
+    dims = [("batch" if i == 0 else f"d{idx}_{i}")
+            if d is None or d == -1 else d
             for i, d in enumerate(spec.shape)]
     if any(isinstance(d, str) for d in dims):
         if scope[0] is None:

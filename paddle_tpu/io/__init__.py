@@ -6,3 +6,7 @@ from .dataloader import (  # noqa: F401
     DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
 )
 from .serialization import save, load  # noqa: F401
+
+# native (C++) record-file data path — threaded prefetch into staging
+# buffers (csrc/ptio.cc); importing is lazy so g++ is only needed on use
+from . import native  # noqa: F401

@@ -1,0 +1,221 @@
+"""ctypes binding for the native IO runtime (csrc/ptio.cc).
+
+The reference keeps its data path in C++ (DataFeed channels
+`framework/data_feed.cc`, in-memory Dataset `data_set.cc`, double-buffered
+`reader/buffered_reader.h`); this is the TPU-native counterpart: record
+datasets are written once, mmap'd, and batches are gathered by C++ worker
+threads into pooled aligned staging buffers that Python hands directly to
+the device transfer. Built on demand with g++ (no pybind dependency).
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DTYPES = {  # code <-> numpy dtype (must match elem_size_of in ptio.cc)
+    0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64,
+    4: np.uint8, 5: np.float16, 6: np.int16, 7: np.int8,
+}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _src_path():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "csrc", "ptio.cc")
+
+
+def _build_lib():
+    src = _src_path()
+    out_dir = os.path.join(os.path.dirname(src), "build")
+    os.makedirs(out_dir, exist_ok=True)
+    so = os.path.join(out_dir, "libptio.so")
+    if (not os.path.exists(so) or
+            os.path.getmtime(so) < os.path.getmtime(src)):
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", so + ".tmp"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(so + ".tmp", so)
+    return so
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_build_lib())
+        lib.ptio_writer_open.restype = ctypes.c_void_p
+        lib.ptio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                         ctypes.c_int32,
+                                         ctypes.POINTER(ctypes.c_int64)]
+        lib.ptio_writer_append.restype = ctypes.c_int64
+        lib.ptio_writer_append.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_int64]
+        lib.ptio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.ptio_open.restype = ctypes.c_void_p
+        lib.ptio_open.argtypes = [ctypes.c_char_p]
+        lib.ptio_count.restype = ctypes.c_int64
+        lib.ptio_count.argtypes = [ctypes.c_void_p]
+        lib.ptio_dtype.restype = ctypes.c_int32
+        lib.ptio_dtype.argtypes = [ctypes.c_void_p]
+        lib.ptio_ndim.restype = ctypes.c_int32
+        lib.ptio_ndim.argtypes = [ctypes.c_void_p]
+        lib.ptio_dims.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.ptio_close.argtypes = [ctypes.c_void_p]
+        lib.ptio_loader_create.restype = ctypes.c_void_p
+        lib.ptio_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
+        lib.ptio_loader_next.restype = ctypes.c_int64
+        lib.ptio_loader_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_void_p),
+                                         ctypes.POINTER(ctypes.c_void_p)]
+        lib.ptio_batch_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.ptio_loader_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ptio_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available():
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def write_dataset(path, array):
+    """Write a [N, ...] numpy array as a PTIO record file."""
+    lib = _load()
+    arr = np.ascontiguousarray(array)
+    code = _CODES.get(arr.dtype)
+    if code is None:
+        raise TypeError(f"unsupported dtype {arr.dtype}")
+    dims = (ctypes.c_int64 * 8)(*arr.shape[1:], *([0] * (8 - arr.ndim + 1)))
+    w = lib.ptio_writer_open(path.encode(), code, arr.ndim - 1, dims)
+    if not w:
+        raise OSError(f"cannot open {path} for writing")
+    n = lib.ptio_writer_append(
+        w, arr.ctypes.data_as(ctypes.c_void_p), arr.shape[0])
+    lib.ptio_writer_close(w)
+    if n != arr.shape[0]:
+        raise OSError(f"short write to {path}: {n}/{arr.shape[0]}")
+    return path
+
+
+class RecordDataset:
+    """mmap'd PTIO file."""
+
+    def __init__(self, path):
+        self._lib = _load()
+        self._h = self._lib.ptio_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open PTIO dataset {path}")
+        self.path = path
+        nd = self._lib.ptio_ndim(self._h)
+        dims = (ctypes.c_int64 * 8)()
+        self._lib.ptio_dims(self._h, dims)
+        self.sample_shape = tuple(dims[i] for i in range(nd))
+        self.dtype = np.dtype(_DTYPES[self._lib.ptio_dtype(self._h)])
+
+    def __len__(self):
+        return int(self._lib.ptio_count(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.ptio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeDataLoader:
+    """Threaded prefetching loader over one or more zipped PTIO files.
+
+    Yields tuples of numpy arrays (one per file). The arrays VIEW pooled
+    staging buffers and are valid until the next iteration step (pass
+    copy=True to detach). Epochs reshuffle deterministically from
+    seed + epoch.
+    """
+
+    def __init__(self, paths, batch_size, shuffle=False, seed=0,
+                 num_threads=4, capacity=8, drop_last=True, copy=False):
+        self._lib = _load()
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        self.datasets = [RecordDataset(p) for p in paths]
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.copy = copy
+        self._epoch = 0
+        self._ticket = None
+        handles = (ctypes.c_void_p * len(self.datasets))(
+            *[d._h for d in self.datasets])
+        self._h = self._lib.ptio_loader_create(
+            handles, len(self.datasets), self.batch_size,
+            1 if shuffle else 0, seed, num_threads, capacity,
+            1 if drop_last else 0)
+        if not self._h:
+            raise OSError("loader creation failed")
+        n = min(len(d) for d in self.datasets)
+        self._num_batches = n // self.batch_size if drop_last else \
+            -(-n // self.batch_size)
+
+    def __len__(self):
+        return self._num_batches
+
+    def _release(self):
+        if self._ticket is not None:
+            self._lib.ptio_batch_release(self._h, self._ticket)
+            self._ticket = None
+
+    def __iter__(self):
+        if self._epoch > 0:
+            self._release()
+            self._lib.ptio_loader_reset(self._h, self.seed + self._epoch)
+        self._epoch += 1
+        out_ptrs = (ctypes.c_void_p * len(self.datasets))()
+        ticket = ctypes.c_void_p()
+        while True:
+            self._release()
+            n = self._lib.ptio_loader_next(self._h, out_ptrs,
+                                           ctypes.byref(ticket))
+            if n <= 0:
+                if n < 0:
+                    raise RuntimeError("native loader stopped")
+                return
+            self._ticket = ticket.value
+            arrs = []
+            for d, ds in enumerate(self.datasets):
+                shape = (n,) + ds.sample_shape
+                nbytes = int(np.prod(shape)) * ds.dtype.itemsize
+                buf = (ctypes.c_char * nbytes).from_address(out_ptrs[d])
+                a = np.frombuffer(buf, dtype=ds.dtype).reshape(shape)
+                arrs.append(a.copy() if self.copy else a)
+            yield tuple(arrs)
+
+    def close(self):
+        self._release()
+        if getattr(self, "_h", None):
+            self._lib.ptio_loader_destroy(self._h)
+            self._h = None
+        for d in self.datasets:
+            d.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -152,6 +152,10 @@ def ulysses_attention_values(q, k, v, causal=False, scale=None,
     if q.shape[2] % sp != 0:
         raise ValueError(f"ulysses needs heads ({q.shape[2]}) divisible by "
                          f"sp ({sp}); use ring_attention instead")
+    if q.shape[1] % sp or k.shape[1] % sp:
+        raise ValueError(
+            f"ulysses attention needs seq lengths (q={q.shape[1]}, "
+            f"k={k.shape[1]}) divisible by the '{axis_name}' mesh size {sp}")
     inner = functools.partial(_ulysses_inner, causal=causal, scale=scale,
                               axis_name=axis_name)
     spec = P(None, axis_name, None, None)

@@ -1,0 +1,156 @@
+"""paddle_tpu.profiler — host spans + device traces.
+
+Reference analog: `platform/profiler.h:130` RecordEvent RAII spans with
+EnableProfiler/DisableProfiler summary tables, and DeviceTracer's CUPTI
+correlation (`platform/device_tracer.h:43`). TPU-native: device-side
+tracing is `jax.profiler` (XPlane -> TensorBoard, captures XLA ops and ICI
+collectives); this module keeps the RecordEvent-style host span API, a
+sorted summary table, and wraps jax.profiler start/stop so one call
+produces both views.
+"""
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+import jax
+
+_state = threading.local()
+_GLOBAL = {"enabled": False, "events": defaultdict(lambda: [0, 0.0]),
+           "lock": threading.Lock(), "trace_dir": None}
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"  # accepted for parity; device tracing == TPU here
+    TPU = "tpu"
+
+
+class RecordEvent:
+    """Host span: `with RecordEvent("name"):` or start()/end()
+    (reference `platform/profiler.h:130`)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    start = begin
+
+    def end(self):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        if _GLOBAL["enabled"]:
+            with _GLOBAL["lock"]:
+                rec = _GLOBAL["events"][self.name]
+                rec[0] += 1
+                rec[1] += dt
+
+    stop = end
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def annotate(name=None):
+    """Decorator: profile a function as a span (and a jax named scope so it
+    shows up inside the XLA trace too)."""
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        def wrapped(*args, **kwargs):
+            with RecordEvent(label), jax.named_scope(label):
+                return fn(*args, **kwargs)
+        wrapped.__name__ = fn.__name__
+        return wrapped
+    return deco
+
+
+def start_profiler(trace_dir=None, targets=None):
+    """EnableProfiler analog. trace_dir also starts the jax/XPlane device
+    trace viewable in TensorBoard."""
+    _GLOBAL["enabled"] = True
+    _GLOBAL["events"].clear()
+    if trace_dir:
+        _GLOBAL["trace_dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", print_table=True):
+    """DisableProfiler analog: stops tracing, returns (and prints) the host
+    span table."""
+    _GLOBAL["enabled"] = False
+    if _GLOBAL["trace_dir"]:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _GLOBAL["trace_dir"] = None
+    with _GLOBAL["lock"]:
+        rows = [(name, cnt, tot, tot / max(cnt, 1))
+                for name, (cnt, tot) in _GLOBAL["events"].items()]
+    key = {"total": 2, "calls": 1, "avg": 3, "name": 0}[sorted_key]
+    rows.sort(key=lambda r: r[key], reverse=key != 0)
+    if print_table and rows:
+        w = max(len(r[0]) for r in rows) + 2
+        print(f"{'Event':<{w}}{'Calls':>8}{'Total(s)':>12}{'Avg(ms)':>12}")
+        for name, cnt, tot, avg in rows:
+            print(f"{name:<{w}}{cnt:>8}{tot:>12.4f}{avg * 1000:>12.3f}")
+    return {r[0]: {"calls": r[1], "total": r[2], "avg": r[3]} for r in rows}
+
+
+@contextlib.contextmanager
+def profiler(trace_dir=None):
+    start_profiler(trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler()
+
+
+# jax passthroughs for power users / server-based capture
+start_server = jax.profiler.start_server
+trace_annotation = jax.profiler.TraceAnnotation
+
+
+class Profiler:
+    """paddle.profiler.Profiler class-style API (2.x parity)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, trace_dir=None):
+        self.trace_dir = trace_dir
+        self._summary = None
+
+    def start(self):
+        start_profiler(self.trace_dir)
+        return self
+
+    def stop(self):
+        self._summary = stop_profiler(print_table=False)
+
+    def step(self):
+        pass
+
+    def summary(self, sorted_by=None, **kw):
+        return self._summary
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,15 @@
+"""paddle_tpu.quant — quantization-aware training and post-training
+quantization.
+
+Reference analog: `python/paddle/fluid/contrib/slim/quantization/`
+(QuantizationTransformPass program rewriting for QAT, imperative QAT
+`imperative/qat.py`, PostTrainingQuantization
+`post_training_quantization.py`). TPU-native: no pass pipeline — QAT is a
+layer substitution (Linear/Conv2D -> fake-quant wrappers with
+straight-through estimators, all fused by XLA), PTQ is activation-range
+calibration over sample data, and converted inference layers run real int8
+matmuls on the MXU (int8 is 2x bf16 throughput on v5e+).
+"""
+from .qat import (FakeQuantAbsMax, QuantizedLinear, QuantizedConv2D,  # noqa: F401
+                  QAT, quant_dequant)
+from .ptq import PTQ, AbsmaxQuantizer, HistQuantizer  # noqa: F401

@@ -1,0 +1,126 @@
+"""Post-training quantization (reference
+`contrib/slim/quantization/post_training_quantization.py`)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from .. import nn
+from ..nn import functional as F
+
+
+class AbsmaxQuantizer:
+    def __init__(self):
+        self.max = 0.0
+
+    def observe(self, arr):
+        self.max = max(self.max, float(np.max(np.abs(arr))))
+
+    def scale(self):
+        return max(self.max, 1e-8)
+
+
+class HistQuantizer:
+    """Percentile-clipped range (cheap stand-in for the reference's KL
+    calibration)."""
+
+    def __init__(self, percentile=99.99, bins=2048):
+        self.percentile = percentile
+        self.vals = []
+
+    def observe(self, arr):
+        self.vals.append(np.abs(np.asarray(arr)).ravel())
+
+    def scale(self):
+        if not self.vals:
+            return 1e-8
+        allv = np.concatenate(self.vals)
+        return max(float(np.percentile(allv, self.percentile)), 1e-8)
+
+
+class Int8Linear(nn.Layer):
+    """Real-int8 inference linear: w stored int8, activations quantized at
+    the boundary, i8 x i8 -> i32 dot on the MXU, dequant fused by XLA."""
+
+    def __init__(self, layer, act_scale, bits=8):
+        super().__init__()
+        qmax = 2.0 ** (bits - 1) - 1
+        w = layer.weight.numpy()
+        self.w_scale = float(np.max(np.abs(w)) or 1e-8)
+        self.wq = Tensor(jnp.asarray(
+            np.clip(np.round(w / self.w_scale * qmax), -qmax, qmax),
+            jnp.int8), stop_gradient=True)
+        self.bias = layer.bias
+        self.act_scale = float(act_scale)
+        self.qmax = qmax
+
+    def forward(self, x):
+        s_in, s_w, qmax = self.act_scale, self.w_scale, self.qmax
+
+        def fn(xv, wq, *maybe_bias):
+            xq = jnp.clip(jnp.round(xv / s_in * qmax), -qmax, qmax
+                          ).astype(jnp.int8)
+            out = jnp.matmul(xq, wq, preferred_element_type=jnp.int32)
+            out = out.astype(jnp.float32) * (s_in * s_w / (qmax * qmax))
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out
+        args = (x, self.wq) + ((self.bias,) if self.bias is not None else ())
+        return apply(fn, *args)
+
+
+class PTQ:
+    """Calibrate activation ranges over sample batches, then convert
+    Linear layers to real-int8 inference layers."""
+
+    def __init__(self, quantizer="abs_max", bits=8):
+        self.bits = bits
+        self.quantizer = quantizer
+        self._observers = {}
+
+    def _make_q(self):
+        return (HistQuantizer() if self.quantizer in ("hist", "KL")
+                else AbsmaxQuantizer())
+
+    def quantize(self, model, calib_fn=None, calib_data=None):
+        """Attach observers, run calibration data, convert in place."""
+        hooks = []
+        observers = {}
+
+        def attach(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if type(child).__name__ == "Linear":
+                    q = self._make_q()
+                    observers[id(child)] = q
+
+                    def hook(lyr, inputs, _q=q):
+                        x = inputs[0]
+                        _q.observe(x.numpy())
+                    hooks.append(child.register_forward_pre_hook(
+                        lambda lyr, inputs, _q=q: _q.observe(
+                            inputs[0].numpy())))
+                else:
+                    attach(child)
+        attach(model)
+        model.eval()
+        if calib_fn is not None:
+            calib_fn(model)
+        elif calib_data is not None:
+            from ..core import autograd
+            with autograd.no_grad():
+                for batch in calib_data:
+                    batch = batch if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    model(*[b if isinstance(b, Tensor) else Tensor(b)
+                            for b in batch])
+        for h in hooks:
+            h.remove()
+
+        def convert(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if id(child) in observers:
+                    layer._sub_layers[name] = Int8Linear(
+                        child, observers[id(child)].scale(), self.bits)
+                else:
+                    convert(child)
+        convert(model)
+        return model

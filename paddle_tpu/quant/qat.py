@@ -1,0 +1,110 @@
+"""Quantization-aware training (imperative QAT analog,
+`contrib/slim/quantization/imperative/qat.py`)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from .. import nn
+from ..nn import functional as F
+
+
+def quant_dequant(x, scale, bits=8):
+    """Fake-quantize with straight-through gradient:
+    y = x + stop_grad(q(x) - x)."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def fn(v, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+        return v + jax.lax.stop_gradient(q - v)
+    return apply(fn, x, scale)
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Running abs-max observer + fake quant (the moving-average absmax
+    quantizer of `quantization_pass.py`)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.scale = self.create_buffer([1], fill=1e-8)
+
+    def create_buffer(self, shape, fill):
+        t = Tensor(jnp.full(shape, fill, jnp.float32), stop_gradient=True)
+        self.register_buffer("scale_buf", t)
+        return t
+
+    def forward(self, x):
+        if self.training:
+            cur = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), x)
+            m = self.momentum
+            new_scale = apply(
+                lambda s, c: jnp.maximum(m * s + (1 - m) * c, 1e-8),
+                self.scale, cur)
+            self.scale._value = jax.lax.stop_gradient(new_scale._value)
+        return quant_dequant(x, self.scale, self.bits)
+
+
+class QuantizedLinear(nn.Layer):
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        self.inner = layer
+        self.act_quant = FakeQuantAbsMax(bits)
+        self.w_quant_bits = bits
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.inner.weight
+        w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
+        wq = quant_dequant(w, w_scale, self.w_quant_bits)
+        out = F.linear(x, wq, self.inner.bias)
+        return out
+
+
+class QuantizedConv2D(nn.Layer):
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        self.inner = layer
+        self.act_quant = FakeQuantAbsMax(bits)
+        self.w_quant_bits = bits
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.inner.weight
+        w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
+        wq = quant_dequant(w, w_scale, self.w_quant_bits)
+        return F.conv2d(x, wq, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+class QAT:
+    """`QAT().quantize(model)` swaps Linear/Conv2D sublayers in place for
+    fake-quant wrappers (imperative QAT `qat.py` ImperativeQuantAware)."""
+
+    def __init__(self, bits=8, quantizable_layer_type=("Linear", "Conv2D")):
+        self.bits = bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model):
+        self._swap(model)
+        return model
+
+    def _swap(self, layer):
+        for name, child in list(layer._sub_layers.items()):
+            cls = type(child).__name__
+            if cls == "Linear" and "Linear" in self.types:
+                layer._sub_layers[name] = QuantizedLinear(child, self.bits)
+            elif cls == "Conv2D" and "Conv2D" in self.types:
+                layer._sub_layers[name] = QuantizedConv2D(child, self.bits)
+            else:
+                self._swap(child)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..inference.export import save_inference_model
+        model.eval()
+        return save_inference_model(path, model, input_spec=input_spec)

@@ -1,0 +1,90 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference analog: `python/paddle/fluid/contrib/sparsity/` (`asp.py`
+prune_model/decorate, `utils.py` mask generation) + the
+`ASPOptimizer` meta-optimizer. TPU-native: masks are plain jnp arrays
+multiplied into weights (XLA folds the multiply); the decorated optimizer
+re-applies masks after every step so pruned weights stay zero, exactly the
+reference's OptimizerWithSparsityGuarantee behavior.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+
+def calculate_density(x):
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def check_sparsity(x, n=2, m=4):
+    """True iff every group of m consecutive elements along the last dim
+    has at most n non-zeros."""
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    arr = arr.reshape(-1, arr.shape[-1])
+    if arr.shape[-1] % m:
+        return False
+    g = (arr != 0).reshape(arr.shape[0], -1, m)
+    return bool((g.sum(-1) <= n).all())
+
+
+def create_mask(w, n=2, m=4):
+    """Keep the n largest-|w| entries of each group of m along the last
+    dim (reference `sparsity/utils.py get_mask_2d_best` 1-D variant)."""
+    arr = np.asarray(w)
+    shape = arr.shape
+    if shape[-1] % m:
+        raise ValueError(f"last dim {shape[-1]} not divisible by m={m}")
+    flat = np.abs(arr).reshape(-1, m)
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(shape).astype(arr.dtype)
+
+
+def _prunable(name, param):
+    return param is not None and not param.stop_gradient and \
+        len(param.shape) >= 2 and param.shape[-1] % 4 == 0
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable weight. The mask is stored ON the
+    parameter (`p._asp_mask`), so a decorated optimizer enforces exactly the
+    masks of its own parameters — no global registry, no cross-model
+    contamination."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p.numpy(), n, m)
+        mj = jnp.asarray(mask)
+        p._value = p._value * mj
+        p._asp_mask = mj
+        pruned[name] = mask
+    return pruned
+
+
+def reset_excluded_layers(*a, **k):
+    pass
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply its own parameters' masks after each
+    update (the ASPOptimizer / OptimizerWithSparsityGuarantee analog)."""
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._parameter_list or []:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._value = p._value * mask
+    optimizer.step = step
+    return optimizer
+
+
+class ASPHelper:
+    calculate_density = staticmethod(calculate_density)
+    prune_model = staticmethod(prune_model)
+    decorate = staticmethod(decorate)

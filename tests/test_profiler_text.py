@@ -1,0 +1,78 @@
+"""Profiler span table + text dataset/viterbi tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu import nn
+
+
+def test_profiler_spans_and_table(capsys):
+    profiler.start_profiler()
+    for _ in range(3):
+        with profiler.RecordEvent("forward"):
+            _ = paddle.randn([8, 8]) @ paddle.randn([8, 8])
+    with profiler.RecordEvent("other"):
+        pass
+    table = profiler.stop_profiler()
+    out = capsys.readouterr().out
+    assert "forward" in out
+    assert table["forward"]["calls"] == 3
+    assert table["forward"]["total"] > 0
+
+
+def test_profiler_class_api():
+    with profiler.Profiler() as prof:
+        with profiler.RecordEvent("x"):
+            pass
+    assert prof.summary()["x"]["calls"] == 1
+
+
+def test_annotate_decorator():
+    @profiler.annotate("span_fn")
+    def f(a):
+        return a + 1
+
+    profiler.start_profiler()
+    f(paddle.ones([2]))
+    t = profiler.stop_profiler(print_table=False)
+    assert t["span_fn"]["calls"] == 1
+
+
+def test_text_datasets_learnable():
+    from paddle_tpu.text import Imdb, UCIHousing, Imikolov
+    ds = Imdb(mode="train")
+    x, y = ds[0]
+    assert x.shape == (64,) and y in (0, 1)
+    # class-conditional structure exists: token means differ by class
+    pos = np.concatenate([ds[i][0] for i in range(len(ds))
+                          if ds[i][1] == 1])
+    neg = np.concatenate([ds[i][0] for i in range(len(ds))
+                          if ds[i][1] == 0])
+    assert abs(pos.mean() - neg.mean()) > 50
+
+    h = UCIHousing()
+    assert h[0][0].shape == (13,)
+    ng = Imikolov(window_size=5)
+    ctx, nxt = ng[0]
+    assert len(ctx) == 4
+
+
+def test_viterbi_decoder_matches_bruteforce():
+    import itertools
+    from paddle_tpu.text import ViterbiDecoder
+    rs = np.random.RandomState(3)
+    B, T, N = 2, 4, 3
+    emis = rs.randn(B, T, N).astype(np.float32)
+    trans = rs.randn(N, N).astype(np.float32)
+    dec = ViterbiDecoder(paddle.to_tensor(trans))
+    scores, paths = dec(paddle.to_tensor(emis))
+    for b in range(B):
+        best, bp = -1e9, None
+        for seq in itertools.product(range(N), repeat=T):
+            s = emis[b, 0, seq[0]] + sum(
+                trans[seq[t - 1], seq[t]] + emis[b, t, seq[t]]
+                for t in range(1, T))
+            if s > best:
+                best, bp = s, seq
+        assert abs(best - float(scores.numpy()[b])) < 1e-4
+        assert list(bp) == paths.numpy()[b].tolist()

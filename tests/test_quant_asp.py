@@ -1,0 +1,96 @@
+"""Quantization (QAT fake-quant, PTQ real-int8) and ASP 2:4 sparsity tests
+(reference: slim quantization tests + test_asp_optimize.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _data(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 4)
+    y = np.argmax(x @ w, 1).astype(np.int64)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def test_qat_trains_and_stays_accurate():
+    from paddle_tpu.quant import QAT
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    QAT(bits=8).quantize(model)
+    assert type(model[0]).__name__ == "QuantizedLinear"
+    x, y = _data()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    losses = []
+    for _ in range(60):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0] * 0.3
+    model.eval()
+    acc = (np.argmax(model(x).numpy(), 1) == y.numpy()).mean()
+    assert acc > 0.9
+
+
+def test_ptq_int8_close_to_float():
+    from paddle_tpu.quant import PTQ
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    x, _ = _data()
+    ref = model(x).numpy()
+    PTQ().quantize(model, calib_data=[(x,)])
+    assert type(model[0]).__name__ == "Int8Linear"
+    assert str(model[0].wq._value.dtype) == "int8"
+    got = model(x).numpy()
+    # int8 quantization error stays small relative to output scale
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+    # classifications preserved for most samples
+    agree = (np.argmax(got, 1) == np.argmax(ref, 1)).mean()
+    assert agree > 0.95
+
+
+def test_asp_prune_and_training_keeps_masks():
+    from paddle_tpu import sparsity
+    paddle.seed(2)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    pruned = sparsity.prune_model(model)
+    assert len(pruned) == 2
+    for p in (model[0].weight, model[2].weight):
+        assert abs(sparsity.calculate_density(p) - 0.5) < 1e-6
+        assert sparsity.check_sparsity(p, 2, 4)
+
+    x, y = _data()
+    opt = sparsity.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    for _ in range(5):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks still enforced after training steps
+    assert sparsity.check_sparsity(model[0].weight, 2, 4)
+    assert abs(sparsity.calculate_density(model[0].weight) - 0.5) < 0.02
+
+
+def test_asp_masks_are_per_model():
+    """Decorating model B's optimizer must not touch model A's weights."""
+    from paddle_tpu import sparsity
+    paddle.seed(3)
+    a = nn.Linear(8, 8)
+    b = nn.Linear(8, 8)
+    sparsity.prune_model(a)
+    wa = a.weight.numpy().copy()
+    opt_b = sparsity.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=b.parameters()))
+    loss = F.mse_loss(b(paddle.ones([2, 8])), paddle.zeros([2, 8]))
+    loss.backward()
+    opt_b.step()
+    assert np.array_equal(a.weight.numpy(), wa)  # A untouched
+    assert not sparsity.check_sparsity(b.weight)  # B not pruned
