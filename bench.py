@@ -39,7 +39,7 @@ def main():
 
     if on_tpu:
         cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
-        batch, seq, steps, warmup = 8, 1024, 30, 3
+        batch, seq, steps, warmup = 16, 1024, 30, 3
     else:  # CPU smoke so the script always works
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=256, dropout=0.0,

@@ -1,0 +1,13 @@
+"""paddle_tpu.incubate — incubating APIs.
+
+Mirrors the reference's incubate namespace surface that the rest of this
+framework implements elsewhere: `asp` (2:4 sparsity,
+`contrib/sparsity/asp.py`), fused transformer layers
+(`incubate/nn/layer/fused_transformer.py` over `operators/fused/`), and
+dygraph recompute/LookAhead-style utilities.
+"""
+from .. import sparsity as asp  # noqa: F401
+from . import nn  # noqa: F401
+from ..distributed.recompute import recompute  # noqa: F401
+
+__all__ = ["asp", "nn", "recompute"]

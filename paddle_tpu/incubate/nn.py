@@ -1,0 +1,99 @@
+"""incubate.nn — fused transformer layer parity.
+
+Reference: `operators/fused/fused_attention_op.cu` /
+`fused_transformer_op.cu` exposed through
+`python/paddle/incubate/nn/layer/fused_transformer.py`. On TPU, "fused"
+means the Pallas flash-attention kernel plus XLA's automatic elementwise
+fusion — these layers keep the reference API and route to that path.
+"""
+import math
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..ops.attention import flash_attention
+from ..tensor.manipulation import reshape
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """pre/post-LN multi-head self-attention with residual
+    (`fused_attention_op` semantics)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=False,
+                 qkv_weight_attr=None, linear_weight_attr=None, **kw):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv_proj = nn.Linear(embed_dim, 3 * embed_dim,
+                                  weight_attr=qkv_weight_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr)
+        self.norm = nn.LayerNorm(embed_dim)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        b, s = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv_proj(x),
+                      [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        if attn_mask is not None:
+            from ..ops.attention import scaled_dot_product_attention
+            out = scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.attn_dropout_rate, training=self.training)
+        else:
+            out = flash_attention(q, k, v, dropout=self.attn_dropout_rate,
+                                  causal=False, training=self.training)
+        out = self.out_proj(reshape(out, [b, s, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """linear-act-dropout-linear-residual-LN (`fused_feedforward_op`)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", normalize_before=False, **kw):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, dim_feedforward)
+        self.fc2 = nn.Linear(dim_feedforward, d_model)
+        self.norm = nn.LayerNorm(d_model)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = self.fc2(self.dropout(
+            getattr(F, self.activation)(self.fc1(x))))
+        x = residual + self.dropout(x)
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kw):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate if attn_dropout_rate is not None
+            else dropout_rate, normalize_before)
+        self.ffn = FusedFeedForward(d_model, dim_feedforward, dropout_rate,
+                                    activation, normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.self_attn(src, src_mask))

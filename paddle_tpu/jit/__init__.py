@@ -125,7 +125,12 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         params, buffers = self._collect_state()
         dyn_vals, rebuild, key = _split_args(args)
-        cache_key = (key, tuple(sorted(kwargs)) if kwargs else ())
+        # amp state is read at trace time; a toggled auto_cast context must
+        # not silently reuse a trace made under the other policy
+        from ..amp import amp_state
+        st = amp_state()
+        cache_key = (key, tuple(sorted(kwargs)) if kwargs else (),
+                     st.enabled, str(st.dtype) if st.enabled else "")
 
         jitted = self._jit_cache.get(cache_key)
         if jitted is None:
@@ -232,8 +237,12 @@ class TrainStep:
         return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
-        if self._jitted is None:
+        from ..amp import amp_state
+        st = amp_state()
+        amp_key = (st.enabled, str(st.dtype) if st.enabled else "")
+        if self._jitted is None or getattr(self, "_amp_key", None) != amp_key:
             self._jitted = self._make_step()
+            self._amp_key = amp_key
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
         param_vals = [p._value for p in self.params]

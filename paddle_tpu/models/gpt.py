@@ -125,7 +125,9 @@ class GPTAttention(Layer):
             out = attn(q, k, v, causal=True)
         else:
             out = flash_attention(q, k, v, dropout=self.attn_dropout,
-                                  causal=True, training=self.training)
+                                  causal=True, training=self.training,
+                                  use_pallas=None if self.use_flash
+                                  else False)
         out = reshape(out, [b, s, self.hidden_size])
         out = self.out_proj(out)
         if new_cache is not None:
@@ -223,9 +225,14 @@ class GPTForPretraining(Layer):
     def forward(self, input_ids, position_ids=None):
         h = self.gpt(input_ids, position_ids)
         w = self.gpt.wte.weight
-        logits = apply(lambda hh, ww: jnp.einsum(
-            "bsd,vd->bsv", hh, ww,
-            preferred_element_type=jnp.float32), h, w)
+        from ..amp import maybe_cast_to_compute as _amp
+
+        def head(hh, ww):
+            # honor the AMP policy like F.linear does: the vocab projection
+            # is the single largest matmul and must hit the MXU in bf16
+            return jnp.einsum("bsd,vd->bsv", _amp(hh), _amp(ww),
+                              preferred_element_type=jnp.float32)
+        logits = apply(head, h, w)
         return logits
 
     def loss(self, input_ids, labels, loss_mask=None):

@@ -15,12 +15,17 @@ from ...tensor._helpers import ensure_tensor
 
 
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W + b with W shaped [in, out] (paddle convention)."""
+    """y = x @ W + b with W shaped [in, out] (paddle convention). Under
+    amp.auto_cast the operands are cast to the compute dtype so the matmul
+    hits the MXU at bf16 rate (the white-list cast the reference's tracer
+    inserts, `imperative/amp_auto_cast.cc`)."""
+    from ...amp import maybe_cast_to_compute as _amp
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     if bias is None:
-        return apply(lambda v, w: jnp.matmul(v, w), x, weight)
+        return apply(lambda v, w: jnp.matmul(_amp(v), _amp(w)), x, weight)
     bias = ensure_tensor(bias)
-    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias)
+    return apply(lambda v, w, b: jnp.matmul(_amp(v), _amp(w)) +
+                 _amp(b), x, weight, bias)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

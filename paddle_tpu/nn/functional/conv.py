@@ -57,12 +57,14 @@ def _dim_numbers(nd, channel_last):
 
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
              channel_last):
+    from ...amp import maybe_cast_to_compute as _amp
     stride = _norm_tuple(stride, nd)
     dilation = _norm_tuple(dilation, nd)
     pad = _norm_padding(padding, nd)
     dn = _dim_numbers(nd, channel_last)
 
     def fn(v, w):
+        v, w = _amp(v), _amp(w)
         return lax.conv_general_dilated(
             v, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,

@@ -1,0 +1,9 @@
+"""paddle_tpu.onnx — ONNX export facade.
+
+Reference: `python/paddle/onnx/export.py` (delegates to the external
+paddle2onnx package). This environment ships no onnx package; the native
+deployment artifact is serialized StableHLO (`paddle_tpu.inference`), which
+is the portable format for XLA-backed runtimes. `export` raises with that
+guidance unless an onnx installation is present.
+"""
+from .export import export  # noqa: F401

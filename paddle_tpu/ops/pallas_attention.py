@@ -21,8 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _LANES = 128  # stats buffers padded to a full lane register
 _SUB = 8     # row-stats (lse/delta) replicated over 8 sublanes so their
              # [.., _SUB, bq] blocks satisfy the TPU (8, 128) tile minimum
@@ -31,6 +31,15 @@ _NEG_INF = -1e30
 
 def _interpret():
     return jax.default_backend() != "tpu"
+
+
+def _fit_block(block, dim):
+    """Largest power-of-two block <= `block` that exactly tiles `dim`
+    (callers guarantee dim % 128 == 0, so this terminates >= 128)."""
+    b = min(block, dim)
+    while dim % b:
+        b //= 2
+    return b
 
 
 # ---------------------------------------------------------------------------
@@ -50,11 +59,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     qi = pl.program_id(1)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)          # [bq, H]
-        k = k_ref[0].astype(jnp.float32)          # [bk, H]
+        q = q_ref[0]                               # [bq, H] input dtype
+        k = k_ref[0]                               # [bk, H]
+        # bf16 inputs feed the MXU directly; accumulation stays f32
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            preferred_element_type=jnp.float32) * scale   # [bq, bk] f32
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
@@ -63,11 +73,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                     # [bq, bk]
+        p = jnp.exp(s - m_new)                     # [bq, bk] f32
         l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0].astype(jnp.float32)           # [bk, H]
+        v = v_ref[0]                               # [bk, H]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [bq, H]
         acc_sc[:] = acc_sc[:] * alpha + pv
         m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
@@ -93,8 +103,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = _fit_block(block_q, sq)
+    bk = _fit_block(block_k, sk)
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
 
@@ -152,10 +162,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)           # [bq, H]
-        k = k_ref[0].astype(jnp.float32)           # [bk, H]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)         # [bq, H]
+        q = q_ref[0]                               # [bq, H] input dtype
+        k = k_ref[0]                               # [bk, H]
+        v = v_ref[0]
+        do = do_ref[0]                             # [bq, H]
         lse = lse_ref[0][0][:, None]               # [bq, 1]
         delta = delta_ref[0][0][:, None]           # [bq, 1]
         s = jax.lax.dot_general(
@@ -168,14 +178,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p = jnp.where(rows + offset >= cols, p, 0.0)
         # dv += p^T do
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [bq, bk]
         ds = p * (dp - delta) * scale
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -202,10 +212,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][0][:, None]
         delta = delta_ref[0][0][:, None]
         s = jax.lax.dot_general(
@@ -221,7 +231,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -239,8 +249,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = _fit_block(block_q, sq)
+    bk = _fit_block(block_k, sk)
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
 

@@ -18,9 +18,11 @@ from ._helpers import ensure_tensor, normalize_axis
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from ..amp import maybe_cast_to_compute as _amp
     x, y = ensure_tensor(x), ensure_tensor(y)
 
     def fn(a, b):
+        a, b = _amp(a), _amp(b)
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:

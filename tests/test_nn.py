@@ -366,3 +366,21 @@ class TestToStatic:
         bn(paddle.randn([8, 4]) + 3.0)
         after = bn._mean.numpy()
         assert not np.allclose(before, after)
+
+
+def test_amp_toggle_not_cached():
+    """A compiled function traced without amp must retrace when amp turns
+    on (and vice versa)."""
+    import jax.numpy as jnp
+    from paddle_tpu import amp
+    paddle.seed(0)
+    layer = nn.Linear(8, 8)
+    fn = paddle.jit.to_static(lambda t: layer(t), )
+    x = paddle.randn([4, 8])
+    out_f32 = fn(x)
+    with amp.auto_cast():
+        out_amp = fn(x)
+    # bf16 matmul rounds differently from f32 — outputs must differ
+    assert not np.array_equal(out_f32.numpy(), out_amp.numpy())
+    out_f32_again = fn(x)
+    assert np.array_equal(out_f32.numpy(), out_f32_again.numpy())

@@ -60,3 +60,22 @@ def test_flash_attention_cross_lengths():
     out = flash_attention_fwd(q, k, v, True)
     ref = _ref(q, k, v, causal=True)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_non_block_multiple_seq():
+    """Seq lengths that are multiples of 128 but not of the 512 default
+    block must still tile exactly (regression: silent truncation)."""
+    rs = np.random.RandomState(5)
+    b, s, n, h = 1, 1152, 2, 64   # 1152 = 9 * 128
+    q = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    k = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    v = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    out = flash_attention_fwd(q, k, v, True)
+    ref = _ref(q, k, v, True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention_fwd(*a, True) ** 2),
+                  (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_ref(*a, True) ** 2), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        assert np.allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
