@@ -1,0 +1,362 @@
+// paddle_tpu native parameter-server core: sharded sparse embedding table
+// with in-table optimizers, plus a TCP pull/push service.
+//
+// TPU-native equivalent of the reference PS stack — brpc client/server
+// (paddle/fluid/distributed/service/brpc_ps_client.h, brpc_ps_server.h),
+// sparse tables (distributed/table/common_sparse_table.h, memory_dense_table)
+// and the GPU embedding-cache optimizers (framework/fleet/heter_ps/
+// optimizer.cuh.h): embeddings too large for HBM live in host DRAM sharded
+// across hosts; trainers PULL rows for a batch (gather -> dense staging,
+// transferred to the chip) and PUSH gradients (scatter-apply with the
+// table-resident optimizer). Transport is a length-prefixed TCP protocol —
+// the brpc replacement; sharding across servers is key-hash modulo, done by
+// the Python client layer.
+//
+// C ABI throughout (ctypes binding, no pybind).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 64;
+
+enum class Opt : int32_t { SGD = 0, ADAGRAD = 1 };
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;  // value (+accum)
+};
+
+struct Table {
+  int32_t dim = 0;
+  Opt opt = Opt::SGD;
+  float lr = 0.01f;
+  float init_range = 0.05f;
+  uint64_t seed = 0;
+  Shard shards[kShards];
+  std::atomic<int64_t> size{0};
+
+  size_t row_floats() const {
+    return opt == Opt::ADAGRAD ? 2 * (size_t)dim : (size_t)dim;
+  }
+
+  Shard& shard_of(int64_t key) {
+    return shards[(uint64_t)key % kShards];
+  }
+
+  std::vector<float>& lookup_init(int64_t key, Shard& sh) {
+    auto it = sh.rows.find(key);
+    if (it != sh.rows.end()) return it->second;
+    std::vector<float> row(row_floats(), 0.0f);
+    // deterministic per-key init (same row on every server restart)
+    std::mt19937_64 rng(seed ^ (uint64_t)key * 0x9E3779B97F4A7C15ull);
+    std::uniform_real_distribution<float> dist(-init_range, init_range);
+    for (int i = 0; i < dim; ++i) row[i] = dist(rng);
+    size.fetch_add(1);
+    return sh.rows.emplace(key, std::move(row)).first->second;
+  }
+
+  void pull(const int64_t* keys, int64_t n, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& sh = shard_of(keys[i]);
+      std::lock_guard<std::mutex> lk(sh.mu);
+      auto& row = lookup_init(keys[i], sh);
+      std::memcpy(out + i * dim, row.data(), dim * sizeof(float));
+    }
+  }
+
+  void push(const int64_t* keys, int64_t n, const float* grads) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& sh = shard_of(keys[i]);
+      std::lock_guard<std::mutex> lk(sh.mu);
+      auto& row = lookup_init(keys[i], sh);
+      const float* g = grads + i * dim;
+      if (opt == Opt::SGD) {
+        for (int d = 0; d < dim; ++d) row[d] -= lr * g[d];
+      } else {  // adagrad: accumulator stored after the value
+        float* acc = row.data() + dim;
+        for (int d = 0; d < dim; ++d) {
+          acc[d] += g[d] * g[d];
+          row[d] -= lr * g[d] / (std::sqrt(acc[d]) + 1e-8f);
+        }
+      }
+    }
+  }
+};
+
+// ---------------- TCP service ----------------
+// frame: u32 op (1=pull, 2=push, 3=stop) | u32 n | n*i64 keys |
+//        [push: n*dim f32 grads]; reply to pull: n*dim f32.
+
+bool read_all(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct Server {
+  Table* table;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<int64_t> keys;
+    std::vector<float> vals;
+    for (;;) {
+      uint32_t hdr[2];
+      if (!read_all(fd, hdr, sizeof(hdr))) break;
+      uint32_t op = hdr[0], n = hdr[1];
+      if (op == 3) break;
+      keys.resize(n);
+      if (!read_all(fd, keys.data(), n * sizeof(int64_t))) break;
+      if (op == 1) {
+        vals.resize((size_t)n * table->dim);
+        table->pull(keys.data(), n, vals.data());
+        if (!write_all(fd, vals.data(), vals.size() * sizeof(float))) break;
+      } else if (op == 2) {
+        vals.resize((size_t)n * table->dim);
+        if (!read_all(fd, vals.data(), vals.size() * sizeof(float))) break;
+        table->push(keys.data(), n, vals.data());
+        uint32_t ok = 0;
+        if (!write_all(fd, &ok, sizeof(ok))) break;
+      }
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)want_port);
+    if (::bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd, (sockaddr*)&addr, &len);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 64) != 0) return false;
+    acceptor = std::thread([this] {
+      while (!stop.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        std::lock_guard<std::mutex> lk(conns_mu);
+        conns.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return true;
+  }
+
+  void shutdown() {
+    stop.store(true);
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (acceptor.joinable()) acceptor.join();
+    std::lock_guard<std::mutex> lk(conns_mu);
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  int32_t dim = 0;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pskv_table_create(int32_t dim, int32_t opt, float lr, float init_range,
+                        uint64_t seed) {
+  auto* t = new (std::nothrow) Table();
+  if (!t) return nullptr;
+  t->dim = dim;
+  t->opt = (Opt)opt;
+  t->lr = lr;
+  t->init_range = init_range;
+  t->seed = seed;
+  return t;
+}
+
+void pskv_table_destroy(void* tp) { delete static_cast<Table*>(tp); }
+
+int64_t pskv_table_size(void* tp) {
+  return static_cast<Table*>(tp)->size.load();
+}
+
+void pskv_pull(void* tp, const int64_t* keys, int64_t n, float* out) {
+  static_cast<Table*>(tp)->pull(keys, n, out);
+}
+
+void pskv_push(void* tp, const int64_t* keys, int64_t n, const float* g) {
+  static_cast<Table*>(tp)->push(keys, n, g);
+}
+
+void pskv_set_lr(void* tp, float lr) { static_cast<Table*>(tp)->lr = lr; }
+
+int64_t pskv_save(void* tp, const char* path) {
+  auto* t = static_cast<Table*>(tp);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int64_t count = 0;
+  size_t rf = t->row_floats();
+  std::fwrite(&t->dim, sizeof(int32_t), 1, f);
+  int32_t opt = (int32_t)t->opt;
+  std::fwrite(&opt, sizeof(int32_t), 1, f);
+  for (auto& sh : t->shards) {
+    std::lock_guard<std::mutex> lk(sh.mu);
+    for (auto& kv : sh.rows) {
+      std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      std::fwrite(kv.second.data(), sizeof(float), rf, f);
+      ++count;
+    }
+  }
+  std::fclose(f);
+  return count;
+}
+
+int64_t pskv_load(void* tp, const char* path) {
+  auto* t = static_cast<Table*>(tp);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t dim = 0, opt = 0;
+  if (std::fread(&dim, sizeof(int32_t), 1, f) != 1 ||
+      std::fread(&opt, sizeof(int32_t), 1, f) != 1 ||
+      dim != t->dim || opt != (int32_t)t->opt) {
+    std::fclose(f);
+    return -1;
+  }
+  size_t rf = t->row_floats();
+  int64_t count = 0;
+  int64_t key;
+  std::vector<float> row(rf);
+  while (std::fread(&key, sizeof(int64_t), 1, f) == 1) {
+    if (std::fread(row.data(), sizeof(float), rf, f) != rf) break;
+    Shard& sh = t->shard_of(key);
+    std::lock_guard<std::mutex> lk(sh.mu);
+    if (sh.rows.emplace(key, row).second) t->size.fetch_add(1);
+    ++count;
+  }
+  std::fclose(f);
+  return count;
+}
+
+// ---- server ----
+void* pskv_serve(void* tp, int32_t port) {
+  auto* s = new Server();
+  s->table = static_cast<Table*>(tp);
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int32_t pskv_server_port(void* sp) { return static_cast<Server*>(sp)->port; }
+
+void pskv_server_stop(void* sp) {
+  auto* s = static_cast<Server*>(sp);
+  s->shutdown();
+  delete s;
+}
+
+// ---- client ----
+void* pskv_connect(const char* host, int32_t port, int32_t dim) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  c->dim = dim;
+  return c;
+}
+
+int32_t pskv_client_pull(void* cp, const int64_t* keys, int64_t n,
+                         float* out) {
+  auto* c = static_cast<Client*>(cp);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t hdr[2] = {1, (uint32_t)n};
+  if (!write_all(c->fd, hdr, sizeof(hdr))) return -1;
+  if (!write_all(c->fd, keys, n * sizeof(int64_t))) return -1;
+  if (!read_all(c->fd, out, (size_t)n * c->dim * sizeof(float))) return -1;
+  return 0;
+}
+
+int32_t pskv_client_push(void* cp, const int64_t* keys, int64_t n,
+                         const float* grads) {
+  auto* c = static_cast<Client*>(cp);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t hdr[2] = {2, (uint32_t)n};
+  if (!write_all(c->fd, hdr, sizeof(hdr))) return -1;
+  if (!write_all(c->fd, keys, n * sizeof(int64_t))) return -1;
+  if (!write_all(c->fd, grads, (size_t)n * c->dim * sizeof(float)))
+    return -1;
+  uint32_t ok;
+  if (!read_all(c->fd, &ok, sizeof(ok))) return -1;
+  return (int32_t)ok;
+}
+
+void pskv_client_close(void* cp) {
+  auto* c = static_cast<Client*>(cp);
+  uint32_t hdr[2] = {3, 0};
+  write_all(c->fd, hdr, sizeof(hdr));
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

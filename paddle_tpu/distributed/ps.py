@@ -1,0 +1,264 @@
+"""Parameter-server runtime (ctypes over csrc/pskv.cc).
+
+Reference analog: the brpc PS stack — `distributed/service/brpc_ps_client.h`
+/ `brpc_ps_server.h`, sparse tables `distributed/table/common_sparse_table.h`,
+the python runtime `fleet/runtime/the_one_ps.py`, and the pull/push sparse
+ops (`operators/pscore/`). TPU-native shape: dense compute runs on chips
+under GSPMD; only the huge embedding tables live host-side — trainers PULL
+the rows a batch touches into a dense staging array (host->HBM transfer),
+run the jitted step, and PUSH sparse grads back where the table-resident
+optimizer (SGD/Adagrad) applies them. Sharding across servers is
+key-hash modulo, handled here in the client.
+"""
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.native import _build_lib  # shares the build machinery pattern
+
+_lib = None
+_lib_lock = threading.Lock()
+
+OPT_SGD = 0
+OPT_ADAGRAD = 1
+_OPTS = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD}
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        import subprocess
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "csrc", "pskv.cc")
+        out_dir = os.path.join(os.path.dirname(src), "build")
+        os.makedirs(out_dir, exist_ok=True)
+        so = os.path.join(out_dir, "libpskv.so")
+        if (not os.path.exists(so) or
+                os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                            "-pthread", src, "-o", so + ".tmp"],
+                           check=True, capture_output=True)
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        lib.pskv_table_create.restype = ctypes.c_void_p
+        lib.pskv_table_create.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                          ctypes.c_float, ctypes.c_float,
+                                          ctypes.c_uint64]
+        lib.pskv_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.pskv_table_size.restype = ctypes.c_int64
+        lib.pskv_table_size.argtypes = [ctypes.c_void_p]
+        lib.pskv_pull.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64,
+                                  _f32p]
+        lib.pskv_push.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64,
+                                  _f32p]
+        lib.pskv_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+        lib.pskv_save.restype = ctypes.c_int64
+        lib.pskv_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pskv_load.restype = ctypes.c_int64
+        lib.pskv_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pskv_serve.restype = ctypes.c_void_p
+        lib.pskv_serve.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pskv_server_port.restype = ctypes.c_int32
+        lib.pskv_server_port.argtypes = [ctypes.c_void_p]
+        lib.pskv_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pskv_connect.restype = ctypes.c_void_p
+        lib.pskv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                     ctypes.c_int32]
+        lib.pskv_client_pull.restype = ctypes.c_int32
+        lib.pskv_client_pull.argtypes = [ctypes.c_void_p, _i64p,
+                                         ctypes.c_int64, _f32p]
+        lib.pskv_client_push.restype = ctypes.c_int32
+        lib.pskv_client_push.argtypes = [ctypes.c_void_p, _i64p,
+                                         ctypes.c_int64, _f32p]
+        lib.pskv_client_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _keys_arr(keys):
+    k = np.ascontiguousarray(np.asarray(keys, np.int64).ravel())
+    return k, k.ctypes.data_as(_i64p)
+
+
+class SparseTable:
+    """In-process sparse embedding table (the common_sparse_table analog)."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_range=0.05,
+                 seed=0):
+        self._lib = _load()
+        self.dim = dim
+        self.optimizer = optimizer
+        self._h = self._lib.pskv_table_create(
+            dim, _OPTS[optimizer], lr, init_range, seed)
+        if not self._h:
+            raise RuntimeError("table creation failed")
+
+    def pull(self, keys):
+        k, kp = _keys_arr(keys)
+        out = np.empty((k.size, self.dim), np.float32)
+        self._lib.pskv_pull(self._h, kp, k.size,
+                            out.ctypes.data_as(_f32p))
+        return out
+
+    def push(self, keys, grads):
+        k, kp = _keys_arr(keys)
+        g = np.ascontiguousarray(np.asarray(grads, np.float32)).reshape(
+            k.size, self.dim)
+        self._lib.pskv_push(self._h, kp, k.size, g.ctypes.data_as(_f32p))
+
+    def set_lr(self, lr):
+        self._lib.pskv_set_lr(self._h, float(lr))
+
+    def __len__(self):
+        return int(self._lib.pskv_table_size(self._h))
+
+    def save(self, path):
+        n = self._lib.pskv_save(self._h, path.encode())
+        if n < 0:
+            raise OSError(f"save failed: {path}")
+        return n
+
+    def load(self, path):
+        n = self._lib.pskv_load(self._h, path.encode())
+        if n < 0:
+            raise OSError(f"load failed or incompatible: {path}")
+        return n
+
+    def serve(self, port=0):
+        return PSServer(self, port)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pskv_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PSServer:
+    def __init__(self, table, port=0):
+        self._lib = table._lib
+        self.table = table  # keep alive
+        self._h = self._lib.pskv_serve(table._h, port)
+        if not self._h:
+            raise OSError("pskv server start failed")
+        self.port = int(self._lib.pskv_server_port(self._h))
+
+    def stop(self):
+        if self._h:
+            self._lib.pskv_server_stop(self._h)
+            self._h = None
+
+
+class PSClient:
+    """Sharded client: key k lives on server hash(k) % len(endpoints)
+    (the reference's table-shard routing, `brpc_ps_client.cc`)."""
+
+    def __init__(self, endpoints, dim):
+        self._lib = _load()
+        self.dim = dim
+        self._conns = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.pskv_connect(host.encode(), int(port), dim)
+            if not h:
+                raise OSError(f"cannot connect to ps server {ep}")
+            self._conns.append(h)
+
+    def _route(self, keys):
+        k = np.asarray(keys, np.int64).ravel()
+        ns = len(self._conns)
+        owner = (k % ns).astype(np.int64) if ns > 1 else np.zeros_like(k)
+        return k, owner
+
+    def pull(self, keys):
+        k, owner = self._route(keys)
+        out = np.empty((k.size, self.dim), np.float32)
+        for s, conn in enumerate(self._conns):
+            idx = np.nonzero(owner == s)[0]
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(k[idx])
+            buf = np.empty((sub.size, self.dim), np.float32)
+            rc = self._lib.pskv_client_pull(
+                conn, sub.ctypes.data_as(_i64p), sub.size,
+                buf.ctypes.data_as(_f32p))
+            if rc != 0:
+                raise OSError("pull RPC failed")
+            out[idx] = buf
+        return out
+
+    def push(self, keys, grads):
+        k, owner = self._route(keys)
+        g = np.ascontiguousarray(np.asarray(grads, np.float32)).reshape(
+            k.size, self.dim)
+        for s, conn in enumerate(self._conns):
+            idx = np.nonzero(owner == s)[0]
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(k[idx])
+            gb = np.ascontiguousarray(g[idx])
+            rc = self._lib.pskv_client_push(
+                conn, sub.ctypes.data_as(_i64p), sub.size,
+                gb.ctypes.data_as(_f32p))
+            if rc != 0:
+                raise OSError("push RPC failed")
+
+    def close(self):
+        for c in self._conns:
+            self._lib.pskv_client_close(c)
+        self._conns = []
+
+
+class DistributedEmbedding:
+    """Embedding whose rows live in a PS table. Forward pulls the touched
+    rows host-side and computes the lookup on-device; backward pushes the
+    dense per-row grads back (dedup + sum for repeated ids). The analog of
+    the reference's distributed lookup_table + push_sparse
+    (`operators/pscore/distributed_lookup_table_op.cc`)."""
+
+    def __init__(self, table_or_client, name="embedding"):
+        self.table = table_or_client
+        self.dim = table_or_client.dim
+        self.name = name
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+        from ..core import autograd
+
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor)
+                            else ids).astype(np.int64)
+        uniq, inverse = np.unique(ids_np.ravel(), return_inverse=True)
+        rows = self.table.pull(uniq)                      # [U, dim] host
+        rows_t = Tensor(jnp.asarray(rows), stop_gradient=False)
+        inv = jnp.asarray(inverse.reshape(ids_np.shape))
+
+        from ..core.tensor import apply
+        out = apply(lambda r: jnp.take(r, inv, axis=0), rows_t)
+
+        table = self.table
+
+        def push_hook(grad_rows):
+            table.push(uniq, np.asarray(grad_rows))
+
+        rows_t._ps_push = push_hook  # picked up by ps_step
+        self._last_rows = rows_t
+        self._last_uniq = uniq
+        return out
+
+    def apply_gradients(self):
+        """Push accumulated grads of the last forward (call after
+        backward())."""
+        rows_t = getattr(self, "_last_rows", None)
+        if rows_t is not None and rows_t.grad is not None:
+            self.table.push(self._last_uniq, rows_t.grad.numpy())
+            rows_t.grad = None
