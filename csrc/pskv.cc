@@ -105,6 +105,8 @@ struct Table {
 // frame: u32 op (1=pull, 2=push, 3=stop) | u32 n | n*i64 keys |
 //        [push: n*dim f32 grads]; reply to pull: n*dim f32.
 
+constexpr uint32_t kMaxFrameKeys = 1u << 24;  // 16M keys per frame
+
 bool read_all(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
   while (n) {
@@ -134,7 +136,14 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread acceptor;
   std::vector<std::thread> conns;
+  std::vector<int> conn_fds;  // live sockets, so stop can unblock recv()
   std::mutex conns_mu;
+
+  void forget_fd(int fd) {
+    std::lock_guard<std::mutex> lk(conns_mu);
+    for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it)
+      if (*it == fd) { conn_fds.erase(it); break; }
+  }
 
   void handle(int fd) {
     int one = 1;
@@ -146,6 +155,7 @@ struct Server {
       if (!read_all(fd, hdr, sizeof(hdr))) break;
       uint32_t op = hdr[0], n = hdr[1];
       if (op == 3) break;
+      if (n > kMaxFrameKeys) break;  // malformed/hostile frame
       keys.resize(n);
       if (!read_all(fd, keys.data(), n * sizeof(int64_t))) break;
       if (op == 1) {
@@ -160,6 +170,7 @@ struct Server {
         if (!write_all(fd, &ok, sizeof(ok))) break;
       }
     }
+    forget_fd(fd);
     ::close(fd);
   }
 
@@ -182,6 +193,7 @@ struct Server {
         int fd = ::accept(listen_fd, nullptr, nullptr);
         if (fd < 0) break;
         std::lock_guard<std::mutex> lk(conns_mu);
+        conn_fds.push_back(fd);
         conns.emplace_back([this, fd] { handle(fd); });
       }
     });
@@ -193,8 +205,16 @@ struct Server {
     ::shutdown(listen_fd, SHUT_RDWR);
     ::close(listen_fd);
     if (acceptor.joinable()) acceptor.join();
-    std::lock_guard<std::mutex> lk(conns_mu);
-    for (auto& t : conns)
+    std::vector<std::thread> to_join;
+    {
+      // unblock handlers stuck in recv() on live client connections (e.g.
+      // a client that died without sending the op=3 close frame), then
+      // join OUTSIDE the lock — handlers take conns_mu (forget_fd) to exit
+      std::lock_guard<std::mutex> lk(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      to_join.swap(conns);
+    }
+    for (auto& t : to_join)
       if (t.joinable()) t.join();
   }
 };

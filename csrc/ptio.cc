@@ -140,8 +140,11 @@ struct Loader {
 
   void worker_loop() {
     for (;;) {
-      int64_t b = next_batch.fetch_add(1);
-      if (b >= num_batches || stop.load()) return;
+      // claim a staging slot BEFORE claiming a batch index: every claimed
+      // batch then owns a slot, so the lowest outstanding seq (the one the
+      // consumer is waiting for — delivery is in seq order) always
+      // completes; claiming the index first could fill every slot with
+      // higher seqs and deadlock.
       Batch* slot = nullptr;
       {
         std::unique_lock<std::mutex> lk(mu);
@@ -149,6 +152,15 @@ struct Loader {
         if (stop.load()) return;
         slot = free_q.front();
         free_q.pop_front();
+      }
+      int64_t b = next_batch.fetch_add(1);
+      if (b >= num_batches || stop.load()) {
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          free_q.push_back(slot);
+        }
+        cv_free.notify_one();
+        return;
       }
       const int64_t begin = b * batch_size;
       const int64_t end = std::min(begin + batch_size, count);
@@ -311,11 +323,25 @@ int64_t ptio_loader_next(void* lp, void** out_ptrs, void** ticket) {
   if (L->delivered >= L->num_batches) return 0;
   Batch* b = nullptr;
   {
+    // deliver strictly in seq order so 'epochs reshuffle deterministically
+    // from seed + epoch' covers batch ORDER, not just contents, with
+    // num_threads > 1 (workers complete out of order)
     std::unique_lock<std::mutex> lk(L->mu);
-    L->cv_ready.wait(lk, [&] { return L->stop.load() || !L->ready_q.empty(); });
-    if (L->stop.load() && L->ready_q.empty()) return -1;
-    b = L->ready_q.front();
-    L->ready_q.pop_front();
+    const int64_t want = L->delivered;
+    L->cv_ready.wait(lk, [&] {
+      if (L->stop.load()) return true;
+      for (Batch* x : L->ready_q)
+        if (x->seq == want) return true;
+      return false;
+    });
+    for (auto it = L->ready_q.begin(); it != L->ready_q.end(); ++it) {
+      if ((*it)->seq == want) {
+        b = *it;
+        L->ready_q.erase(it);
+        break;
+      }
+    }
+    if (b == nullptr) return -1;  // stopped before the wanted batch arrived
   }
   L->delivered += 1;
   for (size_t d = 0; d < b->bufs.size(); ++d) out_ptrs[d] = b->bufs[d];

@@ -67,15 +67,31 @@ class Node:
 
     Analog of `imperative::OpBase` + GradOpNode (`imperative/op_base.h`) with
     the grad kernel replaced by a jax.vjp closure.
+
+    Gradient routing is keyed by each tensor's `_key` — a fresh object per
+    *value*, not per Tensor object — captured at record time. In-place ops
+    (`__setitem__`, `increment`, `reshape_`) give the mutated tensor a fresh
+    key, so cotangents for the pre- and post-mutation values route to the
+    right producers (the reference tracks the same hazard with
+    `TensorInplaceVersion`, `framework/tensor.h:77`).
     """
 
-    __slots__ = ("inputs", "outputs", "vjp_fn", "multi_output")
+    __slots__ = ("inputs", "outputs", "vjp_fn", "multi_output",
+                 "in_keys", "out_keys", "in_had_producer", "out_avals")
 
     def __init__(self, inputs, outputs, vjp_fn, multi_output):
         self.inputs = inputs          # tuple[Tensor]
         self.outputs = outputs        # tuple[Tensor]
         self.vjp_fn = vjp_fn
         self.multi_output = multi_output
+        self.in_keys = tuple(t._key for t in inputs)
+        self.out_keys = tuple(o._key for o in outputs)
+        self.in_had_producer = tuple(t._has_producer for t in inputs)
+        # record-time output avals: a later in-place mutation (reshape_) can
+        # change o._value's shape, but zero-cotangent fill must match the
+        # shape this node actually produced
+        self.out_avals = tuple((o._value.shape, o._value.dtype)
+                               for o in outputs)
 
 
 def record(node):
@@ -126,29 +142,31 @@ def backward(tensor, grad=None, retain_graph=False):
     else:
         seed = jnp.asarray(grad, dtype=tensor._value.dtype)
 
-    # pending cotangents for non-leaf tensors, keyed by identity
-    pending = {id(tensor): seed}
+    # pending cotangents for non-leaf values, keyed by tape key (per-value
+    # identity — survives in-place mutation of the Tensor object)
+    pending = {tensor._key: seed}
     if tensor._retain_grad or not tensor._has_producer:
         if not tensor.stop_gradient:
             tensor._accumulate_grad(seed)
 
     for node in reversed(_state.nodes):
-        if not any(id(o) in pending for o in node.outputs):
+        if not any(k in pending for k in node.out_keys):
             continue
         cots = []
-        for o in node.outputs:
-            c = pending.pop(id(o), None)
+        for (shape, dtype), k in zip(node.out_avals, node.out_keys):
+            c = pending.pop(k, None)
             if c is None:
-                c = jnp.zeros_like(o._value)
+                c = jnp.zeros(shape, dtype)
             cots.append(c)
         cot = tuple(cots) if node.multi_output else cots[0]
         in_grads = node.vjp_fn(cot)
-        for inp, g in zip(node.inputs, in_grads):
+        for inp, key, had_producer, g in zip(
+                node.inputs, node.in_keys, node.in_had_producer, in_grads):
             if inp.stop_gradient or g.dtype == float0:
                 continue
-            if inp._has_producer:
-                prev = pending.get(id(inp))
-                pending[id(inp)] = g if prev is None else prev + g
+            if had_producer:
+                prev = pending.get(key)
+                pending[key] = g if prev is None else prev + g
                 if inp._retain_grad:
                     inp._accumulate_grad(g)
             else:
@@ -179,37 +197,41 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
     for o, g in zip(outputs, grad_outputs):
         seed = jnp.ones_like(o._value) if g is None else (
             g._value if isinstance(g, Tensor) else jnp.asarray(g))
-        prev = pending.get(id(o))
-        pending[id(o)] = seed if prev is None else prev + seed
+        prev = pending.get(o._key)
+        pending[o._key] = seed if prev is None else prev + seed
 
+    # wanted is keyed by Tensor OBJECT identity: grads are w.r.t. the input
+    # tensor as the graph consumed it, even if it was mutated in-place after
+    # the forward pass
     wanted = {id(t): i for i, t in enumerate(inputs)}
     results = [None] * len(inputs)
 
-    def _stash(t, g):
-        i = wanted.get(id(t))
+    def _stash(obj_id, g):
+        i = wanted.get(obj_id)
         if i is not None:
             results[i] = g if results[i] is None else results[i] + g
 
     for o in outputs:
         if id(o) in wanted:
-            _stash(o, pending[id(o)])
+            _stash(id(o), pending[o._key])
 
     for node in reversed(_state.nodes):
-        if not any(id(o) in pending for o in node.outputs):
+        if not any(k in pending for k in node.out_keys):
             continue
         cots = []
-        for o in node.outputs:
-            c = pending.pop(id(o), None)
-            cots.append(jnp.zeros_like(o._value) if c is None else c)
+        for (shape, dtype), k in zip(node.out_avals, node.out_keys):
+            c = pending.pop(k, None)
+            cots.append(jnp.zeros(shape, dtype) if c is None else c)
         cot = tuple(cots) if node.multi_output else cots[0]
         in_grads = node.vjp_fn(cot)
-        for inp, g in zip(node.inputs, in_grads):
+        for inp, key, had_producer, g in zip(
+                node.inputs, node.in_keys, node.in_had_producer, in_grads):
             if inp.stop_gradient or g.dtype == float0:
                 continue
-            if inp._has_producer:
-                prev = pending.get(id(inp))
-                pending[id(inp)] = g if prev is None else prev + g
-            _stash(inp, g)
+            if had_producer:
+                prev = pending.get(key)
+                pending[key] = g if prev is None else prev + g
+            _stash(id(inp), g)
 
     if not retain_graph:
         clear_tape()

@@ -26,7 +26,7 @@ _tensor_method_registry = {}
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
                  "_has_producer", "_retain_grad", "trainable", "is_distributed",
-                 "__weakref__", "__dict__")
+                 "_key", "__weakref__", "__dict__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None,
                  place=None):
@@ -53,6 +53,10 @@ class Tensor:
         self.is_distributed = False
         self._has_producer = False
         self._retain_grad = False
+        # per-VALUE tape identity: refreshed by in-place mutation so autograd
+        # routes cotangents to the right version (the reference's
+        # TensorInplaceVersion counter, `framework/tensor.h:77`)
+        self._key = object()
 
     # ---- metadata -------------------------------------------------------
     @property
@@ -176,6 +180,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
         self._value = value
+        self._key = object()
         return self
 
     def copy_(self, other):
@@ -210,9 +215,41 @@ class Tensor:
 
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)
-        if isinstance(value, Tensor):
-            value = value._value
-        self._value = self._value.at[idx].set(value)
+        vt = value if isinstance(value, Tensor) else None
+        requires = autograd.grad_enabled() and (
+            not self.stop_gradient or (vt is not None and not vt.stop_gradient))
+        if not requires:
+            if vt is not None:
+                value = vt._value
+            self._value = self._value.at[idx].set(value)
+            self._key = object()
+            return self
+        if vt is None:
+            vt = Tensor(value)
+        # recorded scatter: grad w.r.t. the old value is zeroed at idx, grad
+        # w.r.t. the assigned value is the cotangent gathered at idx
+        return self._inplace_apply(
+            lambda v, u: v.at[idx].set(u.astype(v.dtype)), vt)
+
+    def _inplace_apply(self, fn, *others):
+        """In-place update self._value = fn(old_value, *other_values), recorded
+        on the tape. The node's input key is self's pre-mutation key (earlier
+        producers still receive the old value's cotangent); self then gets a
+        fresh key as the node's sole output."""
+        vals = (self._value,) + tuple(t._value for t in others)
+        requires = autograd.grad_enabled() and any(
+            not t.stop_gradient for t in (self,) + others)
+        if not requires:
+            self._value = fn(*vals)
+            self._key = object()
+            return self
+        new_val, vjp_fn = jax.vjp(fn, *vals)
+        node = autograd.Node((self,) + others, (self,), vjp_fn, False)
+        self._key = object()          # post-mutation value identity
+        node.out_keys = (self._key,)
+        autograd.record(node)
+        self._value = new_val
+        self.stop_gradient = False
         return self
 
     def __iter__(self):

@@ -64,7 +64,9 @@ class Model:
 
     def _split_batch(self, batch):
         batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
-        if self._loss is None or len(batch) == 1:
+        # split labels off whenever anything will consume them — a loss OR
+        # metrics (metrics-only evaluation is supported, hapi/model.py ref)
+        if (self._loss is None and not self._metrics) or len(batch) == 1:
             return batch, []
         n_lab = max(1, len(self._labels_spec)) if self._labels_spec else 1
         return batch[:-n_lab], batch[-n_lab:]
@@ -101,9 +103,9 @@ class Model:
             # accumulation in progress.
             outs = self.network(*inputs)
             loss = self._loss_value(outs, labels)
-            if loss_scale != 1.0:
-                loss = loss * loss_scale
-            loss.backward()
+            # backprop the scaled loss (so accumulated grads average), but
+            # report the true micro-batch loss to callbacks/logs
+            (loss * loss_scale if loss_scale != 1.0 else loss).backward()
             if update:
                 self._optimizer.step()
                 self._optimizer.clear_grad()

@@ -41,7 +41,7 @@ def _composed_attention(q, k, v, bias=None, causal=False, scale=None,
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
-def _use_pallas(q, force=None):
+def _use_pallas(q, force=None, k=None):
     """Kernel dispatch. The Pallas blockwise kernel (bf16 MXU dots, 512
     tiles) beats XLA's fused attention from s=1024 up on v5e (measured
     full-GPT step: 94ms vs 131ms at s=1024; 9x at s=8192 where composed
@@ -51,6 +51,11 @@ def _use_pallas(q, force=None):
         return False
     b, s, n, h = q.shape
     shapes_ok = s % 128 == 0 and h in (64, 128, 256) and s >= 256
+    if k is not None:
+        # cross-attention / unpadded KV: the kernel's tiling contract needs
+        # the KV sequence 128-aligned and at least one block long too
+        sk = k.shape[1]
+        shapes_ok = shapes_ok and sk % 128 == 0 and sk >= 256
     if force is not None:
         return force and shapes_ok
     return shapes_ok and s >= 1024
@@ -71,7 +76,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         dropout_key = next_key()
 
     def fn(q, k, v):
-        if _use_pallas(q, use_pallas) and dropout == 0.0:
+        if _use_pallas(q, use_pallas, k=k) and dropout == 0.0:
             from .pallas_attention import flash_attention_fwd
             return flash_attention_fwd(q, k, v, causal=causal)
         return _composed_attention(q, k, v, causal=causal,
@@ -95,7 +100,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     if attn_mask is None:
         def fn(q, k, v):
-            if _use_pallas(q) and dropout_p == 0.0:
+            if _use_pallas(q, k=k) and dropout_p == 0.0:
                 from .pallas_attention import flash_attention_fwd
                 return flash_attention_fwd(q, k, v, causal=is_causal)
             return _composed_attention(

@@ -133,7 +133,7 @@ def _ulysses_inner(ql, kl, vl, *, causal, scale, axis_name):
     from .attention import _composed_attention
     from .pallas_attention import flash_attention_fwd
     from .attention import _use_pallas
-    if _use_pallas(qh):
+    if _use_pallas(qh, k=kh):
         out = flash_attention_fwd(qh, kh, vh, causal, scale)
     else:
         out = _composed_attention(qh, kh, vh, causal=causal, scale=scale)

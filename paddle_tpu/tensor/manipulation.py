@@ -35,8 +35,8 @@ def reshape(x, shape, name=None):
 
 def reshape_(x, shape, name=None):
     x = ensure_tensor(x)
-    x._value = jnp.reshape(x._value, shape_arg(shape))
-    return x
+    shp = shape_arg(shape)
+    return x._inplace_apply(lambda v: jnp.reshape(v, shp))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):

@@ -199,8 +199,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 def increment(x, value=1.0, name=None):
     x = ensure_tensor(x)
-    x._value = x._value + value
-    return x
+    return x._inplace_apply(lambda v: v + value)
 
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):

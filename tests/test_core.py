@@ -198,3 +198,43 @@ class TestRandom:
     def test_randperm(self):
         p = paddle.randperm(10).numpy()
         assert sorted(p.tolist()) == list(range(10))
+
+
+def test_setitem_records_gradients():
+    """In-place __setitem__ must route grads: the assigned value receives
+    the cotangent at the written slots; the overwritten region's upstream
+    grad is zeroed (reference tracks this with TensorInplaceVersion,
+    `framework/tensor.h:77`)."""
+    x = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    v = paddle.to_tensor(np.full((3, 2), 5.0, np.float32),
+                         stop_gradient=False)
+    y = x * 2.0
+    y[0] = v[0] * 3.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0, 0], [2, 2], [2, 2]])
+    np.testing.assert_allclose(v.grad.numpy(), [[3, 3], [0, 0], [0, 0]])
+
+
+def test_setitem_on_leaf_grad():
+    a = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.full((2,), 2.0, np.float32),
+                         stop_gradient=False)
+    a[1:3] = b * 2.0
+    (a * a).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2, 0, 0, 2])
+    np.testing.assert_allclose(b.grad.numpy(), [16, 16])
+
+
+def test_increment_inplace_grad_passthrough():
+    c = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    d = c * 3.0
+    paddle.increment(d, 1.0)
+    d.sum().backward()
+    np.testing.assert_allclose(c.grad.numpy(), [3, 3])
+
+
+def test_setitem_no_grad_is_plain_scatter():
+    a = paddle.to_tensor(np.zeros((3,), np.float32))
+    a[1] = 7.0
+    np.testing.assert_allclose(a.numpy(), [0, 7, 0])

@@ -183,3 +183,41 @@ def test_num_iters_limits_training():
     m.train_batch = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
     m.fit(ds, batch_size=16, epochs=3, verbose=0, num_iters=2)
     assert len(calls) == 2
+
+
+def test_metrics_only_evaluate():
+    """evaluate() with metrics but no loss must still split labels off the
+    batch (reference hapi supports metrics-only evaluation)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.metric import Accuracy
+
+    net = nn.Linear(4, 3)
+    model = hapi.Model(net)
+    model.prepare(metrics=Accuracy())
+    xs = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 3, (8, 1)).astype(np.int64)
+    res = model.evaluate([(xs, ys)], verbose=0)
+    assert "acc" in res
+
+
+def test_accumulation_logs_unscaled_loss():
+    """train_batch under gradient accumulation must report the true
+    micro-batch loss, not the 1/accum-scaled one."""
+    rs = np.random.RandomState(0)
+    xs = rs.rand(4, 4).astype(np.float32)
+    ys = rs.rand(4, 1).astype(np.float32)
+
+    def make():
+        paddle.seed(7)
+        net = nn.Linear(4, 1)
+        m = hapi.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+                      learning_rate=0.0, parameters=net.parameters()),
+                  loss=nn.MSELoss())
+        return m
+
+    m1, m2 = make(), make()
+    full = m1.train_batch([xs], [ys])[0]
+    scaled = m2.train_batch([xs], [ys], update=False, loss_scale=0.25)[0]
+    np.testing.assert_allclose(np.asarray(full), np.asarray(scaled),
+                               rtol=1e-5)
