@@ -324,12 +324,43 @@ def apply(fn, *tensors):
         outs = fn(*vals)
     multi = isinstance(outs, (tuple, list))
     out_list = list(outs) if multi else [outs]
+    if _debug_flags_on():
+        _debug_check(fn, out_list)
     wrapped = [Tensor(o, stop_gradient=not requires) for o in out_list]
     if requires:
         autograd.record(autograd.Node(tensors, tuple(wrapped), vjp_fn, multi))
     if _capture_stack:
         _capture_stack[-1].record_op(fn, tensors, tuple(wrapped), multi)
     return wrapped if multi else wrapped[0]
+
+
+def _debug_flags_on():
+    from .. import flags
+    return flags.get_flag("check_nan_inf") or flags.get_flag("benchmark")
+
+
+def _debug_check(fn, out_list):
+    """Per-op debug hooks, gated on runtime flags (both force host sync on
+    concrete values — that is the point of the modes). Analog of the
+    reference's FLAGS_check_nan_inf op-output scan
+    (`framework/details/nan_inf_utils_detail.cc:1`) and FLAGS_benchmark."""
+    from .. import flags
+    for o in out_list:
+        if isinstance(o, jax.core.Tracer):
+            continue  # under jit tracing: TrainStep owns the compiled check
+        if flags.get_flag("benchmark") and isinstance(o, jax.Array):
+            o.block_until_ready()
+        if (flags.get_flag("check_nan_inf") and isinstance(o, jax.Array)
+                and jnp.issubdtype(o.dtype, jnp.floating)):
+            if not bool(jnp.isfinite(o).all()):
+                op = getattr(fn, "__qualname__", None) or repr(fn)
+                msg = (f"check_nan_inf: op {op} produced a non-finite "
+                       f"output (shape={tuple(o.shape)}, dtype={o.dtype})")
+                if flags.get_flag("check_nan_inf_level") >= 1:
+                    import warnings
+                    warnings.warn(msg)
+                else:
+                    raise FloatingPointError(msg)
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

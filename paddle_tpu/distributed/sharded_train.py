@@ -74,8 +74,10 @@ class ShardedTrainStep:
         self.optimizer = optimizer
         self.zero_stage = zero_stage
         self.seq_shard = seq_shard_batch
-        self.params = [p for _, p in model.named_parameters()
-                       if not p.stop_gradient]
+        named = [(n, p) for n, p in model.named_parameters()
+                 if not p.stop_gradient]
+        self.param_names = [n for n, _ in named]
+        self.params = [p for _, p in named]
         self.buffers = [b for _, b in model.named_buffers() if b is not None]
         for p in self.params:
             self.optimizer._get_state(p)
@@ -97,7 +99,7 @@ class ShardedTrainStep:
                 st[k] = jax.device_put(
                     v, sh if v.shape == tuple(p._value.shape) else rep)
 
-    def _make_step(self):
+    def _make_step(self, check_nan_inf=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
         mesh = self.mesh
@@ -122,6 +124,15 @@ class ShardedTrainStep:
                 autograd.backward(loss)
                 grads = [p.grad._value if p.grad is not None
                          else jnp.zeros_like(p._value) for p in params]
+                # compiled FLAGS_check_nan_inf (the eager per-op scan can't
+                # see inside the pjit'd step); a poisoned step keeps old
+                # params/opt-state (the inputs are donated)
+                checks = None
+                if check_nan_inf:
+                    checks = (jnp.isfinite(loss._value).all(),
+                              jnp.stack([jnp.all(jnp.isfinite(g))
+                                         for g in grads])
+                              if grads else jnp.ones((0,), jnp.bool_))
                 with autograd.no_grad():
                     if opt._grad_clip is not None:
                         pg = opt._grad_clip(
@@ -129,25 +140,35 @@ class ShardedTrainStep:
                         grads = [g._value for _, g in pg]
                     new_vals, new_states = opt._functional_apply(
                         params, param_vals, grads, opt_states, lr)
+                if check_nan_inf:
+                    ok = jnp.logical_and(checks[0], jnp.all(checks[1]))
+                    new_vals = [jnp.where(ok, n, o)
+                                for n, o in zip(new_vals, param_vals)]
+                    new_states = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o),
+                        new_states, opt_states)
                 new_buf = [b._value for b in buffers]
-                return loss._value, new_vals, new_states, new_buf
+                return loss._value, new_vals, new_states, new_buf, checks
 
         in_sh = (param_sh, state_sh, buf_sh, rep, rep, None)
-        out_sh = (rep, param_sh, state_sh, buf_sh)
+        out_sh = (rep, param_sh, state_sh, buf_sh, None)
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
     def __call__(self, *batch):
-        if self._jitted is None:
-            self._jitted = self._make_step()
+        from ..flags import get_flag
+        check = get_flag("check_nan_inf")
+        if self._jitted is None or getattr(self, "_check_key", None) != check:
+            self._jitted = self._make_step(check_nan_inf=check)
+            self._check_key = check
         batch_vals = shard_batch(batch, self.mesh, self.seq_shard)
         param_vals = [p._value for p in self.params]
         opt_states = [self.optimizer._states[id(p)] for p in self.params]
         buffer_vals = [b._value for b in self.buffers]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
-        loss, new_vals, new_states, new_buf = self._jitted(
+        loss, new_vals, new_states, new_buf, checks = self._jitted(
             param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
         for p, v in zip(self.params, new_vals):
             p._value = v
@@ -156,4 +177,7 @@ class ShardedTrainStep:
             self.optimizer._states[id(p)] = s
         for b, v in zip(self.buffers, new_buf):
             b._value = v
+        if checks is not None:
+            from ..jit import TrainStep
+            TrainStep._report_non_finite(self, checks)
         return Tensor(loss)

@@ -53,37 +53,10 @@ NPUPlace = TPUPlace
 # flags registry — analog of PADDLE_DEFINE_EXPORTED gflags (flags.cc)
 # ---------------------------------------------------------------------------
 
-_FLAGS = {
-    "FLAGS_check_nan_inf": False,
-    "FLAGS_benchmark": False,
-    "FLAGS_default_dtype": "float32",
-    "FLAGS_use_donated_buffers": True,
-    "FLAGS_jit_cache_dir": "",
-    "FLAGS_profile": False,
-    "FLAGS_allocator_strategy": "xla",
-    "FLAGS_cudnn_deterministic": False,
-    "FLAGS_embedding_deterministic": False,
-    "FLAGS_max_inplace_grad_add": 0,
-}
-
-for _k in list(_FLAGS):
-    if _k in os.environ:
-        _v = os.environ[_k]
-        if isinstance(_FLAGS[_k], bool):
-            _FLAGS[_k] = _v.lower() in ("1", "true", "yes")
-        else:
-            _FLAGS[_k] = type(_FLAGS[_k])(_v)
-
-
-def get_flags(flags):
-    if isinstance(flags, str):
-        flags = [flags]
-    return {f: _FLAGS.get(f) for f in flags}
-
-
-def set_flags(flags):
-    for k, v in flags.items():
-        _FLAGS[k] = v
+# runtime flags live in paddle_tpu.flags (the gflags-registry analog,
+# `platform/flags.cc:48`); re-exported here for the paddle.{get,set}_flags
+# call sites
+from .flags import get_flags, set_flags  # noqa: E402,F401
 
 
 def core_avx_supported():

@@ -151,7 +151,10 @@ class NativeDataLoader:
     """
 
     def __init__(self, paths, batch_size, shuffle=False, seed=0,
-                 num_threads=4, capacity=8, drop_last=True, copy=False):
+                 num_threads=4, capacity=None, drop_last=True, copy=False):
+        if capacity is None:
+            from ..flags import get_flag
+            capacity = max(2, get_flag("io_prefetch_capacity"))
         self._lib = _load()
         paths = [paths] if isinstance(paths, str) else list(paths)
         self.datasets = [RecordDataset(p) for p in paths]

@@ -200,15 +200,17 @@ class TrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
-        self.params = [p for _, p in model.named_parameters()
-                       if not p.stop_gradient]
+        named = [(n, p) for n, p in model.named_parameters()
+                 if not p.stop_gradient]
+        self.param_names = [n for n, _ in named]
+        self.params = [p for _, p in named]
         self.buffers = [b for _, b in model.named_buffers() if b is not None]
         for p in self.params:
             self.optimizer._get_state(p)
         self._jitted = None
         self._donate = donate
 
-    def _make_step(self):
+    def _make_step(self, check_nan_inf=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
 
@@ -223,6 +225,15 @@ class TrainStep:
                 for p in params:
                     grads.append(p.grad._value if p.grad is not None
                                  else jnp.zeros_like(p._value))
+                # compiled FLAGS_check_nan_inf analog: the per-op eager scan
+                # can't see inside a fused step, so check loss + every grad
+                # here (costs one tiny all-reduce per tensor, flag-gated)
+                checks = None
+                if check_nan_inf:
+                    checks = (jnp.isfinite(loss._value).all(),
+                              jnp.stack([jnp.all(jnp.isfinite(g))
+                                         for g in grads])
+                              if grads else jnp.ones((0,), jnp.bool_))
                 with autograd.no_grad():
                     if opt._grad_clip is not None:
                         pg = opt._grad_clip(
@@ -230,18 +241,31 @@ class TrainStep:
                         grads = [g._value for _, g in pg]
                     new_vals, new_states = opt._functional_apply(
                         params, param_vals, grads, opt_states, lr)
+                if check_nan_inf:
+                    # a poisoned step must not be applied: keep the old
+                    # params/opt-state when anything was non-finite (the old
+                    # buffers are donated, so the select must happen on
+                    # device inside this program)
+                    ok = jnp.logical_and(checks[0], jnp.all(checks[1]))
+                    new_vals = [jnp.where(ok, n, o)
+                                for n, o in zip(new_vals, param_vals)]
+                    new_states = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o),
+                        new_states, opt_states)
                 new_buf = [b._value for b in buffers]
-                return loss._value, new_vals, new_states, new_buf
+                return loss._value, new_vals, new_states, new_buf, checks
 
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
         from ..amp import amp_state
+        from .. import flags
         st = amp_state()
-        amp_key = (st.enabled, str(st.dtype) if st.enabled else "")
+        check = flags.get_flag("check_nan_inf")
+        amp_key = (st.enabled, str(st.dtype) if st.enabled else "", check)
         if self._jitted is None or getattr(self, "_amp_key", None) != amp_key:
-            self._jitted = self._make_step()
+            self._jitted = self._make_step(check_nan_inf=check)
             self._amp_key = amp_key
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
@@ -250,8 +274,11 @@ class TrainStep:
         buffer_vals = [b._value for b in self.buffers]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
-        loss, new_vals, new_states, new_buf = self._jitted(
+        loss, new_vals, new_states, new_buf, checks = self._jitted(
             param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        # reassign state FIRST: the inputs were donated, so the tensors must
+        # point at the fresh buffers even when the finite check fires (the
+        # step itself was skipped on device in that case)
         for p, v in zip(self.params, new_vals):
             p._value = v
             p.grad = None
@@ -259,7 +286,29 @@ class TrainStep:
             self.optimizer._states[id(p)] = s
         for b, v in zip(self.buffers, new_buf):
             b._value = v
+        if checks is not None:
+            self._report_non_finite(checks)
         return Tensor(loss)
+
+    def _report_non_finite(self, checks):
+        loss_ok, grads_ok = checks
+        grads_ok = np.asarray(grads_ok)
+        if bool(loss_ok) and bool(grads_ok.all()):
+            return
+        bad = [n for n, ok in zip(self.param_names, grads_ok) if not ok]
+        msg = ("check_nan_inf: train step produced non-finite "
+               + " and ".join(
+                   (["loss"] if not bool(loss_ok) else [])
+                   + ([f"grads for {bad[:8]}"
+                       + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else "")]
+                      if bad else []))
+               + "; the update was skipped")
+        from ..flags import get_flag
+        if get_flag("check_nan_inf_level") >= 1:
+            import warnings
+            warnings.warn(msg)
+        else:
+            raise FloatingPointError(msg)
 
 
 def save(layer, path, input_spec=None, **configs):

@@ -106,19 +106,26 @@ class GPTAttention(Layer):
         return (mesh is not None and "sp" in mesh.axis_names and
                 mesh.shape["sp"] > 1)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, offset=None):
+        """cache: optional (k_buf, v_buf) Tensors of FIXED shape
+        [b, max_len, n, h]; offset: scalar int Tensor/int — how many cache
+        positions are already filled. Fixed-size buffers +
+        `lax.dynamic_update_slice` keep decode shapes static so XLA compiles
+        the step once (the TPU answer to the reference's growing-concat
+        decode caches, `fluid/layers/rnn.py:1583` dynamic_decode)."""
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
         if cache is not None:
-            from ..tensor.manipulation import concat
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
-            new_cache = (k, v)
-        else:
-            new_cache = None
-        if self._sp_active() and cache is None:
+            off = offset if isinstance(offset, Tensor) else \
+                Tensor(jnp.asarray(0 if offset is None else offset,
+                                   jnp.int32))
+            out, k_buf, v_buf = apply(_cached_attention, q, k, v,
+                                      cache[0], cache[1], off)
+            out = reshape(out, [b, s, self.hidden_size])
+            return self.out_proj(out), (k_buf, v_buf)
+        if self._sp_active():
             from ..ops.ring_attention import ring_attention, ulysses_attention
             attn = ring_attention if self.sequence_parallel == "ring" \
                 else ulysses_attention
@@ -129,10 +136,29 @@ class GPTAttention(Layer):
                                   use_pallas=None if self.use_flash
                                   else False)
         out = reshape(out, [b, s, self.hidden_size])
-        out = self.out_proj(out)
-        if new_cache is not None:
-            return out, new_cache
-        return out
+        return self.out_proj(out)
+
+
+def _cached_attention(q, k_new, v_new, k_buf, v_buf, off):
+    """Incremental-decode attention on raw values: write k/v at `off`, attend
+    q (s tokens at positions off..off+s) over the valid prefix via masking.
+    O(max_len) per step — the standard KV-cache decode cost."""
+    import jax
+    b, s, n, h = q.shape
+    L = k_buf.shape[1]
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k_new.astype(k_buf.dtype), (0, off, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v_new.astype(v_buf.dtype), (0, off, 0, 0))
+    scale = 1.0 / math.sqrt(h)
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k_buf.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
+    q_pos = (off + jnp.arange(s, dtype=jnp.int32))[None, None, :, None]
+    logits = jnp.where(key_pos <= q_pos, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v_buf.astype(q.dtype))
+    return out, k_buf, v_buf
 
 
 class GPTMLP(Layer):
@@ -161,7 +187,12 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, offset=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache, offset=offset)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln2(x)))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return x
@@ -180,13 +211,35 @@ class GPTModel(Layer):
         self.blocks = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
         self.ln_f = LayerNorm(c.hidden_size)
 
-    def forward(self, input_ids, position_ids=None):
+    def init_cache(self, batch_size, max_len, dtype=None):
+        """Fixed-shape KV buffers, one (k, v) pair per block."""
+        c = self.config
+        dt = dtype or c.dtype
+        shape = (batch_size, max_len, c.num_heads,
+                 c.hidden_size // c.num_heads)
+        return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
+                for _ in self.blocks]
+
+    def forward(self, input_ids, position_ids=None, caches=None, offset=None):
         b, s = input_ids.shape[0], input_ids.shape[1]
         if position_ids is None:
-            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+            if caches is not None and offset is not None:
+                off = offset if isinstance(offset, Tensor) else \
+                    Tensor(jnp.asarray(offset, jnp.int32))
+                position_ids = apply(
+                    lambda o: (o + jnp.arange(s, dtype=jnp.int32))[None, :],
+                    off)
+            else:
+                position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         h = self.wte(input_ids) + self.wpe(position_ids)
         h = self.drop(h)
         h = _shard_activation(h)
+        if caches is not None:
+            new_caches = []
+            for block, cache in zip(self.blocks, caches):
+                h, nc = block(h, cache=cache, offset=offset)
+                new_caches.append(nc)
+            return self.ln_f(h), new_caches
         for block in self.blocks:
             h = block(h)
             h = _shard_activation(h)
@@ -222,8 +275,12 @@ class GPTForPretraining(Layer):
         self.gpt = GPTModel(config)
         self.config = config
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None, offset=None):
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, position_ids, caches=caches,
+                                     offset=offset)
+        else:
+            h = self.gpt(input_ids, position_ids)
         w = self.gpt.wte.weight
         from ..amp import maybe_cast_to_compute as _amp
 
@@ -233,7 +290,30 @@ class GPTForPretraining(Layer):
             return jnp.einsum("bsd,vd->bsv", _amp(hh), _amp(ww),
                               preferred_element_type=jnp.float32)
         logits = apply(head, h, w)
+        if caches is not None:
+            return logits, new_caches
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, decode_strategy="greedy",
+                 top_k=0, top_p=1.0, temperature=1.0, num_beams=1,
+                 length_penalty=0.0, eos_token_id=None, pad_token_id=0,
+                 seed=None):
+        """Autoregressive decoding with a static KV cache, compiled to a
+        single XLA program (prefill + `lax.while_loop` decode). Analog of
+        the reference's dynamic_decode/BeamSearchDecoder
+        (`fluid/layers/rnn.py:866,1583`, `operators/beam_search_op.cc:1`).
+
+        decode_strategy: "greedy" | "sampling" (top_k/top_p/temperature) |
+        "beam_search" (num_beams, length_penalty).
+        Returns (ids Tensor [b, prompt+max_new], scores Tensor [b]).
+        """
+        from ..generation import run_generate
+        return run_generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            decode_strategy=decode_strategy, top_k=top_k, top_p=top_p,
+            temperature=temperature, num_beams=num_beams,
+            length_penalty=length_penalty, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, seed=seed)
 
     def loss(self, input_ids, labels, loss_mask=None):
         logits = self(input_ids)

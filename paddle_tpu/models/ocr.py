@@ -7,6 +7,7 @@ re-layouts for the MXU), scan-compiled BiLSTM, in-framework CTC
 (`nn/functional/loss.py ctc_loss`) — no warpctc, no cudnn RNN descriptors.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, apply
@@ -121,6 +122,63 @@ def ctc_greedy_decode(logits, blank=0):
                 out.append(int(t))
             prev = t
         results.append(out)
+    return results
+
+
+def ctc_beam_search_decode(logits, beam_size=10, blank=0):
+    """CTC prefix beam search (`operators/beam_search_op.cc:1` capability for
+    the CRNN path; algorithm of Hannun et al. 2014). [B, T, C] logits ->
+    list of (label sequence, log prob) — the best prefix per batch item,
+    marginalized over alignments (which greedy cannot do).
+
+    Host-side numpy: CTC beam decode is inherently dict-of-prefixes
+    sequential work, the standard post-processing placement (the reference
+    runs it on host through its C++ op too).
+    """
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(
+        logits._value if isinstance(logits, Tensor) else logits,
+        jnp.float32), axis=-1))
+
+    def lse(*xs):
+        m = max(xs)
+        if m == -np.inf:
+            return -np.inf
+        return m + np.log(sum(np.exp(x - m) for x in xs))
+
+    results = []
+    for b in range(lp.shape[0]):
+        # prefix -> (log p ending in blank, log p ending in non-blank)
+        beams = {(): (0.0, -np.inf)}
+        for t in range(lp.shape[1]):
+            row = lp[b, t]
+            # candidate set depends only on the frame, not the prefix
+            cands = np.argpartition(-row, min(beam_size, len(row) - 1)
+                                    )[:beam_size]
+            new = {}
+
+            def add(prefix, pb, pnb):
+                opb, opnb = new.get(prefix, (-np.inf, -np.inf))
+                new[prefix] = (lse(opb, pb), lse(opnb, pnb))
+
+            for prefix, (pb, pnb) in beams.items():
+                # extend with blank
+                add(prefix, lse(pb, pnb) + row[blank], -np.inf)
+                # repeat last symbol (only the non-blank path merges)
+                if prefix:
+                    add(prefix, -np.inf, pnb + row[prefix[-1]])
+                for c in cands:
+                    c = int(c)
+                    if c == blank:
+                        continue
+                    if prefix and c == prefix[-1]:
+                        # after a blank only: p_b extends a repeated symbol
+                        add(prefix + (c,), -np.inf, pb + row[c])
+                    else:
+                        add(prefix + (c,), -np.inf, lse(pb, pnb) + row[c])
+            beams = dict(sorted(new.items(), key=lambda kv: -lse(*kv[1])
+                                )[:beam_size])
+        best, (pb, pnb) = max(beams.items(), key=lambda kv: lse(*kv[1]))
+        results.append((list(best), float(lse(pb, pnb))))
     return results
 
 

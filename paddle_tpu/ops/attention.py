@@ -47,6 +47,9 @@ def _use_pallas(q, force=None, k=None):
     full-GPT step: 94ms vs 131ms at s=1024; 9x at s=8192 where composed
     materializes the O(s^2) probability tensor). Below that the composed
     path's single fusion wins on launch overhead."""
+    from ..flags import get_flag
+    if not get_flag("use_pallas_attention"):
+        return False
     if jax.default_backend() != "tpu":
         return False
     b, s, n, h = q.shape
@@ -58,7 +61,7 @@ def _use_pallas(q, force=None, k=None):
         shapes_ok = shapes_ok and sk % 128 == 0 and sk >= 256
     if force is not None:
         return force and shapes_ok
-    return shapes_ok and s >= 1024
+    return shapes_ok and s >= get_flag("pallas_attention_min_seq")
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -23,6 +23,8 @@ from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 from ..inference.export import (save_inference_model,  # noqa: F401
                                 load_inference_model)
 from . import nn  # noqa: F401
+from .control_flow import (while_loop, cond, case,  # noqa: F401
+                           switch_case, Assert)
 
 
 class _ProgramOp:

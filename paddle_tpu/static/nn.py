@@ -54,3 +54,8 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     layer = Embedding(size[0], size[1], padding_idx=padding_idx,
                       weight_attr=param_attr)
     return layer(input)
+
+
+# control flow (paddle.static.nn.while_loop etc. in the 2.x namespace)
+from .control_flow import (while_loop, cond, case,  # noqa: F401,E402
+                           switch_case)

@@ -1,0 +1,158 @@
+"""Control-flow op tests (reference `fluid/layers/control_flow.py:973` While,
+`:2302` cond; tests modeled on `test_while_loop_op.py` / `test_cond.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.jit import to_static
+
+
+def test_while_loop_eager_loop_carried_grad():
+    x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    i = paddle.to_tensor(np.int32(0))
+    _, out = static.while_loop(lambda i, x: i < 3,
+                               lambda i, x: [i + 1, x * x], [i, x])
+    out.backward()
+    assert abs(out.item() - 256.0) < 1e-3       # ((2^2)^2)^2
+    assert abs(x.grad.item() - 1024.0) < 1e-2   # 8 * 2^7
+
+
+def test_while_loop_traced_dynamic_trip_count():
+    def count_halvings(t):
+        c = paddle.to_tensor(np.int32(0))
+        c2, _ = static.while_loop(lambda c, t: (t > 1.0).all(),
+                                  lambda c, t: [c + 1, t / 2.0], [c, t])
+        return c2
+    f = to_static(count_halvings)
+    assert f(paddle.to_tensor(np.float32(40.0))).item() == 6
+    assert f(paddle.to_tensor(np.float32(3.0))).item() == 2
+
+
+def test_while_loop_traced_grad_needs_max_iters():
+    import jax
+
+    def loss(xv):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        i = paddle.to_tensor(np.int32(0))
+        with pytest.raises(ValueError, match="maximum_iterations"):
+            static.while_loop(lambda i, x: i < 3,
+                              lambda i, x: [i + 1, x * x], [i, x])
+        return xv
+    jax.jit(loss)(np.float32(2.0))
+
+
+def test_while_loop_bounded_scan_gradient():
+    """The maximum_iterations path must produce correct loop-carried grads
+    under a jit trace (the differentiable-decode building block)."""
+    import jax
+
+    def f(xv):
+        x = paddle.Tensor(xv, stop_gradient=False)
+        i = paddle.Tensor(np.int32(0))
+        _, out = static.while_loop(lambda i, x: i < 3,
+                                   lambda i, x: [i + 1, x * x], [i, x],
+                                   maximum_iterations=5)
+        s = out.sum()
+        s.backward()
+        return s._value, x.grad._value
+
+    val, g = jax.jit(f)(np.float32(2.0))
+    assert abs(float(val) - 256.0) < 1e-3
+    assert abs(float(g) - 1024.0) < 1e-2
+
+
+def test_cond_eager_and_traced():
+    r = static.cond(paddle.to_tensor(True),
+                    lambda: paddle.to_tensor(1.0),
+                    lambda: paddle.to_tensor(2.0))
+    assert r.item() == 1.0
+
+    def h(x):
+        return static.cond((x.sum() > 0).all(),
+                           lambda: x * 2.0, lambda: x - 1.0)
+    hf = to_static(h)
+    np.testing.assert_allclose(
+        hf(paddle.to_tensor(np.array([1., 2.], np.float32))).numpy(),
+        [2., 4.])
+    np.testing.assert_allclose(
+        hf(paddle.to_tensor(np.array([-1., -2.], np.float32))).numpy(),
+        [-2., -3.])
+
+
+def test_cond_gradient_through_branches():
+    """Differentiable cond: cotangents must reach the taken branch's
+    captures (jit-traced, where both branches run + select)."""
+    import jax
+
+    def f(xv, pv):
+        x = paddle.Tensor(xv, stop_gradient=False)
+        out = static.cond(paddle.Tensor(pv),
+                          lambda: (x * 2.0).sum(),
+                          lambda: (x * 5.0).sum())
+        out.backward()
+        return x.grad._value
+
+    g_true = jax.jit(f)(np.ones(3, np.float32), np.bool_(True))
+    g_false = jax.jit(f)(np.ones(3, np.float32), np.bool_(False))
+    np.testing.assert_allclose(np.asarray(g_true), 2.0)
+    np.testing.assert_allclose(np.asarray(g_false), 5.0)
+
+
+def test_case_first_true_wins():
+    out = static.case([
+        (paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+        (paddle.to_tensor(True), lambda: paddle.to_tensor(2.0)),
+        (paddle.to_tensor(True), lambda: paddle.to_tensor(3.0)),
+    ], default=lambda: paddle.to_tensor(9.0))
+    assert out.item() == 2.0
+    out = static.case([
+        (paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+    ], default=lambda: paddle.to_tensor(9.0))
+    assert out.item() == 9.0
+
+
+def test_switch_case_eager_and_traced():
+    fns = [lambda: paddle.to_tensor(10.0), lambda: paddle.to_tensor(20.0),
+           lambda: paddle.to_tensor(30.0)]
+    assert static.switch_case(paddle.to_tensor(np.int32(1)), fns).item() \
+        == 20.0
+    # out-of-range -> default (last fn)
+    assert static.switch_case(paddle.to_tensor(np.int32(7)), fns).item() \
+        == 30.0
+
+    def f(i, x):
+        return static.switch_case(
+            i, [lambda: x * 1.0, lambda: x * 2.0, lambda: x * 3.0])
+    ff = to_static(f)
+    x = paddle.to_tensor(np.float32(5.0))
+    for k, expect in [(0, 5.0), (2, 15.0), (9, 15.0)]:
+        got = ff(paddle.to_tensor(np.int32(k)), x)
+        assert abs(got.item() - expect) < 1e-4, (k, got.item())
+
+
+def test_assert_eager():
+    static.Assert(paddle.to_tensor(True))
+    with pytest.raises(AssertionError):
+        static.Assert(paddle.to_tensor(False),
+                      data=[paddle.to_tensor(np.arange(3))])
+
+
+def test_switch_case_out_of_range_above_max_uses_default():
+    """Traced out-of-range ABOVE max key must hit the explicit default,
+    matching eager fns.get(i, default)."""
+    fns = {0: (lambda: paddle.to_tensor(10.0)),
+           1: (lambda: paddle.to_tensor(20.0))}
+    default = lambda: paddle.to_tensor(99.0)  # noqa: E731
+    assert static.switch_case(paddle.to_tensor(np.int32(5)),
+                              list(fns.items()), default).item() == 99.0
+
+    def f(i):
+        return static.switch_case(
+            i, [lambda: paddle.to_tensor(10.0),
+                lambda: paddle.to_tensor(20.0)],
+            default=lambda: paddle.to_tensor(99.0))
+    ff = to_static(f)
+    assert ff(paddle.to_tensor(np.int32(5))).item() == 99.0
+    assert ff(paddle.to_tensor(np.int32(-3))).item() == 99.0
+    assert ff(paddle.to_tensor(np.int32(1))).item() == 20.0

@@ -1,0 +1,157 @@
+"""Flag registry + NaN/Inf debug mode tests.
+
+Reference analogs: FLAGS registry (`platform/flags.cc:48`, runtime get/set
+via `pybind/global_value_getter_setter.cc`) and the per-op non-finite scan
+(`framework/details/nan_inf_utils_detail.cc:1`, FLAGS_check_nan_inf).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    flags.set_flags({"check_nan_inf": False, "benchmark": False,
+                     "check_nan_inf_level": 0})
+
+
+def test_set_get_roundtrip():
+    paddle.set_flags({"check_nan_inf": True})
+    assert paddle.get_flags("check_nan_inf")["check_nan_inf"] is True
+    # FLAGS_ prefix accepted (reference env-var spelling)
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    assert paddle.get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"] \
+        is False
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(ValueError):
+        paddle.set_flags({"no_such_flag": 1})
+    with pytest.raises(ValueError):
+        paddle.get_flags("no_such_flag")
+
+
+def test_bool_coercion_from_strings():
+    paddle.set_flags({"check_nan_inf": "true"})
+    assert flags.get_flag("check_nan_inf") is True
+    paddle.set_flags({"check_nan_inf": "0"})
+    assert flags.get_flag("check_nan_inf") is False
+
+
+def test_check_nan_inf_eager_raises():
+    paddle.set_flags({"check_nan_inf": True})
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        x / x  # 0/0 -> nan
+    # warn-only level
+    paddle.set_flags({"check_nan_inf_level": 1})
+    with pytest.warns(UserWarning, match="non-finite"):
+        x / x
+
+
+def test_check_nan_inf_clean_graph_passes():
+    paddle.set_flags({"check_nan_inf": True})
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    (x @ x).sum().backward()
+    assert x.grad is not None
+
+
+def test_check_nan_inf_train_step():
+    """TrainStep's compiled finite check must catch a poisoned step and name
+    the offending grads."""
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+
+    def loss_fn(x):
+        return (net(x) * np.inf).sum()  # poison: inf loss, nan grads
+
+    step = TrainStep(net, loss_fn, opt)
+    paddle.set_flags({"check_nan_inf": True})
+    with pytest.raises(FloatingPointError, match="loss|grads"):
+        step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+
+
+def test_check_nan_inf_train_step_clean():
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = TrainStep(net, lambda x: (net(x) ** 2).sum(), opt)
+    paddle.set_flags({"check_nan_inf": True})
+    loss = step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert np.isfinite(loss.item())
+
+
+def test_benchmark_flag_syncs():
+    paddle.set_flags({"benchmark": True})
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    y = x @ x  # must not raise; result synced
+    assert y.shape == [8, 8]
+
+
+def test_pallas_flag_gates_dispatch():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.attention import _use_pallas
+
+    q = jnp.zeros((1, 2048, 4, 64), jnp.bfloat16)
+    # on CPU _use_pallas is always False; this asserts the flag short-circuit
+    paddle.set_flags({"use_pallas_attention": False})
+    try:
+        assert _use_pallas(q) is False
+    finally:
+        paddle.set_flags({"use_pallas_attention": True})
+
+
+def test_check_nan_inf_skips_poisoned_update_and_can_continue():
+    """With check_nan_inf_level=1 a poisoned step warns, SKIPS the update
+    (params unchanged), and training continues usable — donated buffers
+    must stay consistent."""
+    import warnings
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    poison = {"on": True}
+
+    def loss_fn(x):
+        out = (net(x) ** 2).sum()
+        if poison["on"]:
+            out = out * np.inf
+        return out
+
+    step = TrainStep(net, loss_fn, opt)
+    paddle.set_flags({"check_nan_inf": True, "check_nan_inf_level": 1})
+    before = net.weight.numpy().copy()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert any("non-finite" in str(x.message) for x in w)
+    np.testing.assert_allclose(net.weight.numpy(), before)  # update skipped
+    # params still usable (not donated-away)
+    _ = net(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy()
+
+
+def test_check_nan_inf_raise_keeps_state_usable():
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = TrainStep(net, lambda x: (net(x) * np.inf).sum(), opt)
+    paddle.set_flags({"check_nan_inf": True})
+    with pytest.raises(FloatingPointError):
+        step(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    # after the raise the params must still be readable and finite
+    assert np.isfinite(net.weight.numpy()).all()

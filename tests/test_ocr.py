@@ -76,3 +76,56 @@ def test_dbnet_forward_and_loss():
     model.eval()
     p_only = model(x)
     assert not isinstance(p_only, tuple)
+
+
+def test_ctc_beam_search_matches_exact_marginalization():
+    """Wide-beam prefix search must equal brute-force alignment
+    marginalization on a tiny grid (Hannun et al. algorithm check)."""
+    import itertools
+    import math
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.ocr import ctc_beam_search_decode
+
+    rs = np.random.RandomState(0)
+    T, C = 5, 4
+    logits = rs.randn(1, T, C).astype(np.float32) * 2
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))[0]
+
+    def collapse(path, blank=0):
+        out, prev = [], -1
+        for t in path:
+            if t != prev and t != blank:
+                out.append(t)
+            prev = t
+        return tuple(out)
+
+    def lse(a, b):
+        m = max(a, b)
+        if m == -np.inf:
+            return -np.inf
+        return m + math.log(math.exp(a - m) + math.exp(b - m))
+
+    exact = {}
+    for path in itertools.product(range(C), repeat=T):
+        s = sum(lp[t, c] for t, c in enumerate(path))
+        k = collapse(path)
+        exact[k] = lse(exact.get(k, -np.inf), s)
+    best_seq, best_lp = max(exact.items(), key=lambda kv: kv[1])
+
+    (seq, got_lp), = ctc_beam_search_decode(
+        paddle.to_tensor(logits), beam_size=64)
+    assert tuple(seq) == best_seq
+    assert abs(got_lp - best_lp) < 1e-4
+
+
+def test_ctc_beam_search_beats_or_ties_greedy():
+    from paddle_tpu.models.ocr import (ctc_beam_search_decode,
+                                       ctc_greedy_decode)
+    rs = np.random.RandomState(7)
+    logits = rs.randn(3, 12, 9).astype(np.float32)
+    beam = ctc_beam_search_decode(paddle.to_tensor(logits), beam_size=16)
+    greedy = ctc_greedy_decode(paddle.to_tensor(logits))
+    assert len(beam) == 3 and len(greedy) == 3
+    for (seq, lp) in beam:
+        assert np.isfinite(lp)
