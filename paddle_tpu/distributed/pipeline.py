@@ -94,6 +94,165 @@ def pipeline_apply_tensors(stage_fn, stacked_param_tensors, x_tensor,
 
 
 # ---------------------------------------------------------------------------
+# 1F1B schedule (true bounded-memory pipeline)
+# ---------------------------------------------------------------------------
+
+def pipeline_train_step_1f1b(stage_fn, head_loss_fn, stacked_params,
+                             head_params, x, y, num_microbatches, mesh=None):
+    """One-forward-one-backward pipelined fwd+bwd with O(pp) live
+    activations.
+
+    The reference's defining PP feature (`meta_parallel/pipeline_parallel.py
+    :111-160` warmup/steady/cooldown, `section_worker.cc:143` schedule_mode
+    1F1B). The GPipe scan above leans on reverse-AD through the scan, which
+    keeps EVERY microbatch's stage activations alive for the backward —
+    O(n_micro) memory. Here the schedule is explicit: a single scan over
+    pipeline ticks where each stage, per tick, runs one microbatch forward
+    AND one microbatch backward (vjp with recompute-from-saved-stage-input),
+    so only the <=2*pp in-flight stage INPUTS are stored. Activations move
+    forward and cotangents backward each tick via `lax.ppermute` over ICI.
+
+    stage_fn(local_params, h_mb) -> h_mb           (leading dim blocks/pp)
+    head_loss_fn(head_params, h_mb, y_mb) -> scalar mean loss of the
+        microbatch (runs on the last stage; head grads are psum'd across pp
+        — the shared-embedding allreduce analog, `pipeline_parallel.py:162`)
+
+    x: [B, ...] already-embedded activations; y: [B, ...] labels.
+    Returns (loss, stacked_param_grads, head_param_grads, dx) — dx is
+    d(loss)/dx for the caller to continue backward into the embedding.
+    stage_fn/head_loss_fn must be deterministic (thread dropout seeds in
+    explicitly if needed).
+    """
+    mesh = mesh or env.current_mesh()
+    pp = mesh.shape["pp"]
+    n_micro = num_microbatches
+
+    if pp == 1:
+        def single(params, hp, xv, yv):
+            loss_fn = lambda p, hp_, xv_, yv_: head_loss_fn(  # noqa: E731
+                hp_, stage_fn(p, xv_), yv_)
+            loss, vjp = jax.vjp(loss_fn, params, hp, xv, yv)
+            dp, dhp, dx, _ = vjp(jnp.ones((), loss.dtype))
+            return loss, dp, dhp, dx
+        return single(stacked_params, head_params, x, y)
+
+    T = n_micro + 2 * (pp - 1)
+    ring = 2 * pp
+
+    def inner(params, hp, xv, yv):
+        stage = jax.lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == pp - 1
+        B = xv.shape[0]
+        mb = B // n_micro
+        xm = xv.reshape((n_micro, mb) + xv.shape[1:])
+        ym = yv.reshape((n_micro, mb) + yv.shape[1:])
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+        def vary(a):
+            try:
+                return jax.lax.pcast(a, ("pp",), to="varying")
+            except ValueError:
+                return a  # already device-varying (e.g. built from params)
+
+        # make the replicated head params device-varying BEFORE differentiating
+        # them: vjp w.r.t. an invariant input inserts an implicit psum over
+        # pp, which would mix the other stages' masked-out garbage into dhp
+        hp = jax.tree_util.tree_map(vary, hp)
+
+        zero_mb = jnp.zeros((mb,) + xv.shape[1:], xv.dtype)
+        carry0 = dict(
+            fwd=vary(zero_mb),                       # activation from s-1
+            bwd=vary(zero_mb),                       # cotangent from s+1
+            inbuf=vary(jnp.zeros((ring, mb) + xv.shape[1:], xv.dtype)),
+            gacc=jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), params),
+            hacc=jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), hp),
+            dxbuf=vary(jnp.zeros((n_micro, mb) + xv.shape[1:], xv.dtype)),
+            loss=vary(jnp.zeros((), jnp.float32)),
+        )
+
+        def tick(c, t):
+            m_f = t - stage                          # fwd microbatch index
+            m_b = t - (2 * (pp - 1) - stage)         # bwd microbatch index
+            fwd_on = jnp.logical_and(m_f >= 0, m_f < n_micro)
+            bwd_on = jnp.logical_and(m_b >= 0, m_b < n_micro)
+            mf_c = jnp.clip(m_f, 0, n_micro - 1)
+            mb_c = jnp.clip(m_b, 0, n_micro - 1)
+
+            # ---- forward: one microbatch through my blocks ----
+            x_in = jnp.where(is_first,
+                             jax.lax.dynamic_index_in_dim(xm, mf_c, 0,
+                                                          keepdims=False),
+                             c["fwd"])
+            slot_f = jnp.mod(mf_c, ring)
+            old_slot = jax.lax.dynamic_index_in_dim(c["inbuf"], slot_f, 0,
+                                                    keepdims=False)
+            inbuf = jax.lax.dynamic_update_index_in_dim(
+                c["inbuf"], jnp.where(fwd_on, x_in, old_slot), slot_f, 0)
+            out = stage_fn(params, x_in)
+
+            # ---- last stage: loss + its cotangent for this microbatch ----
+            y_mb = jax.lax.dynamic_index_in_dim(ym, mf_c, 0, keepdims=False)
+            loss_m, loss_vjp = jax.vjp(
+                lambda hp_, o: head_loss_fn(hp_, o, y_mb), hp, out)
+            dhp, dout = loss_vjp(vary(jnp.ones((), loss_m.dtype)))
+            loss = c["loss"] + jnp.where(
+                jnp.logical_and(fwd_on, is_last),
+                loss_m.astype(jnp.float32), 0.0)
+            hacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(
+                    jnp.logical_and(bwd_on, is_last), g, jnp.zeros_like(g)),
+                c["hacc"], dhp)
+
+            # ---- backward: vjp with recompute from the saved stage input
+            cot = jnp.where(is_last, dout.astype(xv.dtype), c["bwd"])
+            saved_in = jax.lax.dynamic_index_in_dim(inbuf, jnp.mod(mb_c, ring),
+                                                    0, keepdims=False)
+            _, svjp = jax.vjp(stage_fn, params, saved_in)
+            dp, dx = svjp(cot)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(bwd_on, g, jnp.zeros_like(g)),
+                c["gacc"], dp)
+            dxbuf = jax.lax.dynamic_update_index_in_dim(
+                c["dxbuf"],
+                jnp.where(jnp.logical_and(bwd_on, is_first), dx,
+                          jax.lax.dynamic_index_in_dim(c["dxbuf"], mb_c, 0,
+                                                       keepdims=False)),
+                mb_c, 0)
+
+            return dict(
+                fwd=jax.lax.ppermute(out, "pp", fwd_perm),
+                bwd=jax.lax.ppermute(dx, "pp", bwd_perm),
+                inbuf=inbuf, gacc=gacc, hacc=hacc, dxbuf=dxbuf, loss=loss,
+            ), None
+
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+        # stage-local param grads stay pp-sharded; head grads and loss are
+        # produced on the last stage only -> psum == cross-stage allreduce
+        loss = jax.lax.psum(final["loss"], "pp") / n_micro
+        hg = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g / n_micro, "pp"), final["hacc"])
+        pg = jax.tree_util.tree_map(lambda g: g / n_micro, final["gacc"])
+        dx = jax.lax.psum(final["dxbuf"], "pp") / n_micro
+        return loss, pg, hg, dx.reshape((B,) + dx.shape[2:])
+
+    shard = jax.shard_map(
+        inner, mesh=mesh, axis_names={"pp"},
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                  jax.tree_util.tree_map(lambda _: P(), head_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                   jax.tree_util.tree_map(lambda _: P(), head_params),
+                   P()))
+    return shard(stacked_params, head_params, x, y)
+
+
+# ---------------------------------------------------------------------------
 # PipelineLayer API parity (reference pp_layers.py)
 # ---------------------------------------------------------------------------
 

@@ -50,20 +50,28 @@ class GPTConfig:
         self.dtype = dtype
 
     @staticmethod
+    def _preset(defaults, kw):
+        return GPTConfig(**{**defaults, **kw})
+
+    @staticmethod
     def gpt3_125m(**kw):
-        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+        return GPTConfig._preset(
+            dict(hidden_size=768, num_layers=12, num_heads=12), kw)
 
     @staticmethod
     def gpt3_350m(**kw):
-        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+        return GPTConfig._preset(
+            dict(hidden_size=1024, num_layers=24, num_heads=16), kw)
 
     @staticmethod
     def gpt3_1_3b(**kw):
-        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+        return GPTConfig._preset(
+            dict(hidden_size=2048, num_layers=24, num_heads=16), kw)
 
     @staticmethod
     def gpt3_13b(**kw):
-        return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, **kw)
+        return GPTConfig._preset(
+            dict(hidden_size=5120, num_layers=40, num_heads=40), kw)
 
 
 def _tag(param, axes):

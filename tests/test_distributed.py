@@ -233,3 +233,30 @@ def test_topology_parity():
     assert topo.get_rank(data=1, pipe=0, model=1) == 5
     groups = topo.get_comm_list("model")
     assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+
+def test_gpt_memory_plan_1_3b_fits_v5p():
+    """HBM accounting for the north-star plan: 1.3B on v5p-32 with
+    dp4 x mp2 x pp2, ZeRO-1, remat must fit; and a deliberately absurd
+    plan must not."""
+    from paddle_tpu.distributed import gpt_memory_plan
+    from paddle_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig.gpt3_1_3b(max_seq_len=2048)
+    plan = gpt_memory_plan(cfg, dp=4, mp=2, pp=2, micro_batch=2,
+                           zero_stage=1, remat=True)
+    assert plan.params > 1.2e9
+    assert plan.fits("v5p")
+    # parameter count formula must match the real model at tiny dims
+    from paddle_tpu.distributed.planner import gpt_params
+    tiny = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=32)
+    from paddle_tpu.models.gpt import GPTForPretraining
+    model = GPTForPretraining(tiny)
+    real = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
+    assert gpt_params(tiny) == real, (gpt_params(tiny), real)
+    # no-sharding 13B on v5e must NOT fit
+    big = gpt_memory_plan(GPTConfig.gpt3_13b(max_seq_len=2048),
+                          dp=1, mp=1, pp=1, micro_batch=1,
+                          zero_stage=0, remat=False)
+    assert not big.fits("v5e")
