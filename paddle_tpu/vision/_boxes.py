@@ -1,0 +1,70 @@
+"""Shared box arithmetic for the detection op family.
+
+TPU-first counterparts of the reference's header helpers
+(`paddle/fluid/operators/detection/bbox_util.h`,
+`detection/nms_util.h`): everything is fixed-shape and vectorized —
+IoU as one broadcasted matrix op for the MXU/VPU, greedy NMS as a
+`lax.fori_loop` whose per-step work is a fully vectorized mask update
+(no data-dependent shapes anywhere, so all of it jits on TPU).
+"""
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def box_area(boxes, normalized=True):
+    """[.., 4] xyxy -> [..]; +1 pixel convention when not normalized
+    (reference `bbox_util.h` BBoxArea)."""
+    off = 0.0 if normalized else 1.0
+    w = boxes[..., 2] - boxes[..., 0] + off
+    h = boxes[..., 3] - boxes[..., 1] + off
+    return jnp.where((w >= 0) & (h >= 0), w * h, 0.0)
+
+
+def iou_matrix(a, b, normalized=True):
+    """a [N,4], b [M,4] xyxy -> IoU [N,M] (reference
+    `detection/iou_similarity_op.h` IOUSimilarity)."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a, normalized)[:, None] + \
+        box_area(b, normalized)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def nms_mask(boxes, scores, iou_threshold, normalized=True, eta=1.0,
+             valid=None):
+    """Greedy hard-NMS over M already-materialized candidates.
+
+    Returns (keep [M] bool in ORIGINAL order, order [M] score-desc indices).
+    The sequential dependency of greedy NMS (reference
+    `detection/nms_util.h` NMSFast) is kept, but each of the M steps is a
+    vectorized mask update against the precomputed IoU row — O(M) scan
+    steps of O(M) vector work, static shapes throughout. `eta` < 1 shrinks
+    the threshold adaptively after each kept box once it exceeds 0.5
+    (reference adaptive-NMS semantics).
+    """
+    m = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sb = boxes[order]
+    iou = iou_matrix(sb, sb, normalized)
+    v = jnp.ones((m,), bool) if valid is None else valid[order]
+
+    def body(i, carry):
+        keep, thresh = carry
+        kept_before = keep & (jnp.arange(m) < i)
+        suppressed = jnp.any(kept_before & (iou[i] > thresh))
+        k = (~suppressed) & v[i]
+        keep = keep.at[i].set(k)
+        shrink = k & (eta < 1.0) & (thresh > 0.5)
+        thresh = jnp.where(shrink, thresh * eta, thresh)
+        return keep, thresh
+
+    keep_sorted, _ = jax.lax.fori_loop(
+        0, m, body, (jnp.zeros((m,), bool), jnp.asarray(iou_threshold,
+                                                        jnp.float32)))
+    keep = jnp.zeros((m,), bool).at[order].set(keep_sorted)
+    return keep, order

@@ -1,0 +1,519 @@
+"""Detection post-processing / target-generation op family.
+
+Parity target: `python/paddle/fluid/layers/detection.py` and the kernels
+in `paddle/fluid/operators/detection/` (multiclass_nms, matrix_nms,
+prior_box, density_prior_box, anchor_generator, box_coder, box_clip,
+iou_similarity, bipartite_match, generate_proposals,
+distribute_fpn_proposals). TPU-first redesign of the reference's
+LoD-everywhere contract: every op here returns FIXED-SHAPE padded arrays
+plus a valid count (or -1 labels) instead of variable-length LoD
+tensors, so entire detection heads jit into one XLA program. Greedy NMS
+keeps its sequential semantics as a `lax.fori_loop` of vectorized mask
+updates; matrix_nms is embarrassingly parallel and is the preferred
+TPU path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+from ._boxes import iou_matrix, nms_mask, NEG_INF
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "bipartite_match",
+    "multiclass_nms", "matrix_nms", "prior_box", "density_prior_box",
+    "anchor_generator", "generate_proposals", "distribute_fpn_proposals",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU, [N,4] x [M,4] -> [N,M]
+    (`fluid/layers/detection.py:765`, `iou_similarity_op.h`)."""
+    return Tensor(iou_matrix(_val(ensure_tensor(x)).astype(jnp.float32),
+                             _val(ensure_tensor(y)).astype(jnp.float32),
+                             box_normalized))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors
+    (`fluid/layers/detection.py:819`, `box_coder_op.h`).
+
+    encode: target [N,4] vs priors [M,4] -> [N,M,4] deltas.
+    decode: deltas [N,M,4] (or [N,4] broadcast along `axis`) -> boxes.
+    prior_box_var: None | [M,4] Tensor | 4-list.
+    """
+    pb = _val(ensure_tensor(prior_box)).astype(jnp.float32)
+    tb = _val(ensure_tensor(target_box)).astype(jnp.float32)
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, jnp.float32).reshape(1, 4)
+    else:
+        var = _val(ensure_tensor(prior_box_var)).astype(jnp.float32)
+
+    off = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type.lower() == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None]) / pw[None]
+        dy = (tcy[:, None] - pcy[None]) / ph[None]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], -1) / var[None]
+        return Tensor(out)
+
+    # decode: tb is [N, M, 4] deltas (or [N, 4] against priors along axis)
+    if tb.ndim == 2:
+        tb = tb[:, None, :] if axis == 0 else tb[None, :, :]
+    if axis == 0:
+        pcx_, pcy_, pw_, ph_, var_ = (pcx[None, :], pcy[None, :],
+                                      pw[None, :], ph[None, :], var[None])
+    else:
+        pcx_, pcy_, pw_, ph_, var_ = (pcx[:, None], pcy[:, None],
+                                      pw[:, None], ph[:, None],
+                                      var[:, None] if var.shape[0] > 1
+                                      else var[None])
+    d = tb * var_
+    cx = d[..., 0] * pw_ + pcx_
+    cy = d[..., 1] * ph_ + pcy_
+    w = jnp.exp(d[..., 2]) * pw_
+    h = jnp.exp(d[..., 3]) * ph_
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+    return Tensor(out)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image extents (`fluid/layers/detection.py:3050`).
+    im_info per image: (height, width, scale) — boxes clipped to
+    [0, dim/scale - 1]."""
+    b = _val(ensure_tensor(input)).astype(jnp.float32)
+    info = _val(ensure_tensor(im_info)).astype(jnp.float32)
+    if info.ndim == 1:
+        info = info[None]
+    hmax = info[:, 0] / info[:, 2] - 1
+    wmax = info[:, 1] / info[:, 2] - 1
+    while hmax.ndim < b.ndim - 1:
+        hmax, wmax = hmax[..., None], wmax[..., None]
+    x1 = jnp.clip(b[..., 0], 0, wmax)
+    y1 = jnp.clip(b[..., 1], 0, hmax)
+    x2 = jnp.clip(b[..., 2], 0, wmax)
+    y2 = jnp.clip(b[..., 3], 0, hmax)
+    return Tensor(jnp.stack([x1, y1, x2, y2], -1))
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=None, name=None):
+    """Greedy bipartite matching (`fluid/layers/detection.py:1324`,
+    `bipartite_match_op.cc`): repeatedly take the global argmax of the
+    [R, C] distance matrix, pair that row/col, mask both out. The
+    reference's data-dependent loop becomes a `lax.scan` of min(R, C)
+    fully vectorized steps. Returns (match_indices [C] int32 — row
+    matched to each column, -1 if none; match_dist [C]).
+    'per_prediction' additionally matches every unmatched column to its
+    argmax row when that distance > dist_threshold."""
+    d = _val(ensure_tensor(dist_matrix)).astype(jnp.float32)
+    R, C = d.shape
+
+    def step(carry, _):
+        m, midx, mdist = carry
+        flat = jnp.argmax(m)
+        r, c = flat // C, flat % C
+        best = m[r, c]
+        take = best > 0
+        midx = jnp.where(take, midx.at[c].set(r.astype(jnp.int32)), midx)
+        mdist = jnp.where(take, mdist.at[c].set(best), mdist)
+        m = jnp.where(take, m.at[r, :].set(NEG_INF).at[:, c].set(NEG_INF),
+                      m)
+        return (m, midx, mdist), None
+
+    init = (d, jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), jnp.float32))
+    (_, midx, mdist), _ = jax.lax.scan(step, init, None,
+                                       length=min(R, C))
+    if match_type == "per_prediction":
+        thr = 0.5 if dist_threshold is None else float(dist_threshold)
+        col_best = d.argmax(0).astype(jnp.int32)
+        col_dist = d.max(0)
+        extra = (midx < 0) & (col_dist > thr)
+        midx = jnp.where(extra, col_best, midx)
+        mdist = jnp.where(extra, col_dist, mdist)
+    return Tensor(midx), Tensor(mdist)
+
+
+def _per_class_nms_pad(boxes, scores, score_threshold, nms_top_k,
+                       nms_threshold, normalized, eta):
+    """One class: mask sub-threshold, take top nms_top_k, greedy NMS.
+    Returns (cand_boxes [K,4], cand_scores [K] with suppressed = NEG_INF,
+    cand_idx [K] original box indices)."""
+    s = jnp.where(scores > score_threshold, scores, NEG_INF)
+    k = min(nms_top_k if nms_top_k > 0 else s.shape[0], s.shape[0])
+    top_s, idx = jax.lax.top_k(s, k)
+    b = boxes[idx]
+    keep, order = nms_mask(b, top_s, nms_threshold, normalized, eta,
+                           valid=top_s > NEG_INF / 2)
+    sel = jnp.where(keep, top_s, NEG_INF)
+    return b, sel, idx.astype(jnp.int32)
+
+
+def _assemble_detections(flat_s, flat_b, flat_l, flat_i, ktk):
+    """Shared final stage of multiclass/matrix NMS: global top-k over all
+    per-class candidates -> ([ktk, 6] (label, score, box) padded with
+    label = -1, valid count, [ktk] original box indices padded -1)."""
+    kk = min(ktk, flat_s.shape[0])
+    top_s, top_i = jax.lax.top_k(flat_s, kk)
+    ok = top_s > NEG_INF / 2
+    det = jnp.concatenate(
+        [jnp.where(ok, flat_l[top_i], -1).astype(jnp.float32)[:, None],
+         jnp.where(ok, top_s, 0.0)[:, None],
+         jnp.where(ok[:, None], flat_b[top_i], 0.0)], -1)
+    idx = jnp.where(ok, flat_i[top_i], -1)
+    if kk < ktk:
+        det = jnp.concatenate(
+            [det, jnp.zeros((ktk - kk, 6), jnp.float32).at[:, 0].set(-1)],
+            0)
+        idx = jnp.concatenate([idx, jnp.full((ktk - kk,), -1, jnp.int32)],
+                              0)
+    return det, jnp.sum(ok.astype(jnp.int32)), idx
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False):
+    """Multi-class NMS (`fluid/layers/detection.py:3269`,
+    `multiclass_nms_op.cc`).
+
+    bboxes [N, M, 4] (boxes shared across classes); scores [N, C, M].
+    FIXED-SHAPE output (replaces the reference's LoD): detections
+    [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2) padded with
+    label = -1, plus nums [N] valid counts. With return_index=True, also
+    the original box index [N, keep_top_k] (padded -1) between det and
+    nums, matching the reference's Index output.
+    """
+    bv = _val(ensure_tensor(bboxes)).astype(jnp.float32)
+    sv = _val(ensure_tensor(scores)).astype(jnp.float32)
+    N, C, M = sv.shape
+    ktk = keep_top_k if keep_top_k > 0 else C * M
+
+    def per_image(b, s):
+        def per_class(sc):
+            return _per_class_nms_pad(b, sc, score_threshold, nms_top_k,
+                                      nms_threshold, normalized, nms_eta)
+        cb, cs, ci = jax.vmap(per_class)(s)       # [C, K, 4], [C, K] x2
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], cs.shape)
+        if 0 <= background_label < C:
+            cs = jnp.where(labels == background_label, NEG_INF, cs)
+        return _assemble_detections(cs.reshape(-1), cb.reshape(-1, 4),
+                                    labels.reshape(-1), ci.reshape(-1),
+                                    ktk)
+
+    det, nums, idx = jax.vmap(per_image)(bv, sv)
+    if return_index:
+        return Tensor(det), Tensor(idx), Tensor(nums)
+    return Tensor(det), Tensor(nums)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (`fluid/layers/detection.py:3553`, `matrix_nms_op.cc`;
+    SOLOv2). Unlike greedy NMS this is one batched matrix computation —
+    the natural TPU formulation: decay_i = min_j (f(iou_ij) /
+    f(compensate_j)) over higher-scored j, f gaussian or linear.
+
+    Same fixed-shape output contract as multiclass_nms.
+    """
+    bv = _val(ensure_tensor(bboxes)).astype(jnp.float32)
+    sv = _val(ensure_tensor(scores)).astype(jnp.float32)
+    N, C, M = sv.shape
+    ktk = keep_top_k if keep_top_k > 0 else C * M
+
+    def decay_fn(iou, compensate):
+        if use_gaussian:
+            return jnp.exp((compensate ** 2 - iou ** 2) / gaussian_sigma)
+        return (1.0 - iou) / jnp.maximum(1.0 - compensate, 1e-10)
+
+    def per_class(b, sc):
+        s = jnp.where(sc > score_threshold, sc, NEG_INF)
+        k = min(nms_top_k if nms_top_k > 0 else M, M)
+        top_s, idx = jax.lax.top_k(s, k)
+        sb = b[idx]
+        valid = top_s > NEG_INF / 2
+        iou = iou_matrix(sb, sb, normalized)
+        upper = jnp.triu(jnp.ones((k, k), bool), 1)  # j < i pairs (row j)
+        iou_hi = jnp.where(upper & valid[:, None] & valid[None, :],
+                           iou, 0.0)                 # iou_hi[j, i], j<i
+        compensate = jnp.max(iou_hi, 0)              # per j: max vs higher
+        decay = jnp.where(upper, decay_fn(iou_hi, compensate[:, None]),
+                          jnp.inf)
+        decay = jnp.clip(jnp.min(decay, 0), 0.0, 1.0)
+        new_s = jnp.where(valid, top_s * decay, NEG_INF)
+        new_s = jnp.where(new_s > post_threshold, new_s, NEG_INF)
+        return sb, new_s, idx.astype(jnp.int32)
+
+    def per_image(b, s):
+        cb, cs, ci = jax.vmap(lambda sc: per_class(b, sc))(s)
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], cs.shape)
+        if 0 <= background_label < C:
+            cs = jnp.where(labels == background_label, NEG_INF, cs)
+        return _assemble_detections(cs.reshape(-1), cb.reshape(-1, 4),
+                                    labels.reshape(-1), ci.reshape(-1),
+                                    ktk)
+
+    det, nums, idx = jax.vmap(per_image)(bv, sv)
+    outs = [Tensor(det)]
+    if return_index:
+        outs.append(Tensor(idx))
+    if return_rois_num:
+        outs.append(Tensor(nums))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# anchor generation
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (`fluid/layers/detection.py:1771`,
+    `prior_box_op.h`). input [N,C,H,W] feature, image [N,C,Hi,Wi].
+    Returns (boxes [H,W,P,4] normalized xyxy, variances [H,W,P,4])."""
+    fh, fw = _val(ensure_tensor(input)).shape[-2:]
+    ih, iw = _val(ensure_tensor(image)).shape[-2:]
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] \
+        if max_sizes else []
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+    wh = jnp.asarray(whs, jnp.float32)                  # [P, 2]
+    P = wh.shape[0]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, P))
+    bw = wh[None, None, :, 0] / 2
+    bh = wh[None, None, :, 1] / 2
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (fh, fw, P, 4))
+    return Tensor(boxes), Tensor(var)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (`fluid/layers/detection.py:1932`,
+    `density_prior_box_op.h`): each fixed_size spawns a density x density
+    sub-grid of shifted anchors per ratio."""
+    fh, fw = _val(ensure_tensor(input)).shape[-2:]
+    ih, iw = _val(ensure_tensor(image)).shape[-2:]
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    entries = []  # (w, h, shift_x_frac, shift_y_frac)
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    entries.append(
+                        (bw, bh,
+                         (dj + 0.5) * shift - 0.5,
+                         (di + 0.5) * shift - 0.5))
+    e = jnp.asarray(entries, jnp.float32)               # [P, 4]
+    P = e.shape[0]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg = cx[None, :, None] + e[None, None, :, 2] * step_w
+    cyg = cy[:, None, None] + e[None, None, :, 3] * step_h
+    bw = e[None, None, :, 0] / 2
+    bh = e[None, None, :, 1] / 2
+    cxg = jnp.broadcast_to(cxg, (fh, fw, P))
+    cyg = jnp.broadcast_to(cyg, (fh, fw, P))
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (fh, fw, P, 4))
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(boxes), Tensor(var)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance,
+                     stride, offset=0.5, name=None):
+    """RPN anchors (`fluid/layers/detection.py:2406`,
+    `anchor_generator_op.h`): per feature-map cell, one anchor per
+    (size, ratio) in INPUT-IMAGE pixel coords. Returns
+    (anchors [H,W,A,4], variances [H,W,A,4])."""
+    fh, fw = _val(ensure_tensor(input)).shape[-2:]
+    sw, sh = float(stride[0]), float(stride[1])
+    whs = []
+    for r in aspect_ratios:
+        base_w = round(np.sqrt(sw * sh / r))
+        base_h = round(base_w * r)
+        for s in anchor_sizes:
+            whs.append((s / sw * base_w, s / sh * base_h))
+    wh = jnp.asarray(whs, jnp.float32)
+    A = wh.shape[0]
+    cx = jnp.arange(fw, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(fh, dtype=jnp.float32) * sh + offset * (sh - 1)
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, A))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, A))
+    bw = (wh[None, None, :, 0] - 1) / 2
+    bh = (wh[None, None, :, 1] - 1) / 2
+    anchors = jnp.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh], -1)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (fh, fw, A, 4))
+    return Tensor(anchors), Tensor(var)
+
+
+# ---------------------------------------------------------------------------
+# proposal generation / FPN distribution
+# ---------------------------------------------------------------------------
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True, name=None):
+    """RPN proposal generation (`fluid/layers/detection.py:2901`,
+    `generate_proposals_v2_op.cc`): decode anchors with deltas, clip to
+    the image, drop boxes smaller than min_size (masked, not compacted),
+    take pre_nms_top_n by score, greedy-NMS, keep post_nms_top_n.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; im_shape [N, 2]
+    (h, w); anchors [H, W, A, 4]; variances [H, W, A, 4].
+    Returns (rois [N, post_nms_top_n, 4], roi_probs [N, post_nms_top_n, 1],
+    rois_num [N]) — fixed shapes, padded with zeros.
+    """
+    sv = _val(ensure_tensor(scores)).astype(jnp.float32)
+    dv = _val(ensure_tensor(bbox_deltas)).astype(jnp.float32)
+    imv = _val(ensure_tensor(im_shape)).astype(jnp.float32)
+    av = _val(ensure_tensor(anchors)).astype(jnp.float32).reshape(-1, 4)
+    vv = _val(ensure_tensor(variances)).astype(jnp.float32).reshape(-1, 4)
+    N, A, H, W = sv.shape
+
+    def per_image(s, d, im):
+        # to anchor-major [H*W*A] ordering to match anchors.reshape
+        s = s.transpose(1, 2, 0).reshape(-1)             # [H*W*A]
+        d = d.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = av[:, 2] - av[:, 0] + 1.0
+        ah = av[:, 3] - av[:, 1] + 1.0
+        acx = av[:, 0] + aw * 0.5
+        acy = av[:, 1] + ah * 0.5
+        dd = d * vv
+        cx = dd[:, 0] * aw + acx
+        cy = dd[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(dd[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(dd[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                           cx + w * 0.5 - 1, cy + h * 0.5 - 1], -1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im[1] - 1),
+                           jnp.clip(boxes[:, 1], 0, im[0] - 1),
+                           jnp.clip(boxes[:, 2], 0, im[1] - 1),
+                           jnp.clip(boxes[:, 3], 0, im[0] - 1)], -1)
+        bw = boxes[:, 2] - boxes[:, 0] + 1
+        bh = boxes[:, 3] - boxes[:, 1] + 1
+        ok = (bw >= min_size) & (bh >= min_size)
+        s = jnp.where(ok, s, NEG_INF)
+        k = min(pre_nms_top_n, s.shape[0])
+        top_s, idx = jax.lax.top_k(s, k)
+        b = boxes[idx]
+        keep, order = nms_mask(b, top_s, nms_thresh, normalized=False,
+                               eta=eta, valid=top_s > NEG_INF / 2)
+        kept_sorted = keep[order]
+        rank = jnp.cumsum(kept_sorted.astype(jnp.int32)) - 1
+        put = jnp.where(kept_sorted & (rank < post_nms_top_n), rank,
+                        post_nms_top_n)
+        rois = jnp.zeros((post_nms_top_n, 4), jnp.float32)
+        rois = rois.at[put].set(b[order], mode="drop")
+        probs = jnp.zeros((post_nms_top_n,), jnp.float32)
+        probs = probs.at[put].set(top_s[order], mode="drop")
+        n_val = jnp.minimum(kept_sorted.sum().astype(jnp.int32),
+                            post_nms_top_n)
+        return rois, probs[:, None], n_val
+
+    rois, probs, nums = jax.vmap(per_image)(sv, dv, imv)
+    if return_rois_num:
+        return Tensor(rois), Tensor(probs), Tensor(nums)
+    return Tensor(rois), Tensor(probs)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route rois to FPN levels (`fluid/layers/detection.py:3680`,
+    `distribute_fpn_proposals_op.cc`):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)), clipped.
+
+    fpn_rois [R, 4]. Fixed-shape contract: every level gets an [R, 4]
+    array + a bool mask (invalid rows zeroed) instead of compacted LoD
+    outputs; restore_ind is the identity permutation split by mask rank.
+    Returns (multi_rois list, masks list, restore_ind [R]).
+    """
+    r = _val(ensure_tensor(fpn_rois)).astype(jnp.float32)
+    off = 1.0 if pixel_offset else 0.0
+    area = (r[:, 2] - r[:, 0] + off) * (r[:, 3] - r[:, 1] + off)
+    scale = jnp.sqrt(jnp.maximum(area, 1e-10))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-10))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+    multi_rois, masks = [], []
+    for level in range(min_level, max_level + 1):
+        m = lvl == level
+        multi_rois.append(Tensor(jnp.where(m[:, None], r, 0.0)))
+        masks.append(Tensor(m))
+    # original position of each roi in level-major order
+    order = jnp.argsort(lvl * r.shape[0] + jnp.arange(r.shape[0]))
+    restore = jnp.zeros((r.shape[0],), jnp.int32).at[order].set(
+        jnp.arange(r.shape[0], dtype=jnp.int32))
+    return multi_rois, masks, Tensor(restore)

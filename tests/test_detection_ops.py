@@ -1,0 +1,443 @@
+"""Detection op family vs numpy brute-force oracles.
+
+Test strategy follows the reference's detection op unit tests
+(`tests/unittests/test_multiclass_nms_op.py`, `test_roi_align_op.py`,
+`test_yolov3_loss_op.py`): each op is checked against an independent
+straight-line numpy implementation of the documented contract.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+from paddle_tpu.vision import detection as D
+
+
+def _rand_boxes(rs, n, lo=0.0, hi=50.0):
+    x1 = rs.uniform(lo, hi - 5, n)
+    y1 = rs.uniform(lo, hi - 5, n)
+    w = rs.uniform(1.0, 20.0, n)
+    h = rs.uniform(1.0, 20.0, n)
+    return np.stack([x1, y1, x1 + w, y1 + h], -1).astype(np.float32)
+
+
+def np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i in range(len(a)):
+        for j in range(len(b)):
+            ix1 = max(a[i, 0], b[j, 0])
+            iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2])
+            iy2 = min(a[i, 3], b[j, 3])
+            iw = max(ix2 - ix1 + off, 0.0)
+            ih = max(iy2 - iy1 + off, 0.0)
+            inter = iw * ih
+            ua = ((a[i, 2] - a[i, 0] + off) * (a[i, 3] - a[i, 1] + off)
+                  + (b[j, 2] - b[j, 0] + off) * (b[j, 3] - b[j, 1] + off)
+                  - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def np_greedy_nms(boxes, scores, thresh):
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    for i in order:
+        ok = True
+        for j in keep:
+            if np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def test_iou_similarity():
+    rs = np.random.RandomState(0)
+    a, b = _rand_boxes(rs, 7), _rand_boxes(rs, 5)
+    got = D.iou_similarity(a, b).numpy()
+    np.testing.assert_allclose(got, np_iou(a, b), atol=1e-5)
+    got2 = D.iou_similarity(a, b, box_normalized=False).numpy()
+    np.testing.assert_allclose(got2, np_iou(a, b, False), atol=1e-5)
+
+
+def test_nms_matches_bruteforce():
+    rs = np.random.RandomState(1)
+    boxes = _rand_boxes(rs, 30)
+    scores = rs.uniform(0, 1, 30).astype(np.float32)
+    keep = V.nms(boxes, 0.45, scores).numpy().tolist()
+    assert keep == np_greedy_nms(boxes, scores, 0.45)
+    # padded static-shape variant
+    padded = V.nms(boxes, 0.45, scores, top_k=40).numpy()
+    ref = np_greedy_nms(boxes, scores, 0.45)
+    assert padded[:len(ref)].tolist() == ref
+    assert (padded[len(ref):] == -1).all()
+
+
+def test_nms_categories():
+    rs = np.random.RandomState(2)
+    boxes = np.tile(_rand_boxes(rs, 6), (2, 1))      # identical boxes
+    scores = rs.uniform(0, 1, 12).astype(np.float32)
+    cats = np.array([0] * 6 + [1] * 6, np.int32)
+    keep = V.nms(boxes, 0.5, scores, category_idxs=cats,
+                 categories=[0, 1]).numpy()
+    # identical boxes in different categories never suppress each other
+    per_cat = [np_greedy_nms(boxes[:6], scores[:6], 0.5),
+               [i + 6 for i in np_greedy_nms(boxes[6:], scores[6:], 0.5)]]
+    assert sorted(keep.tolist()) == sorted(per_cat[0] + per_cat[1])
+
+
+def test_multiclass_nms():
+    rs = np.random.RandomState(3)
+    M, C = 20, 4
+    boxes = _rand_boxes(rs, M)[None]                  # [1, M, 4]
+    scores = rs.uniform(0, 1, (1, C, M)).astype(np.float32)
+    det, nums = D.multiclass_nms(boxes, scores, score_threshold=0.3,
+                                 nms_top_k=10, keep_top_k=15,
+                                 nms_threshold=0.4, background_label=0)
+    det, n = det.numpy()[0], int(nums.numpy()[0])
+    # oracle
+    cand = []
+    for c in range(1, C):                             # skip background 0
+        s = scores[0, c]
+        idx = [i for i in np.argsort(-s, kind="stable")[:10] if s[i] > 0.3]
+        kept = np_greedy_nms(boxes[0][idx], s[idx], 0.4)
+        cand += [(c, s[idx[k]], tuple(boxes[0][idx[k]])) for k in kept]
+    cand.sort(key=lambda t: -t[1])
+    cand = cand[:15]
+    assert n == len(cand)
+    for i, (lbl, sc, bx) in enumerate(cand):
+        assert int(det[i, 0]) == lbl
+        np.testing.assert_allclose(det[i, 1], sc, rtol=1e-5)
+        np.testing.assert_allclose(det[i, 2:], bx, rtol=1e-5)
+    assert (det[n:, 0] == -1).all()
+
+
+def test_matrix_nms_decay():
+    # two overlapping boxes + one far box: the overlapped lower-score box
+    # decays below post_threshold, the far box survives
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [100, 100, 110, 110]], np.float32)[None]
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)[None]  # [1,1,3]
+    det, nums = D.matrix_nms(boxes, scores, score_threshold=0.1,
+                             post_threshold=0.5, nms_top_k=3, keep_top_k=3,
+                             background_label=-1)
+    det, n = det.numpy()[0], int(nums.numpy()[0])
+    assert n == 2
+    np.testing.assert_allclose(det[0, 1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(det[1, 1], 0.7, rtol=1e-6)  # far box kept
+    assert (det[2, 0] == -1)
+
+
+def test_box_coder_roundtrip():
+    rs = np.random.RandomState(4)
+    priors = _rand_boxes(rs, 6)
+    targets = _rand_boxes(rs, 6)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = D.box_coder(priors, var, targets, "encode_center_size").numpy()
+    # decode the diagonal (each target against its own prior)
+    diag = np.stack([enc[i, i] for i in range(6)])[:, None, :]
+    dec = D.box_coder(priors, var, np.repeat(diag, 6, 1),
+                      "decode_center_size").numpy()
+    for i in range(6):
+        np.testing.assert_allclose(dec[i, i], targets[i], rtol=1e-4,
+                                   atol=1e-3)
+
+
+def test_box_clip():
+    boxes = np.array([[-5.0, -5.0, 30.0, 40.0]], np.float32)
+    out = D.box_clip(boxes, np.array([20.0, 25.0, 1.0])).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 24, 19], atol=1e-6)
+
+
+def test_bipartite_match():
+    d = np.array([[0.9, 0.1, 0.3],
+                  [0.8, 0.7, 0.2]], np.float32)     # 2 rows, 3 cols
+    idx, dist = D.bipartite_match(d)
+    idx, dist = idx.numpy(), dist.numpy()
+    # global max 0.9 -> (r0, c0); next best among remaining: 0.7 (r1, c1)
+    assert idx.tolist() == [0, 1, -1]
+    np.testing.assert_allclose(dist[:2], [0.9, 0.7], rtol=1e-6)
+    idx2, _ = D.bipartite_match(d, "per_prediction", 0.15)
+    # col 2's best row is 0 at 0.3 > 0.15
+    assert idx2.numpy().tolist() == [0, 1, 0]
+
+
+def test_roi_align_values():
+    # constant feature map -> every output equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    boxes = np.array([[0, 0, 7, 7], [2, 2, 6, 6]], np.float32)
+    out = V.roi_align(x, boxes, [2], output_size=2, spatial_scale=1.0,
+                      sampling_ratio=2).numpy()
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, atol=1e-5)
+    # linear ramp in x: roi_align of an axis-aligned box reproduces the
+    # ramp's bin-center averages
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, :], (8, 1))
+    x2 = ramp[None, None]
+    b = np.array([[0, 0, 8, 8]], np.float32)
+    out2 = V.roi_align(x2, b, [1], output_size=4, spatial_scale=1.0,
+                       sampling_ratio=1, aligned=True).numpy()[0, 0]
+    # bin centers along x at 0.5, 2.5, 4.5, 6.5 (shifted by aligned -0.5)
+    np.testing.assert_allclose(out2[0], [0.5, 2.5, 4.5, 6.5], atol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(5)
+                         .randn(1, 3, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(
+        np.array([[1, 1, 6, 6]], np.float32))
+    out = V.roi_align(x, boxes, [1], output_size=2, sampling_ratio=2)
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 3] = 5.0
+    x[0, 0, 6, 6] = 7.0
+    boxes = np.array([[0, 0, 7, 7]], np.float32)
+    out = V.roi_pool(x, boxes, [1], output_size=2).numpy()[0, 0]
+    # quadrants: max of top-left contains 5, bottom-right contains 7
+    assert out[0, 0] == 5.0 and out[1, 1] == 7.0
+
+
+def test_psroi_pool_shapes_and_avg():
+    # C = oc * ph * pw = 1*2*2; constant channels -> averages are the
+    # channel constants in position order
+    x = np.stack([np.full((4, 4), v, np.float32)
+                  for v in (1.0, 2.0, 3.0, 4.0)])[None]
+    boxes = np.array([[0, 0, 4, 4]], np.float32)
+    out = V.psroi_pool(x, boxes, [1], output_size=2).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-5)
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 4, 9, 9).astype(np.float32)
+    w = rs.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    got = V.deform_conv2d(x, off, w).numpy()
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_padded_matches_conv2d():
+    """Zero-offset deform conv with padding must equal conv2d including
+    borders (regression: clamp-bilinear read edge pixels instead of 0)."""
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(12)
+    x = rs.randn(1, 3, 6, 6).astype(np.float32)
+    w = rs.randn(5, 3, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    got = V.deform_conv2d(x, off, w, padding=1).numpy()
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_nms_return_index():
+    rs = np.random.RandomState(13)
+    boxes = _rand_boxes(rs, 12)[None]
+    scores = rs.uniform(0, 1, (1, 3, 12)).astype(np.float32)
+    det, idx, nums = D.multiclass_nms(
+        boxes, scores, score_threshold=0.2, nms_top_k=8, keep_top_k=10,
+        nms_threshold=0.5, background_label=-1, return_index=True)
+    det, idx, n = det.numpy()[0], idx.numpy()[0], int(nums.numpy()[0])
+    for i in range(n):
+        np.testing.assert_allclose(det[i, 2:], boxes[0, idx[i]], rtol=1e-6)
+    assert (idx[n:] == -1).all()
+
+
+def test_deform_conv2d_mask_and_grad():
+    rs = np.random.RandomState(7)
+    x = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    off = paddle.to_tensor(
+        rs.randn(1, 2 * 9, 4, 4).astype(np.float32) * 0.1)
+    off.stop_gradient = False
+    mask = paddle.to_tensor(
+        rs.uniform(0, 1, (1, 9, 4, 4)).astype(np.float32))
+    w = paddle.to_tensor(rs.randn(3, 2, 3, 3).astype(np.float32))
+    w.stop_gradient = False
+    out = V.deform_conv2d(x, off, w, mask=mask)
+    out.sum().backward()
+    for t in (x, off, w):
+        assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+
+
+def test_deform_conv2d_layer():
+    layer = V.DeformConv2D(4, 8, 3, padding=1)
+    x = paddle.randn([2, 4, 8, 8])
+    off = paddle.zeros([2, 18, 8, 8])
+    out = layer(x, off)
+    assert tuple(out.shape) == (2, 8, 8, 8)
+
+
+def test_yolo_box_decode():
+    N, A, H, W, nc = 1, 2, 4, 4, 3
+    rs = np.random.RandomState(8)
+    x = rs.randn(N, A * (5 + nc), H, W).astype(np.float32)
+    img = np.array([[128, 128]], np.int32)
+    anchors = [10, 13, 16, 30]
+    boxes, scores = V.yolo_box(x, img, anchors, nc, 0.01, 32)
+    boxes, scores = boxes.numpy(), scores.numpy()
+    assert boxes.shape == (N, A * H * W, 4)
+    assert scores.shape == (N, A * H * W, nc)
+    # oracle for one cell (a=0, i=1, j=2)
+    t = x.reshape(N, A, 5 + nc, H, W)
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    cx = (sig(t[0, 0, 0, 1, 2]) + 2) / W
+    cy = (sig(t[0, 0, 1, 1, 2]) + 1) / H
+    bw = np.exp(t[0, 0, 2, 1, 2]) * 10 / (32 * W)
+    bh = np.exp(t[0, 0, 3, 1, 2]) * 13 / (32 * H)
+    flat = (0 * H + 1) * W + 2
+    if sig(t[0, 0, 4, 1, 2]) >= 0.01:
+        exp = [max((cx - bw / 2) * 128, 0), max((cy - bh / 2) * 128, 0),
+               min((cx + bw / 2) * 128, 127), min((cy + bh / 2) * 128, 127)]
+        np.testing.assert_allclose(boxes[0, flat], exp, rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(
+            scores[0, flat],
+            sig(t[0, 0, 4, 1, 2]) * sig(t[0, 0, 5:, 1, 2]), rtol=1e-4)
+
+
+def test_yolo_loss_basic():
+    N, A, H, W, nc = 2, 3, 8, 8, 4
+    rs = np.random.RandomState(9)
+    x = paddle.to_tensor(
+        rs.randn(N, A * (5 + nc), H, W).astype(np.float32) * 0.1)
+    x.stop_gradient = False
+    gt = np.zeros((N, 5, 4), np.float32)
+    gt[:, 0] = [0.5, 0.5, 0.2, 0.3]     # one real gt per sample
+    lbl = np.zeros((N, 5), np.int32)
+    lbl[:, 0] = 2
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119, 116, 90,
+               156, 198, 373, 326]
+    loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                       anchors, [0, 1, 2], nc, ignore_thresh=0.7,
+                       downsample_ratio=32)
+    lv = loss.numpy()
+    assert lv.shape == (N,) and np.isfinite(lv).all() and (lv > 0).all()
+    loss.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    # a perfect prediction at the assigned cell lowers the loss
+    x2 = x.numpy().copy()
+    loss2 = V.yolo_loss(paddle.to_tensor(x2 * 0), paddle.to_tensor(gt),
+                        paddle.to_tensor(lbl), anchors, [0, 1, 2], nc,
+                        0.7, 32)
+    assert np.isfinite(loss2.numpy()).all()
+
+
+def test_prior_box():
+    feat = np.zeros((1, 3, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes, var = D.prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+    b, v = boxes.numpy(), var.numpy()
+    # P = 1 (ar=1,min) + 2 (ar=2, 1/2) + 1 (max) = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # cell (0,0): center at (0.5*16)/64 = 0.125; ar=1 min box half = 8/64
+    np.testing.assert_allclose(b[0, 0, 0],
+                               [0.125 - 0.125, 0.125 - 0.125,
+                                0.125 + 0.125, 0.125 + 0.125], atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box():
+    feat = np.zeros((1, 3, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, var = D.density_prior_box(
+        feat, img, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0],
+        flatten_to_2d=True)
+    b = boxes.numpy()
+    assert b.shape == (2 * 2 * 4, 4)
+    w = b[:, 2] - b[:, 0]
+    np.testing.assert_allclose(w, 8 / 32, atol=1e-6)
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 3, 3), np.float32)
+    anchors, var = D.anchor_generator(
+        feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+        variance=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0])
+    a = anchors.numpy()
+    assert a.shape == (3, 3, 2, 4)
+    # ar=1: base 16x16 -> size 32 anchor is 32x32 centered at
+    # x*16 + 0.5*15
+    c = 0.5 * 15
+    np.testing.assert_allclose(a[0, 0, 0],
+                               [c - 15.5, c - 15.5, c + 15.5, c + 15.5],
+                               atol=1e-5)
+
+
+def test_generate_proposals():
+    rs = np.random.RandomState(10)
+    H = W = 4
+    A = 3
+    anchors = D.anchor_generator(
+        np.zeros((1, 1, H, W), np.float32), anchor_sizes=[16.0, 32.0, 64.0],
+        aspect_ratios=[1.0], variance=[1.0] * 4,
+        stride=[16.0, 16.0])[0].numpy()
+    scores = rs.uniform(0, 1, (1, A, H, W)).astype(np.float32)
+    deltas = (rs.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+    rois, probs, nums = D.generate_proposals(
+        scores, deltas, np.array([[64.0, 64.0]], np.float32),
+        anchors, np.ones_like(anchors), pre_nms_top_n=20,
+        post_nms_top_n=10, nms_thresh=0.6, min_size=4.0)
+    r, p, n = rois.numpy()[0], probs.numpy()[0], int(nums.numpy()[0])
+    assert 0 < n <= 10
+    # valid rois are inside the image and big enough
+    v = r[:n]
+    assert (v[:, 0] >= 0).all() and (v[:, 2] <= 63).all()
+    assert ((v[:, 2] - v[:, 0] + 1) >= 4).all()
+    # probs are descending among valid
+    assert (np.diff(p[:n, 0]) <= 1e-6).all()
+    assert (r[n:] == 0).all()
+
+
+def test_distribute_fpn_proposals():
+    # areas chosen to land on distinct levels (refer: level 4, scale 224)
+    rois = np.array([
+        [0, 0, 111, 111],     # sqrt(area)=112 -> level 3
+        [0, 0, 223, 223],     # 224 -> level 4
+        [0, 0, 447, 447],     # 448 -> level 5
+        [0, 0, 27, 27],       # 28 -> clipped to level 2
+    ], np.float32)
+    multi, masks, restore = D.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224,
+        pixel_offset=True)
+    lv = [m.numpy() for m in masks]
+    assert lv[1][0] and lv[2][1] and lv[3][2] and lv[0][3]
+    # each roi appears (zero-padded) in exactly its level slot
+    np.testing.assert_allclose(multi[1].numpy()[0], rois[0])
+    assert (multi[1].numpy()[2] == 0).all()
+    assert sorted(restore.numpy().tolist()) == [0, 1, 2, 3]
+
+
+def test_detection_ops_jit_clean():
+    """The fixed-shape contract exists so detection heads jit — verify a
+    chain (decode -> clip -> multiclass_nms) compiles as one program."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.vision._boxes import nms_mask
+
+    @jax.jit
+    def head(boxes, scores):
+        det, nums = D.multiclass_nms(
+            boxes, scores, score_threshold=0.2, nms_top_k=8, keep_top_k=10,
+            nms_threshold=0.5, background_label=-1)
+        return det._value, nums._value
+
+    rs = np.random.RandomState(11)
+    b = _rand_boxes(rs, 16)[None]
+    s = rs.uniform(0, 1, (1, 3, 16)).astype(np.float32)
+    det, nums = head(jnp.asarray(b), jnp.asarray(s))
+    assert det.shape == (1, 10, 6) and int(nums[0]) >= 0
