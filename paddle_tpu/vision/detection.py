@@ -498,7 +498,9 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     fpn_rois [R, 4]. Fixed-shape contract: every level gets an [R, 4]
     array + a bool mask (invalid rows zeroed) instead of compacted LoD
     outputs; restore_ind is the identity permutation split by mask rank.
-    Returns (multi_rois list, masks list, restore_ind [R]).
+    Returns (multi_rois list, masks list, restore_ind [R]); with
+    rois_num [n_images] given, additionally a list of per-level
+    [n_images] counts (the reference's RoisNum outputs).
     """
     r = _val(ensure_tensor(fpn_rois)).astype(jnp.float32)
     off = 1.0 if pixel_offset else 0.0
@@ -516,4 +518,15 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     order = jnp.argsort(lvl * r.shape[0] + jnp.arange(r.shape[0]))
     restore = jnp.zeros((r.shape[0],), jnp.int32).at[order].set(
         jnp.arange(r.shape[0], dtype=jnp.int32))
-    return multi_rois, masks, Tensor(restore)
+    if rois_num is None:
+        return multi_rois, masks, Tensor(restore)
+    nv = _val(ensure_tensor(rois_num)).astype(jnp.int32)
+    bidx = jnp.repeat(jnp.arange(nv.shape[0]), nv,
+                      total_repeat_length=r.shape[0])
+    per_level_nums = []
+    for level in range(min_level, max_level + 1):
+        m = (lvl == level)
+        per_level_nums.append(Tensor(jnp.sum(
+            m[None, :] & (bidx[None, :] == jnp.arange(nv.shape[0])[:, None]),
+            axis=1).astype(jnp.int32)))
+    return multi_rois, masks, Tensor(restore), per_level_nums

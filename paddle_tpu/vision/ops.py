@@ -132,14 +132,35 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         sy = y1[:, None] + (rh / ph)[:, None] * gy    # [R, ph*ratio]
         sx = x1[:, None] + (rw / pw)[:, None] * gx    # [R, pw*ratio]
 
-        def per_roi(feat, ys, xs):
-            yy = jnp.broadcast_to(ys[:, None], (ys.shape[0], xs.shape[0]))
-            xx = jnp.broadcast_to(xs[None, :], (ys.shape[0], xs.shape[0]))
-            v = _bilinear(feat, yy, xx)               # [C, ph*r, pw*r]
-            C = v.shape[0]
-            return v.reshape(C, ph, ratio, pw, ratio).mean((2, 4))
+        # point gathers straight out of [N,C,H,W] — never materialize a
+        # per-roi feature-map copy (R x C x H x W would dwarf HBM at FPN
+        # scale); each of the 4 corner reads is one batched gather
+        H, W = xv.shape[-2:]
+        yy = jnp.broadcast_to(sy[:, :, None],
+                              sy.shape + (sx.shape[1],))   # [R, S, T]
+        xx = jnp.broadcast_to(sx[:, None, :],
+                              (sy.shape[0], sy.shape[1], sx.shape[1]))
+        outside = (yy < -1.0) | (yy > H) | (xx < -1.0) | (xx > W)
+        yc = jnp.clip(yy, 0.0, H - 1)
+        xc = jnp.clip(xx, 0.0, W - 1)
+        y0 = jnp.floor(yc).astype(jnp.int32)
+        x0 = jnp.floor(xc).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = yc - y0, xc - x0
 
-        return jax.vmap(per_roi)(xv[bidx], sy, sx)
+        def gather4(yi, xi):
+            v = xv[bidx[:, None, None], :, yi, xi]    # [R, S, T, C]
+            return jnp.moveaxis(v, -1, 1)             # [R, C, S, T]
+
+        w = lambda a: a[:, None]                      # noqa: E731
+        val = (w((1 - ly) * (1 - lx)) * gather4(y0, x0)
+               + w((1 - ly) * lx) * gather4(y0, x1i)
+               + w(ly * (1 - lx)) * gather4(y1i, x0)
+               + w(ly * lx) * gather4(y1i, x1i))
+        val = jnp.where(outside[:, None], 0.0, val)
+        R_, C = val.shape[:2]
+        return val.reshape(R_, C, ph, ratio, pw, ratio).mean((3, 5))
 
     x, boxes = ensure_tensor(x), ensure_tensor(boxes)
     nv = _val(ensure_tensor(boxes_num)).astype(jnp.int32)
@@ -199,14 +220,17 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         mw = (wcoord[None, None] >= jnp.clip(ws, 0, W)[..., None]) & \
              (wcoord[None, None] < jnp.clip(we, 0, W)[..., None])
 
-        def per_roi(feat, mhr, mwr):
-            # feat [C,H,W] -> max over bin pixels; empty bin -> 0
+        def per_roi(args):
+            # one roi at a time (lax.map bounds live memory at
+            # [C, ph, H, W] instead of vmap's [R, C, ph, H, W])
+            b, mhr, mwr = args
+            feat = xv[b]                               # [C, H, W]
             t = jnp.where(mhr[None, :, :, None], feat[:, None], NEG_INF)
             t = t.max(2)                               # [C, ph, W]
             o = jnp.where(mwr[None, None], t[:, :, None], NEG_INF).max(3)
             return jnp.where(o <= NEG_INF / 2, 0.0, o)  # [C, ph, pw]
 
-        return jax.vmap(per_roi)(xv[bidx], mh, mw)
+        return jax.lax.map(per_roi, (bidx, mh, mw))
 
     x, boxes = ensure_tensor(x), ensure_tensor(boxes)
     nv = _val(ensure_tensor(boxes_num)).astype(jnp.int32)
@@ -243,11 +267,14 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             f"psroi_pool: channels {C} not divisible by {ph}*{pw}"
         oc = C // (ph * pw)
         bidx = _batch_index(nv, R, N)
-        sb = bv * spatial_scale
-        x1 = jnp.round(sb[:, 0])
-        y1 = jnp.round(sb[:, 1])
-        rw = jnp.maximum(jnp.round(sb[:, 2]) - x1, 0.1)
-        rh = jnp.maximum(jnp.round(sb[:, 3]) - y1, 0.1)
+        # reference order of operations: round the box IN INPUT COORDS,
+        # end pixel inclusive (+1), THEN scale (`psroi_pool_op.cc`)
+        x1 = jnp.round(bv[:, 0]) * spatial_scale
+        y1 = jnp.round(bv[:, 1]) * spatial_scale
+        rw = jnp.maximum(
+            (jnp.round(bv[:, 2]) + 1.0) * spatial_scale - x1, 0.1)
+        rh = jnp.maximum(
+            (jnp.round(bv[:, 3]) + 1.0) * spatial_scale - y1, 0.1)
 
         i = jnp.arange(ph)
         j = jnp.arange(pw)
@@ -262,17 +289,17 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         mw = (wcoord[None, None] >= jnp.clip(ws, 0, W)[..., None]) & \
              (wcoord[None, None] < jnp.clip(we, 0, W)[..., None])
 
-        def per_roi(feat, mhr, mwr):
-            # feat [C,H,W] -> [oc, ph, pw, H, W] position-sensitive view
-            f = feat.reshape(oc, ph, pw, H, W)
+        def per_roi(args):
+            b, mhr, mwr = args
+            f = xv[b].reshape(oc, ph, pw, H, W)   # position-sensitive view
             m = mhr[:, None, :, None] * mwr[None, :, None, :]  # [ph,pw,H,W]
             s = (f * m[None]).sum((3, 4))
             cnt = m.sum((2, 3))
             return jnp.where(cnt[None] > 0, s / jnp.maximum(cnt[None], 1),
                              0.0)
 
-        return jax.vmap(per_roi)(
-            xv[bidx], mh.astype(xv.dtype), mw.astype(xv.dtype))
+        return jax.lax.map(
+            per_roi, (bidx, mh.astype(xv.dtype), mw.astype(xv.dtype)))
 
     x, boxes = ensure_tensor(x), ensure_tensor(boxes)
     nv = _val(ensure_tensor(boxes_num)).astype(jnp.int32)
@@ -519,23 +546,17 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             return jnp.maximum(logit, 0) - logit * label + \
                 jnp.log1p(jnp.exp(-jnp.abs(logit)))
 
-        # one-hot scatter of each gt onto its (a, gj, gi) cell
-        onehot = (assigned[..., None, None, None]
-                  & (a_pos[..., None, None, None]
-                     == jnp.arange(A)[None, None, :, None, None])
-                  & (gj[..., None, None, None]
-                     == jnp.arange(H)[None, None, None, :, None])
-                  & (gi[..., None, None, None]
-                     == jnp.arange(W)[None, None, None, None, :])
-                  ).astype(xv.dtype)                # [N, B, A, H, W]
+        # gather each gt's prediction vector at its (a, gj, gi) cell —
+        # [N, B, 5+nc] instead of broadcasting losses over the whole
+        # [N, B, A, nc, H, W] grid (which is ~GBs at 52x52/80-class scale)
+        def gather_gt(tn, ap, gjn, gin):
+            return tn[jnp.clip(ap, 0, A - 1), :, gjn, gin]  # [B, 5+nc]
 
-        pred = t[:, None]                           # [N, 1, A, 5+nc, H, W]
-        loc = (sce(pred[:, :, :, 0], tx[..., None, None, None])
-               + sce(pred[:, :, :, 1], ty[..., None, None, None])
-               + jnp.abs(pred[:, :, :, 2] - tw[..., None, None, None])
-               + jnp.abs(pred[:, :, :, 3] - th[..., None, None, None]))
-        loc_loss = (loc * onehot * scale[..., None, None, None]
-                    ).sum((1, 2, 3, 4))
+        pg = jax.vmap(gather_gt)(t, a_pos, gj, gi)
+        amask = assigned.astype(xv.dtype)
+        loc = (sce(pg[..., 0], tx) + sce(pg[..., 1], ty)
+               + jnp.abs(pg[..., 2] - tw) + jnp.abs(pg[..., 3] - th))
+        loc_loss = (loc * amask * scale).sum(1)
 
         if use_label_smooth:
             eps = min(1.0 / nc, 1.0 / 40.0)
@@ -544,10 +565,18 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             pos_l, neg_l = 1.0, 0.0
         cls_target = jnp.where(
             (glv[..., None] == jnp.arange(nc)), pos_l, neg_l)  # [N,B,nc]
-        cls = sce(pred[:, :, :, 5:],
-                  cls_target[:, :, None, :, None, None])
-        cls_loss = (cls * onehot[:, :, :, None] *
-                    gsv[..., None, None, None, None]).sum((1, 2, 3, 4, 5))
+        cls = sce(pg[..., 5:], cls_target)
+        cls_loss = (cls * (amask * gsv)[..., None]).sum((1, 2))
+
+        # positive-cell scatter for the objectness term (flat [A*H*W]
+        # grid per sample; unassigned gts index off the end and drop)
+        flat_cell = (jnp.clip(a_pos, 0, A - 1) * H + gj) * W + gi
+        flat_cell = jnp.where(assigned, flat_cell, A * H * W)
+        nidx = jnp.broadcast_to(jnp.arange(N)[:, None], flat_cell.shape)
+        is_pos = jnp.zeros((N, A * H * W), xv.dtype).at[
+            nidx, flat_cell].max(1.0, mode="drop").reshape(N, A, H, W)
+        obj_pos = jnp.zeros((N, A * H * W), xv.dtype).at[
+            nidx, flat_cell].add(gsv, mode="drop").reshape(N, A, H, W)
 
         # objectness: decode pred boxes, iou vs gts for the ignore mask
         bias = 0.5 * (scale_x_y - 1.0)
@@ -570,9 +599,6 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             return m.max(-1).reshape(A, H, W)
 
         best_iou = jax.vmap(per_sample_iou)(pb, gb, valid)
-        # positive-cell weight = gt_score of the gt assigned there
-        obj_pos = (onehot * gsv[..., None, None, None]).sum(1)
-        is_pos = onehot.max(1)                       # [N, A, H, W]
         ignore = (best_iou > ignore_thresh) & (is_pos < 0.5)
         obj_logit = t[:, :, 4]
         obj_loss = jnp.where(

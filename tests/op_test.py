@@ -1,0 +1,82 @@
+"""OpTest-style central gradient-check harness.
+
+Parity target: the reference's OpTest finite-difference grad check
+(`python/paddle/fluid/tests/unittests/op_test.py:274` get_numeric_gradient,
+`:1420` check_grad_with_place). Instead of per-op kernels registering a
+hand-written backward to validate, every op here is a jax.vjp — so this
+harness checks the ENTIRE differentiation path (op -> tape -> jax.vjp)
+against central differences, the same oracle the reference uses
+(delta perturbation per element, max-relative-error acceptance).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn_np, inputs, wrt, delta=5e-3):
+    """Central-difference d(sum(fn(*inputs)))/d(inputs[wrt]).
+
+    fn_np: callable over numpy arrays returning an array (any shape —
+    reduced by sum, matching the all-ones output cotangent used for the
+    analytic side). Mirrors `op_test.py:274` get_numeric_gradient.
+    """
+    x = [np.asarray(a, np.float32).copy() for a in inputs]
+    g = np.zeros_like(x[wrt], np.float64)
+    flat = x[wrt].reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = float(np.sum(np.asarray(fn_np(*x), np.float64)))
+        flat[i] = orig - delta
+        fm = float(np.sum(np.asarray(fn_np(*x), np.float64)))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * delta)
+    return g.astype(np.float32)
+
+
+def check_grad(op, inputs, grad_inputs=None, delta=5e-3, max_relative_error=5e-3,
+               extra_kwargs=None):
+    """Assert analytic grads (tape backward) match central differences.
+
+    op: callable over paddle Tensors -> Tensor (or tuple; all outputs are
+    summed). inputs: list of numpy arrays. grad_inputs: indices to check
+    (default: all). Acceptance: max(|a - n|) / max(1, max|n|) <=
+    max_relative_error — the reference OpTest criterion
+    (`op_test.py:1420` _assert_is_close).
+    """
+    extra_kwargs = extra_kwargs or {}
+    idxs = list(range(len(inputs))) if grad_inputs is None else grad_inputs
+
+    ts = []
+    for i, a in enumerate(inputs):
+        t = paddle.to_tensor(np.asarray(a, np.float32))
+        t.stop_gradient = i not in idxs
+        ts.append(t)
+    out = op(*ts, **extra_kwargs)
+    if isinstance(out, (tuple, list)):
+        total = None
+        for o in out:
+            s = o.sum()
+            total = s if total is None else total + s
+    else:
+        total = out.sum()
+    total.backward()
+
+    def fn_np(*arrays):
+        t2 = [paddle.to_tensor(a) for a in arrays]
+        o = op(*t2, **extra_kwargs)
+        if isinstance(o, (tuple, list)):
+            return sum(np.sum(x.numpy()) for x in o)
+        return o.numpy()
+
+    for i in idxs:
+        analytic = ts[i].grad
+        assert analytic is not None, f"input {i}: no gradient recorded"
+        a = analytic.numpy()
+        n = numeric_grad(fn_np, inputs, i, delta)
+        scale = max(1.0, float(np.abs(n).max()))
+        err = float(np.abs(a - n).max()) / scale
+        assert err <= max_relative_error, (
+            f"input {i}: max relative grad error {err:.2e} > "
+            f"{max_relative_error:.0e}\nanalytic:\n{a}\nnumeric:\n{n}")

@@ -217,6 +217,27 @@ def test_psroi_pool_shapes_and_avg():
     np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-5)
 
 
+def test_psroi_pool_end_inclusive():
+    """Reference bin arithmetic: box [0,0,3,3] at scale 1 pools the FULL
+    4x4 map (end pixel inclusive, +1 before scaling)."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = V.psroi_pool(x, np.array([[0, 0, 3, 3]], np.float32), [1],
+                       output_size=1).numpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0], 7.5, atol=1e-5)
+
+
+def test_distribute_fpn_rois_num():
+    rois = np.array([[0, 0, 111, 111], [0, 0, 223, 223],
+                     [0, 0, 447, 447]], np.float32)
+    multi, masks, restore, nums = D.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, pixel_offset=True,
+        rois_num=np.array([2, 1], np.int32))
+    per_level = [n.numpy().tolist() for n in nums]
+    # image 0 owns rois 0-1 (levels 3, 4); image 1 owns roi 2 (level 5)
+    assert per_level[1] == [1, 0] and per_level[2] == [1, 0]
+    assert per_level[3] == [0, 1] and per_level[0] == [0, 0]
+
+
 def test_deform_conv2d_zero_offset_equals_conv2d():
     import paddle_tpu.nn.functional as F
     rs = np.random.RandomState(6)

@@ -1,0 +1,221 @@
+"""Finite-difference gradient checks for the most-used ops and EVERY loss.
+
+The reference validates each op kernel's hand-written backward via
+OpTest's numeric gradients (`tests/unittests/op_test.py`); here the same
+oracle is pointed at the tape+jax.vjp path. Inputs are kept away from
+non-differentiable points (|x| bounded below for abs/sqrt kinks, labels
+one-hot away from clamps) exactly like the reference tests do.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+RS = np.random.RandomState(7)
+
+
+def _x(*shape, lo=-2.0, hi=2.0):
+    return RS.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _pos(*shape, lo=0.3, hi=2.0):
+    return RS.uniform(lo, hi, shape).astype(np.float32)
+
+
+# ---- elementwise unary ----------------------------------------------------
+
+@pytest.mark.parametrize("op,data", [
+    (paddle.exp, _x(3, 4)),
+    (paddle.log, _pos(3, 4)),
+    (paddle.sqrt, _pos(3, 4)),
+    (paddle.rsqrt, _pos(3, 4)),
+    (paddle.tanh, _x(3, 4)),
+    (paddle.sin, _x(3, 4)),
+    (paddle.cos, _x(3, 4)),
+    (paddle.sigmoid, _x(3, 4)),
+    (paddle.square, _x(3, 4)),
+    (paddle.reciprocal, _pos(3, 4)),
+], ids=["exp", "log", "sqrt", "rsqrt", "tanh", "sin", "cos", "sigmoid",
+        "square", "reciprocal"])
+def test_unary(op, data):
+    check_grad(op, [data])
+
+
+def _kinked(*shape, gap=0.1):
+    """Uniform values pushed at least `gap` away from 0 (the relu-family
+    kink) so the central difference never straddles it."""
+    x = _x(*shape)
+    return (x + np.sign(x) * gap).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,data", [
+    (F.relu, _kinked(4, 5)),
+    (F.gelu, _x(4, 5)),
+    (F.silu, _x(4, 5)),
+    (F.elu, _kinked(4, 5)),
+    (F.softplus, _x(4, 5)),
+    (F.hardswish, _kinked(4, 5) * 2),
+    (F.leaky_relu, _kinked(4, 5)),
+], ids=["relu", "gelu", "silu", "elu", "softplus", "hardswish",
+        "leaky_relu"])
+def test_activation(op, data):
+    check_grad(op, [data])
+
+
+# ---- binary / broadcast ---------------------------------------------------
+
+@pytest.mark.parametrize("op", [paddle.add, paddle.subtract,
+                                paddle.multiply, paddle.divide,
+                                paddle.maximum, paddle.minimum],
+                         ids=["add", "sub", "mul", "div", "max", "min"])
+def test_binary_broadcast(op):
+    a = _x(3, 4)
+    b = _pos(1, 4) + 1.0          # away from a==b ties and zero divisors
+    check_grad(op, [a, b])
+
+
+def test_pow():
+    check_grad(lambda x: paddle.pow(x, 3.0), [_pos(3, 3)])
+
+
+# ---- reductions / shape ---------------------------------------------------
+
+def test_reductions():
+    check_grad(lambda x: x.sum(), [_x(3, 4)])
+    check_grad(lambda x: x.mean(axis=1), [_x(3, 4)])
+    check_grad(lambda x: paddle.max(x, axis=1), [_x(3, 4) * 3])
+    check_grad(lambda x: paddle.logsumexp(x, axis=1), [_x(3, 4)])
+
+
+def test_shape_ops():
+    check_grad(lambda x: paddle.reshape(x, [2, 6]), [_x(3, 4)])
+    check_grad(lambda x: paddle.transpose(x, [1, 0]), [_x(3, 4)])
+    check_grad(lambda x, y: paddle.concat([x, y], axis=1),
+               [_x(3, 2), _x(3, 3)])
+    check_grad(lambda x: paddle.slice(x, [0, 1], [0, 1], [2, 3]),
+               [_x(3, 4)])
+    check_grad(lambda x: paddle.squeeze(paddle.unsqueeze(x, 0), 0),
+               [_x(3, 4)])
+
+
+def test_gather_indexing():
+    idx = paddle.to_tensor(np.array([2, 0, 1], np.int32))
+    check_grad(lambda x: paddle.gather(x, idx), [_x(4, 3)])
+    check_grad(lambda x: paddle.index_select(x, idx, axis=1), [_x(3, 4)])
+
+
+# ---- matmul / nn ----------------------------------------------------------
+
+def test_matmul():
+    check_grad(paddle.matmul, [_x(3, 4), _x(4, 5)])
+    check_grad(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+               [_x(2, 3, 4), _x(2, 5, 4)])
+
+
+def test_linear_softmax():
+    w, b = _x(4, 5), _x(5)
+    check_grad(lambda x, wv, bv: F.linear(x, wv, bv), [_x(3, 4), w, b])
+    check_grad(lambda x: F.softmax(x, axis=-1), [_x(3, 4)])
+    check_grad(lambda x: F.log_softmax(x, axis=-1), [_x(3, 4)])
+
+
+def test_conv2d_grad():
+    check_grad(lambda x, w: F.conv2d(x, w, padding=1),
+               [_x(1, 2, 5, 5), _x(3, 2, 3, 3)], max_relative_error=1e-2)
+
+
+def test_pool_grad():
+    check_grad(lambda x: F.avg_pool2d(x, 2, 2), [_x(1, 2, 4, 4)])
+    # distinct values -> unique argmax -> smooth locally
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    RS.shuffle(x.reshape(-1))
+    check_grad(lambda t: F.max_pool2d(t, 2, 2), [x])
+
+
+def test_layer_norm_grad():
+    g, b = _pos(4), _x(4)
+    check_grad(lambda x, gv, bv: F.layer_norm(x, [4], gv, bv),
+               [_x(3, 4), g, b], max_relative_error=1e-2)
+
+
+def test_embedding_grad():
+    ids = paddle.to_tensor(np.array([[0, 2], [1, 2]], np.int32))
+    check_grad(lambda w: F.embedding(ids, w), [_x(4, 3)])
+
+
+# ---- every loss -----------------------------------------------------------
+
+def test_loss_cross_entropy_family():
+    logits = _x(4, 5)
+    labels = np.array([0, 2, 4, 1], np.int64)
+    lt = paddle.to_tensor(labels)
+    check_grad(lambda x: F.cross_entropy(x, lt), [logits])
+    check_grad(lambda x: F.nll_loss(F.log_softmax(x, -1), lt), [logits])
+    check_grad(lambda x: F.softmax_with_cross_entropy(x, lt[:, None]),
+               [logits])
+    soft = np.abs(_x(4, 5)) + 0.1
+    soft = (soft / soft.sum(-1, keepdims=True)).astype(np.float32)
+    check_grad(lambda x, s: F.softmax_with_cross_entropy(
+        x, s, soft_label=True), [logits, soft], grad_inputs=[0])
+
+
+def test_loss_regression_family():
+    a, b = _x(3, 4), _x(3, 4) + 0.05   # avoid |a-b|=0 and =delta kinks
+    check_grad(lambda x, y: F.mse_loss(x, y), [a, b])
+    check_grad(lambda x, y: F.l1_loss(x, y), [a, b])
+    check_grad(lambda x, y: F.smooth_l1_loss(x, y), [a, b])
+    check_grad(lambda x, y: F.square_error_cost(x, y), [a, b])
+
+
+def test_loss_binary_family():
+    p = np.clip(np.abs(_x(3, 4)), 0.1, 0.9).astype(np.float32)
+    y = (RS.rand(3, 4) > 0.5).astype(np.float32)
+    yt = paddle.to_tensor(y)
+    check_grad(lambda x: F.binary_cross_entropy(x, yt), [p])
+    check_grad(lambda x: F.binary_cross_entropy_with_logits(x, yt),
+               [_x(3, 4)])
+    check_grad(lambda x: F.log_loss(x, yt), [p])
+    check_grad(lambda x: F.sigmoid_focal_loss(x, yt), [_x(3, 4)])
+
+
+def test_loss_distance_family():
+    y = np.sign(RS.randn(3)).astype(np.float32)
+    yt = paddle.to_tensor(y)
+    check_grad(lambda a, b: F.margin_ranking_loss(a, b, yt),
+               [_x(3) * 2, _x(3) * 2 + 3.0])  # away from the hinge kink
+    check_grad(lambda a, b: F.cosine_embedding_loss(a, b, yt),
+               [_x(3, 4), _x(3, 4) + 2.5], max_relative_error=1e-2)
+    check_grad(lambda a, p, n: F.triplet_margin_loss(a, p, n, margin=10.0),
+               [_x(3, 4), _x(3, 4) + 0.3, _x(3, 4) - 0.3],
+               max_relative_error=1e-2)
+    check_grad(lambda a, p: F.npair_loss(a, p, paddle.to_tensor(
+        np.array([0, 1, 2], np.int64))), [_x(3, 4), _x(3, 4)],
+        max_relative_error=1e-2)
+
+
+def test_loss_kl_hinge():
+    logp = np.log(np.clip(np.abs(_x(3, 4)), 0.1, 0.9)).astype(np.float32)
+    q = np.clip(np.abs(_x(3, 4)), 0.1, 0.9).astype(np.float32)
+    qt = paddle.to_tensor(q)
+    check_grad(lambda x: F.kl_div(x, qt), [logp])
+    y = np.sign(RS.randn(3, 4)).astype(np.float32)
+    a = _x(3, 4) * 2 + np.where(y > 0, 0.0, 3.0)   # keep off the margin
+    check_grad(lambda x: F.hinge_embedding_loss(x, paddle.to_tensor(y)),
+               [a])
+
+
+def test_loss_ctc():
+    """CTC loss grad vs numeric — the hardest loss in the family
+    (dynamic-programming forward, reference `warpctc_op.cc`)."""
+    T, B, C = 5, 2, 4
+    logits = (_x(T, B, C) * 0.5).astype(np.float32)
+    logp = paddle.nn.functional.log_softmax(
+        paddle.to_tensor(logits), axis=-1)
+    labels = paddle.to_tensor(np.array([[1, 2], [2, 3]], np.int32))
+    il = paddle.to_tensor(np.array([T, T], np.int64))
+    ll = paddle.to_tensor(np.array([2, 2], np.int64))
+    check_grad(
+        lambda x: F.ctc_loss(F.log_softmax(x, axis=-1), labels, il, ll),
+        [logits], max_relative_error=1e-2)
