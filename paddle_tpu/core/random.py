@@ -21,12 +21,19 @@ import jax
 
 
 class Generator:
+    """Key creation is LAZY: importing paddle_tpu must not initialize the
+    XLA backend, or `distributed.init_distributed` (which must run before
+    any backend touch — jax.distributed contract) could never be called
+    after the import."""
+
     def __init__(self, seed=0):
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._seed = seed
 
     def manual_seed(self, seed):
-        self._key = jax.random.PRNGKey(seed)
+        # stay lazy: seeding must also be legal before backend init
+        # (`paddle.seed(42)` before `init_distributed()` is common)
+        self._key = None
         self._seed = seed
         return self
 
@@ -36,10 +43,14 @@ class Generator:
 
     def split(self):
         """Return a fresh subkey, advancing internal state."""
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         return self._key
 
     def set_state(self, key):

@@ -3,11 +3,17 @@
 Reference analog: `fleet/elastic/manager.py:103` — etcd3-backed node
 registry with scale-in/out vs fault classification
 (PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL, `manager.py:118`) and the
-ELASTIC_EXIT_CODE=101 relaunch protocol. TPU-native substitution: the
-registry is a shared filesystem directory of heartbeat files (GCS/NFS on a
-pod; etcd adds nothing once the scheduler owns pod lifecycle), and recovery
-is checkpoint-restart — on TPU a lost host invalidates the ICI mesh, so the
-manager's job is detection + relaunch decision, not in-place repair.
+ELASTIC_EXIT_CODE=101 relaunch protocol. Two registry backends:
+
+- shared filesystem directory of heartbeat files (GCS/NFS on a pod —
+  fine when a shared mount exists);
+- the TCP KV store (`kvstore.KVClient` -> `csrc/kvstore.cc`), the
+  cross-host path matching the reference's etcd store (`manager.py:147`)
+  with no shared-filesystem assumption.
+
+Recovery is checkpoint-restart — on TPU a lost host invalidates the ICI
+mesh, so the manager's job is detection + relaunch decision, not
+in-place repair.
 """
 import json
 import os
@@ -25,18 +31,27 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    """Register this host in a shared dir; watch membership.
+    """Register this host in a shared registry; watch membership.
+
+    Backends: `registry_dir` (heartbeat files on a shared mount) or
+    `store` (a `kvstore.KVClient` to the job's TCP store — the etcd
+    analog, works across hosts with no shared filesystem).
 
     fault_tolerance_level 0: any change -> EXIT (job-level restart);
     level >= 1: missing host -> RESTART (relaunch protocol), new host ->
     RESTART with the larger world.
     """
 
-    def __init__(self, registry_dir, np=None, host_id=None,  # noqa: A002
+    def __init__(self, registry_dir=None, np=None, host_id=None,  # noqa: A002
                  heartbeat_interval=1.0, timeout=5.0,
-                 fault_tolerance_level=None):
+                 fault_tolerance_level=None, store=None):
+        if (registry_dir is None) == (store is None):
+            raise ValueError("ElasticManager: pass exactly one of "
+                             "registry_dir or store")
         self.dir = registry_dir
-        os.makedirs(self.dir, exist_ok=True)
+        self.store = store
+        if self.dir is not None:
+            os.makedirs(self.dir, exist_ok=True)
         self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         self.host_id = host_id if host_id is not None else \
             os.environ.get("PADDLE_TRAINER_ID", "0")
@@ -57,28 +72,57 @@ class ElasticManager:
         return self
 
     def heartbeat(self):
+        rec = json.dumps({"host": self.host_id, "ts": time.time(),
+                          "np": self.np})
+        if self.store is not None:
+            self.store.set(f"__elastic__/host-{self.host_id}", rec)
+            return
         tmp = self._path(self.host_id) + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"host": self.host_id, "ts": time.time(),
-                       "np": self.np}, f)
+            f.write(rec)
         os.replace(tmp, self._path(self.host_id))
 
     def deregister(self):
+        if self.store is not None:
+            self.store.delete(f"__elastic__/host-{self.host_id}")
+            return
         try:
             os.remove(self._path(self.host_id))
         except FileNotFoundError:
             pass
 
+    def _records(self):
+        if self.store is not None:
+            # transient coordinator unreachability must classify (stale
+            # hosts age out via ts), not crash the watcher — mirror the
+            # fs backend's per-record OSError tolerance
+            try:
+                keys = self.store.list("__elastic__/host-")
+            except ConnectionError:
+                return
+            for key in keys:
+                try:
+                    raw = self.store.get(key)
+                except ConnectionError:
+                    continue
+                if raw is not None:
+                    yield raw
+            return
+        for name in os.listdir(self.dir):
+            if name.startswith("host-") and name.endswith(".json"):
+                try:
+                    with open(os.path.join(self.dir, name), "rb") as f:
+                        yield f.read()
+                except OSError:
+                    continue
+
     def alive_hosts(self):
         now = time.time()
         alive = []
-        for name in os.listdir(self.dir):
-            if not name.startswith("host-") or not name.endswith(".json"):
-                continue
+        for raw in self._records():
             try:
-                with open(os.path.join(self.dir, name)) as f:
-                    rec = json.load(f)
-            except (OSError, ValueError):
+                rec = json.loads(raw)
+            except ValueError:
                 continue
             if now - rec.get("ts", 0) <= self.timeout:
                 alive.append(str(rec["host"]))

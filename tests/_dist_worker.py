@@ -1,0 +1,70 @@
+"""Subprocess worker for the real 2-process jax.distributed test.
+
+Launched by tests/test_multiprocess.py with PTPU_* env vars. Follows the
+reference's multi-process test harness pattern
+(`tests/unittests/test_dist_base.py:734` — spawn real trainer processes,
+compare their losses), using gloo CPU collectives as the DCN stand-in.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    rank = int(os.environ["PTPU_RANK"])
+    world = int(os.environ["PTPU_WORLD"])
+    coord = os.environ["PTPU_COORD"]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import env as dist_env
+
+    # exercise the framework's own wrapper, not raw jax.distributed
+    dist_env.init_distributed(coordinator=coord, num_processes=world,
+                              process_id=rank)
+    assert jax.process_count() == world
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == 2 * world and n_local == 2
+
+    # a dp mesh spanning both processes; each process contributes its
+    # local shard, a jit'd global mean reduces across process boundaries
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = dist.build_mesh(dp=n_global)
+    sharding = NamedSharding(mesh, P("dp"))
+    global_shape = (n_global * 3,)
+    # value depends on the GLOBAL index so the result proves cross-process
+    # data actually met in the reduction
+    arr = jax.make_array_from_callback(
+        global_shape, sharding,
+        lambda idx: np.arange(*idx[0].indices(global_shape[0]),
+                              dtype=np.float32) ** 2)
+    total = jax.jit(lambda a: jnp.sum(a))(arr)
+    expected = float(np.sum(np.arange(global_shape[0],
+                                      dtype=np.float32) ** 2))
+
+    # cross-process KV store smoke from inside the job
+    from paddle_tpu.distributed.kvstore import KVClient
+    with KVClient(port=int(os.environ["PTPU_KV_PORT"])) as kv:
+        kv.barrier("inside-job", world, timeout_s=30)
+        kv.set(f"result/{rank}", json.dumps(
+            {"total": float(total), "expected": expected,
+             "rank": rank, "n_global": n_global}))
+
+    print(json.dumps({"ok": abs(float(total) - expected) < 1e-3,
+                      "rank": rank}))
+
+
+if __name__ == "__main__":
+    main()
