@@ -1,9 +1,11 @@
 """Single-chip flagship benchmark: GPT train step (fwd+bwd+AdamW, one fused
-XLA program) tokens/sec/chip and model FLOPs utilization.
+XLA program) tokens/sec/chip and MFU, plus the ResNet-50 conv-path
+images/sec (BASELINE.md config 2).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline = achieved MFU / 0.40 (the BASELINE.json north-star MFU target;
-the reference publishes no absolute numbers, see BASELINE.md).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with the
+ResNet numbers as extra keys on the same object.
+vs_baseline = achieved GPT MFU / 0.40 (the BASELINE.json north-star MFU
+target; the reference publishes no absolute numbers, see BASELINE.md).
 """
 import json
 import sys
@@ -26,6 +28,29 @@ def _peak_flops(kind):
         if key in kind:
             return val
     return None
+
+
+def _time_train_steps(step, inputs, steps, warmup):
+    """Shared timing discipline for every phase.
+
+    NOTE: under the axon tunnel `block_until_ready` returns before the
+    remote computation finishes, so synchronization must be a real
+    device->host transfer. Steps chain through the donated params, so
+    fetching the final loss scalar forces the whole timed sequence; the
+    measured transfer round-trip latency is subtracted. Returns
+    (seconds_per_step, last_loss)."""
+    for _ in range(warmup):
+        loss = step(*inputs)
+    float(loss.item())  # sync
+    t0 = time.perf_counter()
+    float(loss.item())
+    fetch_latency = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*inputs)
+    float(loss.item())  # sync: forces all chained steps
+    dt = max(1e-9, time.perf_counter() - t0 - fetch_latency)
+    return dt / steps, loss
 
 
 def main():
@@ -63,26 +88,8 @@ def main():
     lbl = paddle.to_tensor(
         rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
 
-    # NOTE on timing: under the axon tunnel block_until_ready returns before
-    # the remote computation finishes, so synchronization must be a real
-    # device->host transfer. Steps chain through the donated params, so
-    # fetching the final loss scalar forces the whole timed sequence; the
-    # measured transfer round-trip latency is subtracted.
-    for _ in range(warmup):
-        loss = step(ids, lbl)
-    float(loss.item())  # sync
-
-    t0 = time.perf_counter()
-    float(loss.item())
-    fetch_latency = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, lbl)
-    float(loss.item())  # sync: forces all chained steps
-    dt = max(1e-9, time.perf_counter() - t0 - fetch_latency)
-
-    tokens_per_sec = batch * seq * steps / dt
+    sec_per_step, loss = _time_train_steps(step, (ids, lbl), steps, warmup)
+    tokens_per_sec = batch * seq / sec_per_step
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     # PaLM-style train FLOPs/token: 6N for matmuls + 12*L*H*S for attention
@@ -90,15 +97,55 @@ def main():
     peak = _peak_flops(dev.device_kind) if on_tpu else None
     mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
 
+    resnet = bench_resnet50(on_tpu, peak)
+
     print(json.dumps({
         "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.40, 4),
+        "resnet50_images_per_sec_per_chip": resnet["images_per_sec"],
+        "resnet50_mfu": resnet["mfu"],
     }))
     print(f"# device={dev.device_kind} loss={loss.item():.4f} "
           f"mfu={mfu:.3f} params={n_params/1e6:.1f}M "
-          f"step={dt/steps*1000:.1f}ms", file=sys.stderr)
+          f"step={sec_per_step*1000:.1f}ms "
+          f"resnet50={resnet['images_per_sec']:.0f}img/s",
+          file=sys.stderr)
+
+
+def bench_resnet50(on_tpu, peak):
+    """ResNet-50 fwd+bwd+Momentum images/sec/chip (BASELINE.md config 2:
+    the conv/BN path). Same chained-on-donated-params timing discipline as
+    the GPT phase. Train FLOPs/img ~= 3 x 4.089 GFLOP fwd at 224^2."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch, steps, warmup = 64, 20, 3
+    else:
+        batch, steps, warmup = 2, 2, 1
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(x, y):
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return F.cross_entropy(model(x), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(batch, 3, 224, 224).astype(np.float32))
+    y = paddle.to_tensor(rs.randint(0, 1000, (batch,)).astype(np.int32))
+
+    sec_per_step, _ = _time_train_steps(step, (x, y), steps, warmup)
+    ips = batch / sec_per_step
+    mfu = (ips * 3 * 4.089e9 / peak) if peak else 0.0
+    return {"images_per_sec": round(ips, 1), "mfu": round(mfu, 4)}
 
 
 if __name__ == "__main__":

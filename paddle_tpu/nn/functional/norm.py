@@ -6,6 +6,7 @@ Parity: `python/paddle/nn/functional/norm.py` (reference kernels
 is also provided as a Pallas kernel in `paddle_tpu.ops.pallas` for the
 residual+dropout fusion cases.
 """
+import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor, apply
@@ -58,43 +59,58 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
+        def _stats(v):
+            # f32-ACCUMULATING reductions straight off the (possibly bf16)
+            # input: `v.astype(f32)` first would materialize a full f32
+            # activation copy in HLO (measured: +14 GB/step traffic on
+            # ResNet-50/64 — conv nets are bandwidth-bound on TPU). The
+            # variance pass squares the CENTERED bf16 values, avoiding the
+            # E[x^2]-E[x]^2 cancellation while keeping elementwise work in
+            # the input dtype.
+            mean = jnp.mean(v, axis=red_axes, dtype=jnp.float32)
+            d = v - mean.astype(v.dtype).reshape(bshape)
+            var = jnp.mean(jnp.square(d), axis=red_axes, dtype=jnp.float32)
+            return mean, var
+
         # update running stats in place with (stop-gradient) batch stats;
         # tracer-safe under jit via the functional-state capture in paddle_tpu.jit
-        xv32 = x._value.astype(jnp.float32)
-        bmean = jnp.mean(xv32, axis=red_axes)
-        bvar = jnp.var(xv32, axis=red_axes)
+        bmean, bvar = _stats(x._value)
         running_mean._value = (momentum * running_mean._value.astype(jnp.float32)
                                + (1 - momentum) * bmean).astype(running_mean._value.dtype)
         running_var._value = (momentum * running_var._value.astype(jnp.float32)
                               + (1 - momentum) * bvar).astype(running_var._value.dtype)
 
         def fn(v, *wb):
-            # batch stats recomputed inside so grads flow through mean/var
-            v32 = v.astype(jnp.float32)
-            mean = jnp.mean(v32, axis=red_axes).reshape(bshape)
-            var = jnp.var(v32, axis=red_axes).reshape(bshape)
-            out = (v32 - mean) * jnp.power(var + epsilon, -0.5)
-            out = out.astype(v.dtype)
+            # batch stats recomputed inside so grads flow through mean/var.
+            # The normalize is FOLDED into one per-channel multiply-add in
+            # the INPUT dtype: out = v*a + c with a = w*rsqrt(var+eps),
+            # c = b - mean*a — so every activation-sized tensor (and the
+            # vjp's saved residuals) stays bf16 under AMP.
+            mean, var = _stats(v)
+            a = jax.lax.rsqrt(var + epsilon)
             i = 0
             if weight is not None:
-                out = out * wb[i].reshape(bshape)
+                a = a * wb[i]
                 i += 1
+            c = -mean * a
             if bias is not None:
-                out = out + wb[i].reshape(bshape)
-            return out
+                c = c + wb[i]
+            return v * a.reshape(bshape).astype(v.dtype) + \
+                c.reshape(bshape).astype(v.dtype)
     else:
         mean_c, var_c = running_mean._value, running_var._value
 
         def fn(v, *wb):
-            out = (v - mean_c.reshape(bshape).astype(v.dtype)) * \
-                jnp.power(var_c.reshape(bshape).astype(v.dtype) + epsilon, -0.5)
+            a = jax.lax.rsqrt(var_c.astype(jnp.float32) + epsilon)
             i = 0
             if weight is not None:
-                out = out * wb[i].reshape(bshape)
+                a = a * wb[i]
                 i += 1
+            c = -mean_c.astype(jnp.float32) * a
             if bias is not None:
-                out = out + wb[i].reshape(bshape)
-            return out
+                c = c + wb[i]
+            return v * a.reshape(bshape).astype(v.dtype) + \
+                c.reshape(bshape).astype(v.dtype)
 
     args = [x]
     if weight is not None:

@@ -36,10 +36,10 @@ def _pool(x, kernel, stride, padding, nd, channel_last, reducer, init,
             else:
                 pads = [(0, 0), (0, 0)] + list(pad)
         if is_avg:
-            # init must be a concrete scalar so jax recognizes the monoid
-            # (reduce_window grads need the known add/max pattern)
-            zero = np.zeros((), np.dtype(v.dtype)).item() \
-                if v.dtype != jnp.bfloat16 else jnp.bfloat16(0)
+            # init must be a CONCRETE numpy scalar (never a jax array —
+            # a traced init breaks reduce_window's monoid recognition and
+            # reverse-mode linearization); np handles bf16 via ml_dtypes
+            zero = np.zeros((), np.dtype(v.dtype))
             summed = lax.reduce_window(v, zero, lax.add, dims, strides, pads)
             if divisor_override:
                 return summed / divisor_override
@@ -48,7 +48,7 @@ def _pool(x, kernel, stride, padding, nd, channel_last, reducer, init,
             counts = lax.reduce_window(jnp.ones_like(v), zero, lax.add, dims,
                                        strides, pads)
             return summed / counts
-        neg_inf = -np.inf if v.dtype != jnp.bfloat16 else jnp.bfloat16(-np.inf)
+        neg_inf = np.asarray(-np.inf, np.dtype(v.dtype))[()]
         return lax.reduce_window(v, neg_inf, reducer, dims, strides, pads)
     return apply(fn, x)
 
