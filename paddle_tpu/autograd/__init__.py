@@ -1,0 +1,122 @@
+"""paddle_tpu.autograd — user-facing autograd namespace.
+
+Parity: `python/paddle/autograd/` (PyLayer at `py_layer.py`, plus the
+no_grad/grad re-exports). The engine itself lives in `core.autograd`
+(tape over jax.vjp); this package adds PyLayer — user-defined
+forward/backward pairs — implemented as a `jax.custom_vjp` routed
+through `apply()`, so a custom op records on the eager tape AND traces
+into jit exactly like a built-in.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import (  # noqa: F401
+    no_grad, enable_grad, set_grad_enabled, grad, backward,
+)
+from ..core.tensor import Tensor, apply
+
+__all__ = ["PyLayer", "PyLayerContext", "no_grad", "enable_grad",
+           "set_grad_enabled", "grad", "backward"]
+
+
+class PyLayerContext:
+    """`ctx` handed to forward/backward (reference
+    `autograd/py_layer.py` PyLayerContext): save_for_backward carries
+    tensors to the backward; arbitrary python attributes (ctx.alpha = 2)
+    also work — they ride the closure, not the traced residuals."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        # paddle spells it saved_tensor (returns the tuple)
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class PyLayer:
+    """User-defined op with a custom backward.
+
+    Subclass with STATIC methods (reference contract,
+    `py_layer.py` PyLayer):
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 3 * x * x
+
+    Call via `Cube.apply(x)`. backward returns one grad (or None) per
+    TENSOR input of forward, in order. Both methods run on Tensors and
+    may use any paddle_tpu op; because the pair lowers to one
+    `jax.custom_vjp`, the custom backward is used by the eager tape and
+    under `to_static`/`TrainStep` tracing alike.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        is_tensor = [isinstance(a, Tensor) for a in args]
+        tensors = [a for a, t in zip(args, is_tensor) if t]
+        ctx = PyLayerContext()
+
+        def rebuild(vals):
+            it = iter(vals)
+            return [Tensor(next(it)) if t else a
+                    for a, t in zip(args, is_tensor)]
+
+        def run_forward(vals):
+            with no_grad():
+                out = cls.forward(ctx, *rebuild(vals), **kwargs)
+            multi = isinstance(out, (tuple, list))
+            out_vals = tuple(o._value for o in out) if multi \
+                else out._value
+            return out_vals, multi
+
+        multi_box = {}
+
+        @jax.custom_vjp
+        def op(*vals):
+            out_vals, multi = run_forward(vals)
+            multi_box["multi"] = multi
+            return out_vals
+
+        def op_fwd(*vals):
+            out_vals, multi = run_forward(vals)
+            multi_box["multi"] = multi
+            return out_vals, (vals, tuple(t._value for t in ctx._saved))
+
+        def op_bwd(res, gs):
+            in_vals, saved_vals = res
+            ctx._saved = tuple(Tensor(v) for v in saved_vals)
+            g_tensors = [Tensor(g) for g in gs] if multi_box["multi"] \
+                else [Tensor(gs)]
+            with no_grad():
+                grads = cls.backward(ctx, *g_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            n_in = len(in_vals)
+            if len(grads) != n_in:
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} "
+                    f"grads for {n_in} tensor inputs")
+            out = tuple(
+                jnp.zeros_like(v) if g is None
+                else jnp.broadcast_to(g._value, v.shape).astype(v.dtype)
+                for g, v in zip(grads, in_vals))
+            return out
+
+        op.defvjp(op_fwd, op_bwd)
+
+        result = apply(lambda *vals: op(*vals), *tensors)
+        if isinstance(result, list):
+            return result[0] if not multi_box["multi"] else tuple(result)
+        return result

@@ -230,6 +230,10 @@ class DistributedEmbedding:
         self.table = table_or_client
         self.dim = table_or_client.dim
         self.name = name
+        # every grad-tracked forward since the last apply_gradients — a
+        # step that looks up several slots (user, ad, ...) must push all
+        # of them, not just the last call's rows
+        self._pending = []
 
     def __call__(self, ids):
         import jax.numpy as jnp
@@ -239,26 +243,24 @@ class DistributedEmbedding:
                             else ids).astype(np.int64)
         uniq, inverse = np.unique(ids_np.ravel(), return_inverse=True)
         rows = self.table.pull(uniq)                      # [U, dim] host
-        rows_t = Tensor(jnp.asarray(rows), stop_gradient=False)
+        track = autograd.grad_enabled()
+        rows_t = Tensor(jnp.asarray(rows), stop_gradient=not track)
         inv = jnp.asarray(inverse.reshape(ids_np.shape))
 
         from ..core.tensor import apply
         out = apply(lambda r: jnp.take(r, inv, axis=0), rows_t)
 
-        table = self.table
-
-        def push_hook(grad_rows):
-            table.push(uniq, np.asarray(grad_rows))
-
-        rows_t._ps_push = push_hook  # picked up by ps_step
-        self._last_rows = rows_t
-        self._last_uniq = uniq
+        if track:
+            # inference/eval forwards (paddle.no_grad) never enqueue, so
+            # a pull-only loop cannot grow _pending unboundedly
+            self._pending.append((rows_t, uniq))
         return out
 
     def apply_gradients(self):
-        """Push accumulated grads of the last forward (call after
-        backward())."""
-        rows_t = getattr(self, "_last_rows", None)
-        if rows_t is not None and rows_t.grad is not None:
-            self.table.push(self._last_uniq, rows_t.grad.numpy())
-            rows_t.grad = None
+        """Push the grads of every forward since the last call (invoke
+        after backward())."""
+        for rows_t, uniq in self._pending:
+            if rows_t.grad is not None:
+                self.table.push(uniq, rows_t.grad.numpy())
+                rows_t.grad = None
+        self._pending = []

@@ -6,6 +6,9 @@ from .dataloader import (  # noqa: F401
     DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
 )
 from .serialization import save, load  # noqa: F401
+from .dataset import (  # noqa: F401
+    DatasetBase, InMemoryDataset, QueueDataset, SlotDesc, dataset_factory,
+)
 
 # native (C++) record-file data path — threaded prefetch into staging
 # buffers (csrc/ptio.cc); importing is lazy so g++ is only needed on use

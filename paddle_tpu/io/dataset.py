@@ -1,0 +1,247 @@
+"""Slot-based dataset ingestion for the parameter-server path.
+
+Parity target: `python/paddle/fluid/dataset.py` (DatasetBase:65,
+InMemoryDataset:364, QueueDataset:1004) and the C++ MultiSlotDataFeed
+behind them (`paddle/fluid/framework/data_feed.cc`). The reference feeds
+a C++ trainer via protobuf descriptors; here the contract is TPU-first:
+batches come out as dense numpy arrays (sparse slots padded to
+[batch, max_len] int64 with a mask) ready to feed jnp / the
+DistributedEmbedding pull path in one host->device transfer.
+
+Text line format (the classic CTR layout):
+    <label> <slot>:<feasign> <slot>:<feasign> ...
+Sparse slots collect variable-length id lists per example; dense slots
+parse the value as float. `set_pipe_command` pipes each file through a
+shell command first (reference DatasetBase.set_pipe_command:80).
+"""
+import os
+import subprocess
+import threading
+import queue as _queue
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "SlotDesc", "dataset_factory"]
+
+
+class SlotDesc:
+    """One input slot: sparse (id list) or dense (single float)."""
+
+    def __init__(self, name, is_sparse=True, max_len=16, dtype=None):
+        self.name = name
+        self.is_sparse = is_sparse
+        self.max_len = max_len
+        self.dtype = dtype or (np.int64 if is_sparse else np.float32)
+
+    def __repr__(self):
+        kind = "sparse" if self.is_sparse else "dense"
+        return f"SlotDesc({self.name}, {kind})"
+
+
+class DatasetBase:
+    """Reference `dataset.py:65` DatasetBase API surface."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist = []
+        self.slots = []
+        self.pipe_command = None
+        self.drop_last = False
+
+    # ---- reference setters ----
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Accepts SlotDesc objects or names (names default to sparse)."""
+        self.slots = [v if isinstance(v, SlotDesc) else SlotDesc(str(v))
+                      for v in var_list]
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        raise NotImplementedError(
+            "paddle_tpu datasets read local/NFS/GCS-mounted files; "
+            "HDFS ingestion is out of scope (stage files locally)")
+
+    # ---- parsing ----
+    def _read_lines(self, path):
+        if self.pipe_command:
+            proc = subprocess.run(
+                f"{self.pipe_command} < {path!r}", shell=True,
+                capture_output=True, text=True, check=True)
+            return proc.stdout.splitlines()
+        with open(path) as f:
+            return f.read().splitlines()
+
+    def _parse_line(self, line):
+        toks = line.split()
+        if not toks:
+            return None
+        rec = {"label": np.float32(toks[0])}
+        sparse = {s.name: [] for s in self.slots if s.is_sparse}
+        for t in toks[1:]:
+            slot, _, val = t.partition(":")
+            if not val:
+                continue
+            if slot in sparse:
+                sparse[slot].append(int(val))
+            else:
+                rec[slot] = np.float32(val)
+        rec.update(sparse)
+        return rec
+
+    def _batchify(self, records):
+        """records -> dict of arrays: label [B], sparse [B, max_len] int64
+        (padded 0) + <slot>_mask [B, max_len] f32, dense [B] f32."""
+        B = len(records)
+        out = {"label": np.asarray([r["label"] for r in records],
+                                   np.float32)}
+        for s in self.slots:
+            if s.is_sparse:
+                ids = np.zeros((B, s.max_len), np.int64)
+                mask = np.zeros((B, s.max_len), np.float32)
+                for i, r in enumerate(records):
+                    v = r.get(s.name, [])[:s.max_len]
+                    ids[i, :len(v)] = v
+                    mask[i, :len(v)] = 1.0
+                out[s.name] = ids
+                out[s.name + "_mask"] = mask
+            else:
+                out[s.name] = np.asarray(
+                    [r.get(s.name, 0.0) for r in records], np.float32)
+        return out
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference `dataset.py:364`: load everything, shuffle in memory,
+    iterate epochs. global_shuffle redistributes records across trainers
+    by hash (here: deterministic hash-mod over the fleet world size)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = []
+        self._rng = np.random.RandomState(0)
+
+    def load_into_memory(self, is_shuffle=False):
+        self._records = []
+        for path in self.filelist:
+            for line in self._read_lines(path):
+                rec = self._parse_line(line)
+                if rec is not None:
+                    self._records.append(rec)
+        if is_shuffle:
+            self.local_shuffle()
+
+    def set_shuffle_seed(self, seed):
+        self._rng = np.random.RandomState(int(seed))
+
+    def local_shuffle(self):
+        self._rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Keep only this trainer's shard (hash-mod), then shuffle —
+        the stateless equivalent of the reference's cross-trainer
+        record exchange (`dataset.py:816`)."""
+        if fleet is not None:
+            rank = fleet.worker_index()
+            world = fleet.worker_num()
+        else:
+            rank, world = 0, 1
+        if world > 1:
+            self._records = [r for i, r in enumerate(self._records)
+                             if i % world == rank]
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._records = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def __len__(self):
+        return len(self._records)
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self.batch_size):
+            chunk = self._records[i:i + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            yield self._batchify(chunk)
+
+
+class QueueDataset(DatasetBase):
+    """Reference `dataset.py:1004`: streaming — reader threads parse
+    files into a bounded queue, the consumer drains batches; nothing is
+    retained (single-pass, constant memory)."""
+
+    QUEUE_DEPTH = 64
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset is single-pass streaming; use InMemoryDataset "
+            "for shuffling (reference raises the same way, "
+            "dataset.py:1041)")
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset cannot global-shuffle (reference "
+            "dataset.py:1063); shard the filelist across trainers")
+
+    def __iter__(self):
+        q = _queue.Queue(maxsize=self.QUEUE_DEPTH)
+        SENTINEL = object()
+        files = list(self.filelist)
+        lock = threading.Lock()
+
+        def reader():
+            while True:
+                with lock:
+                    if not files:
+                        break
+                    path = files.pop(0)
+                for line in self._read_lines(path):
+                    rec = self._parse_line(line)
+                    if rec is not None:
+                        q.put(rec)
+            q.put(SENTINEL)
+
+        n = min(self.thread_num, max(1, len(self.filelist)))
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(n)]
+        for t in threads:
+            t.start()
+        done = 0
+        buf = []
+        while done < n:
+            item = q.get()
+            if item is SENTINEL:
+                done += 1
+                continue
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield self._batchify(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._batchify(buf)
+        for t in threads:
+            t.join()
+
+
+def dataset_factory(name):
+    """Reference DatasetFactory.create_dataset analog."""
+    table = {"InMemoryDataset": InMemoryDataset,
+             "QueueDataset": QueueDataset}
+    if name not in table:
+        raise ValueError(f"unknown dataset type {name!r}; "
+                         f"one of {sorted(table)}")
+    return table[name]()

@@ -1,0 +1,2 @@
+"""paddle_tpu.utils — mirrors `python/paddle/utils/`."""
+from . import cpp_extension  # noqa: F401

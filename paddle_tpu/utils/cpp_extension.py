@@ -1,0 +1,155 @@
+"""JIT C++ extension loading — the custom-op build path.
+
+Parity target: `python/paddle/utils/cpp_extension/cpp_extension.py:1`
+(CppExtension/CUDAExtension + the JIT `load()` API over a hidden
+setuptools build). TPU-native redesign: device compute belongs in
+Pallas/jax (write a function and register it with `autograd.PyLayer` —
+no C++ needed for kernels), so the C++ extension path targets what
+genuinely needs native code on a TPU host: data loaders, tokenizers,
+feature extraction, host-side services. `load()` compiles sources with
+g++ into a shared library and binds `extern "C"` functions via ctypes —
+the same on-demand toolchain the in-tree runtimes use (`csrc/pskv.cc`,
+`csrc/ptio.cc`, `csrc/kvstore.cc`); there is no pybind11 in the image.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+__all__ = ["load", "CppExtension", "get_build_directory"]
+
+_cache = {}
+_cache_lock = threading.Lock()
+
+_CTYPE = {
+    "void": None,
+    "int": ctypes.c_int,
+    "int32": ctypes.c_int32,
+    "int64": ctypes.c_int64,
+    "float": ctypes.c_float,
+    "double": ctypes.c_double,
+    "char*": ctypes.c_char_p,
+    "str": ctypes.c_char_p,
+    "void*": ctypes.c_void_p,
+    "float*": ctypes.POINTER(ctypes.c_float),
+    "double*": ctypes.POINTER(ctypes.c_double),
+    "int32*": ctypes.POINTER(ctypes.c_int32),
+    "int64*": ctypes.POINTER(ctypes.c_int64),
+}
+
+
+def get_build_directory():
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Source-set descriptor (reference CppExtension signature)."""
+
+    def __init__(self, sources, extra_compile_args=None,
+                 extra_link_args=None, include_dirs=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+class _Extension:
+    """Loaded library: declared functions become attributes."""
+
+    def __init__(self, name, lib, so_path):
+        self._name = name
+        self._lib = lib
+        self.so_path = so_path
+
+    def __getattr__(self, item):
+        return getattr(self._lib, item)
+
+    def __repr__(self):
+        return f"<paddle_tpu extension {self._name} at {self.so_path}>"
+
+
+def _parse_sig(sig):
+    """'double sum_sq(float*, int64)' -> (name, restype, argtypes)."""
+    ret, _, rest = sig.strip().partition(" ")
+    name, _, args = rest.partition("(")
+    args = args.rstrip(") ").strip()
+    argtypes = []
+    if args and args != "void":
+        for a in args.split(","):
+            a = a.strip()
+            if a not in _CTYPE:
+                raise ValueError(
+                    f"unsupported ctypes arg {a!r} in signature {sig!r}; "
+                    f"one of {sorted(_CTYPE)}")
+            argtypes.append(_CTYPE[a])
+    if ret not in _CTYPE:
+        raise ValueError(f"unsupported return type {ret!r} in {sig!r}")
+    return name.strip(), _CTYPE[ret], argtypes
+
+
+def load(name, sources=None, extension=None, functions=None,
+         extra_cflags=None, extra_ldflags=None, include_dirs=None,
+         build_directory=None, verbose=False):
+    """Compile C++ `sources` and return the bound library.
+
+    functions: list of C signatures to declare, e.g.
+        ["double dotf(float*, float*, int64)", "void scale(float*, int64,
+        float)"]
+    Exported symbols must be `extern "C"`. Recompiles only when any
+    source is newer than the cached .so (hash of name+sources).
+    """
+    if extension is not None:
+        sources = extension.sources
+        extra_cflags = (extra_cflags or []) + extension.extra_compile_args
+        extra_ldflags = (extra_ldflags or []) + extension.extra_link_args
+        include_dirs = (include_dirs or []) + extension.include_dirs
+    if not sources:
+        raise ValueError("load() needs sources (or extension=)")
+    sources = [os.path.abspath(s) for s in sources]
+    for s in sources:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+
+    key = hashlib.sha1(
+        (name + "\0" + "\0".join(sources)).encode()).hexdigest()[:12]
+    out_dir = build_directory or get_build_directory()
+    so = os.path.join(out_dir, f"{name}-{key}.so")
+
+    with _cache_lock:
+        cached = _cache.get(so)
+        if cached is None:
+            stale = (not os.path.exists(so) or any(
+                os.path.getmtime(s) > os.path.getmtime(so)
+                for s in sources))
+            if stale:
+                cmd = (["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-pthread"]
+                       + [f"-I{d}" for d in (include_dirs or [])]
+                       + (extra_cflags or []) + sources
+                       + ["-o", so + ".tmp"] + (extra_ldflags or []))
+                if verbose:
+                    print("[paddle_tpu.cpp_extension]", " ".join(cmd))
+                try:
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   text=True)
+                except subprocess.CalledProcessError as e:
+                    raise RuntimeError(
+                        f"extension {name!r} failed to compile:\n"
+                        f"{e.stderr}") from None
+                os.replace(so + ".tmp", so)
+            cached = _Extension(name, ctypes.CDLL(so), so)
+            _cache[so] = cached
+
+    if functions:
+        for sig in functions:
+            fname, restype, argtypes = _parse_sig(sig)
+            fn = getattr(cached._lib, fname)
+            fn.restype = restype
+            fn.argtypes = argtypes
+    return cached

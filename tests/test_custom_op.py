@@ -1,0 +1,167 @@
+"""Custom op extension: PyLayer (custom vjp) + C++ load().
+
+Reference analogs: `python/paddle/autograd/py_layer.py` and
+`python/paddle/utils/cpp_extension/cpp_extension.py:1`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+from op_test import check_grad
+
+
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+
+    @staticmethod
+    def backward(ctx, dy):
+        (x,) = ctx.saved_tensor
+        return dy * 3.0 * x * x
+
+
+class ScaledTanh(PyLayer):
+    """Custom backward that is DELIBERATELY not the true derivative —
+    proves the custom path is used, not jax autodiff of forward."""
+
+    @staticmethod
+    def forward(ctx, x):
+        return paddle.tanh(x)
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy * 0.0 + 7.0
+
+
+class TwoInTwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, a, b):
+        ctx.save_for_backward(a, b)
+        return a * b, a + b
+
+    @staticmethod
+    def backward(ctx, da_mul, da_add):
+        a, b = ctx.saved_tensor
+        return da_mul * b + da_add, da_mul * a + da_add
+
+
+def test_pylayer_forward_backward():
+    x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+    x.stop_gradient = False
+    y = Cube.apply(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 8.0, -27.0], rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0, 27.0],
+                               rtol=1e-6)
+
+
+def test_pylayer_custom_bwd_actually_used():
+    x = paddle.to_tensor(np.array([0.3, -0.5], np.float32))
+    x.stop_gradient = False
+    ScaledTanh.apply(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0, 7.0], rtol=1e-6)
+
+
+def test_pylayer_grad_matches_numeric():
+    check_grad(Cube.apply, [np.array([[0.5, -1.2], [2.0, 0.8]],
+                                     np.float32)])
+
+
+def test_pylayer_multi_io():
+    a = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    b = paddle.to_tensor(np.array([5.0, -1.0], np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    m, s = TwoInTwoOut.apply(a, b)
+    (m.sum() + s.sum()).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [6.0, 0.0], rtol=1e-6)
+    np.testing.assert_allclose(b.grad.numpy(), [3.0, 4.0], rtol=1e-6)
+
+
+def test_pylayer_under_jit():
+    """The custom vjp must survive to_static tracing (one fused program)."""
+    x = paddle.to_tensor(np.array([0.1, 0.2], np.float32))
+    x.stop_gradient = False
+
+    @paddle.jit.to_static
+    def f(v):
+        return ScaledTanh.apply(v) * 2.0
+
+    out = f(x)
+    np.testing.assert_allclose(out.numpy(), np.tanh([0.1, 0.2]) * 2,
+                               rtol=1e-5)
+
+
+def test_pylayer_ctx_attributes():
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, factor):
+            ctx.factor = factor          # non-tensor arg via ctx attr
+            return x * factor
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * ctx.factor
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    Scale.apply(x, 4.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 4.0])
+
+
+CPP_SRC = r"""
+#include <cstdint>
+extern "C" {
+double dotf(const float* a, const float* b, int64_t n) {
+  double acc = 0;
+  for (int64_t i = 0; i < n; ++i) acc += double(a[i]) * b[i];
+  return acc;
+}
+void axpy(float* y, const float* x, float alpha, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] += alpha * x[i];
+}
+int64_t add64(int64_t a, int64_t b) { return a + b; }
+}
+"""
+
+
+def test_cpp_extension_load(tmp_path):
+    import ctypes
+    from paddle_tpu.utils.cpp_extension import load
+
+    src = tmp_path / "mini.cc"
+    src.write_text(CPP_SRC)
+    ext = load("mini", sources=[str(src)],
+               build_directory=str(tmp_path),
+               functions=["double dotf(float*, float*, int64)",
+                          "int64 add64(int64, int64)"])
+    a = np.arange(5, dtype=np.float32)
+    b = np.ones(5, dtype=np.float32)
+    pf = ctypes.POINTER(ctypes.c_float)
+    got = ext.dotf(a.ctypes.data_as(pf), b.ctypes.data_as(pf), 5)
+    assert got == 10.0
+    assert ext.add64(2**40, 5) == 2**40 + 5
+    # cache hit returns the same bound object
+    again = load("mini", sources=[str(src)],
+                 build_directory=str(tmp_path))
+    assert again.so_path == ext.so_path
+
+
+def test_cpp_extension_compile_error(tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="failed to compile"):
+        load("bad", sources=[str(bad)], build_directory=str(tmp_path))
+
+
+def test_cpp_extension_bad_signature(tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / "m2.cc"
+    src.write_text(CPP_SRC)
+    with pytest.raises(ValueError, match="unsupported"):
+        load("m2", sources=[str(src)], build_directory=str(tmp_path),
+             functions=["double dotf(std::vector<float>)"])

@@ -1,0 +1,190 @@
+"""Slot datasets + the CTR end-to-end loop over the PS.
+
+Reference analogs: `python/paddle/fluid/dataset.py` (InMemoryDataset:364,
+QueueDataset:1004) and the fleet CTR workflow (dataset -> distributed
+lookup_table -> dense net -> push_sparse). The end-to-end test is the
+VERDICT item: "nothing wires a CTR-style training loop end to end".
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import (InMemoryDataset, QueueDataset, SlotDesc,
+                           dataset_factory)
+
+
+def _write_ctr_file(path, n, seed, vocab=1000):
+    rs = np.random.RandomState(seed)
+    lines = []
+    for _ in range(n):
+        # ground truth: click iff user-slot id is even
+        uid = rs.randint(0, vocab)
+        ad = rs.randint(0, vocab)
+        label = 1 if uid % 2 == 0 else 0
+        extra = " ".join(f"ad:{rs.randint(0, vocab)}"
+                         for _ in range(rs.randint(0, 3)))
+        dense = rs.uniform(0, 1)
+        lines.append(f"{label} user:{uid} ad:{ad} {extra} price:{dense:.4f}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _slots():
+    return [SlotDesc("user", max_len=1), SlotDesc("ad", max_len=4),
+            SlotDesc("price", is_sparse=False)]
+
+
+def test_inmemory_dataset_basics(tmp_path):
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_ctr_file(p1, 23, 0)
+    _write_ctr_file(p2, 17, 1)
+    ds = dataset_factory("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([p1, p2])
+    ds.set_use_var(_slots())
+    ds.load_into_memory()
+    assert len(ds) == 40
+    batches = list(ds)
+    assert len(batches) == 5
+    b0 = batches[0]
+    assert b0["user"].shape == (8, 1) and b0["user"].dtype == np.int64
+    assert b0["ad"].shape == (8, 4)
+    assert b0["ad_mask"].shape == (8, 4)
+    assert b0["price"].shape == (8,) and b0["price"].dtype == np.float32
+    assert set(np.unique(b0["label"])) <= {0.0, 1.0}
+    # mask marks real ids only
+    assert (b0["ad"][b0["ad_mask"] == 0] == 0).all()
+    # drop_last
+    ds.set_batch_size(9)
+    ds.drop_last = True
+    assert sum(1 for _ in ds) == 4
+
+
+def test_inmemory_shuffle_and_global_shard(tmp_path):
+    p = str(tmp_path / "a.txt")
+    _write_ctr_file(p, 40, 2)
+    ds = InMemoryDataset()
+    ds.set_batch_size(40)
+    ds.set_filelist([p])
+    ds.set_use_var(_slots())
+    ds.load_into_memory()
+    before = next(iter(ds))["user"].ravel().copy()
+    ds.set_shuffle_seed(7)
+    ds.local_shuffle()
+    after = next(iter(ds))["user"].ravel()
+    assert sorted(before.tolist()) == sorted(after.tolist())
+    assert (before != after).any()
+
+    class FakeFleet:
+        def worker_index(self):
+            return 1
+
+        def worker_num(self):
+            return 2
+
+    ds.global_shuffle(FakeFleet())
+    assert ds.get_memory_data_size() == 20
+    ds.release_memory()
+    assert len(ds) == 0
+
+
+def test_queue_dataset_streams(tmp_path):
+    paths = []
+    total = 0
+    for i in range(3):
+        p = str(tmp_path / f"f{i}.txt")
+        _write_ctr_file(p, 10 + i, 10 + i)
+        total += 10 + i
+        paths.append(p)
+    ds = dataset_factory("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_filelist(paths)
+    ds.set_use_var(_slots())
+    seen = 0
+    for b in ds:
+        seen += b["label"].shape[0]
+    assert seen == total
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+
+
+def test_pipe_command(tmp_path):
+    p = str(tmp_path / "raw.txt")
+    # raw file is comma-separated; pipe command converts to the slot format
+    with open(p, "w") as f:
+        f.write("1,5\n0,6\n")
+    ds = InMemoryDataset()
+    ds.set_batch_size(2)
+    ds.set_filelist([p])
+    ds.set_use_var([SlotDesc("user", max_len=1)])
+    ds.set_pipe_command("sed 's/,/ user:/'")
+    ds.load_into_memory()
+    b = next(iter(ds))
+    assert b["user"].ravel().tolist() == [5, 6]
+    assert b["label"].tolist() == [1.0, 0.0]
+
+
+def test_ctr_end_to_end_over_ps(tmp_path):
+    """The full CTR loop: dataset -> DistributedEmbedding (pskv sparse
+    table) -> dense logistic head -> backward -> push_sparse + SGD on the
+    dense params. The task is learnable (label = user id parity), so the
+    loss must drop substantially."""
+    from paddle_tpu.distributed.ps import SparseTable, DistributedEmbedding
+
+    p = str(tmp_path / "train.txt")
+    _write_ctr_file(p, 256, 3, vocab=50)
+
+    dim = 8
+    table = SparseTable(dim=dim, optimizer="sgd", lr=2.0, init_range=0.05,
+                        seed=5)
+    emb = DistributedEmbedding(table)
+
+    ds = InMemoryDataset()
+    ds.set_batch_size(32)
+    ds.set_filelist([p])
+    ds.set_use_var(_slots())
+    ds.load_into_memory(is_shuffle=True)
+
+    paddle.seed(0)
+    # non-zero head init: with w = 0 AND near-zero embeddings the
+    # bilinear form has no gradient signal (both factors ~0)
+    w = paddle.to_tensor(np.random.RandomState(11)
+                         .randn(2 * dim + 1, 1).astype(np.float32) * 0.3)
+    w.stop_gradient = False
+    b = paddle.to_tensor(np.zeros((1,), np.float32))
+    b.stop_gradient = False
+
+    def run_epoch():
+        losses = []
+        for batch in ds:
+            user = emb(paddle.to_tensor(batch["user"]))     # [B, 1, d]
+            ad = emb(paddle.to_tensor(batch["ad"]))         # [B, 4, d]
+            mask = paddle.to_tensor(batch["ad_mask"])
+            ad_sum = (ad * mask.unsqueeze(-1)).sum(axis=1)  # [B, d]
+            feat = paddle.concat(
+                [user.squeeze(1), ad_sum,
+                 paddle.to_tensor(batch["price"]).unsqueeze(-1)], axis=1)
+            logit = paddle.matmul(feat, w) + b
+            y = paddle.to_tensor(batch["label"]).unsqueeze(-1)
+            loss = F.binary_cross_entropy_with_logits(logit, y)
+            loss.backward()
+            emb.apply_gradients()                  # push_sparse
+            with paddle.no_grad():
+                for t in (w, b):
+                    t._value = t._value - 0.5 * t.grad._value
+                    t.grad = None
+            losses.append(float(loss.numpy()))
+        return float(np.mean(losses))
+
+    first = run_epoch()
+    last = None
+    for _ in range(9):
+        ds.local_shuffle()
+        last = run_epoch()
+    assert last < first * 0.7, (first, last)
+    # the table actually learned rows for the touched ids
+    assert len(table) > 0
