@@ -20,9 +20,22 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply
 from ..core.dtype import convert_dtype, float32, bfloat16, float16
 
-# ops (by name of the jnp-level function wrapped) that benefit from low
-# precision — the "white list" (reference `fp16_lists.py`)
-_WHITE = {"matmul", "conv"}
+# default op lists (reference `fp16_lists.py` AutoMixedPrecisionLists):
+# WHITE ops compute in the low precision (MXU-bound — the FLOPs live
+# here); BLACK ops keep their NUMERICS-CRITICAL internal math in f32
+# (softmax/norm statistics and reduction accumulators — consulted via
+# amp_op_dtype by the op implementations). TPU-native deviation from the
+# reference: black does NOT materialize f32 activation copies (conv nets
+# are HBM-bound; reductions accumulate in f32 off low-precision inputs
+# instead — same numerics safety, half the traffic). Everything else
+# runs in its input dtype.
+_DEFAULT_WHITE = frozenset({
+    "matmul", "conv", "linear", "mul", "einsum", "attention", "bmm",
+})
+_DEFAULT_BLACK = frozenset({
+    "softmax_with_cross_entropy", "cross_entropy", "layer_norm", "exp",
+    "log", "mean", "sum", "cos_sim", "norm", "reduce_sum",
+})
 
 
 class _AmpState(threading.local):
@@ -30,6 +43,8 @@ class _AmpState(threading.local):
         self.enabled = False
         self.dtype = bfloat16
         self.level = "O1"
+        self.white = _DEFAULT_WHITE
+        self.black = _DEFAULT_BLACK
 
 
 _state = _AmpState()
@@ -42,24 +57,58 @@ def amp_state():
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16"):
-    prev = (_state.enabled, _state.dtype, _state.level)
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white,
+            _state.black)
     _state.enabled = enable
     _state.dtype = convert_dtype(dtype)
     _state.level = level
+    # reference semantics (`fp16_lists.py`): custom white entries are
+    # REMOVED from black and vice versa
+    white = set(_DEFAULT_WHITE) | set(custom_white_list or ())
+    black = set(_DEFAULT_BLACK) | set(custom_black_list or ())
+    white -= set(custom_black_list or ())
+    black -= set(custom_white_list or ())
+    _state.white = frozenset(white)
+    _state.black = frozenset(black)
     try:
         yield
     finally:
-        _state.enabled, _state.dtype, _state.level = prev
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = prev
 
 
 amp_guard = auto_cast
 
 
-def maybe_cast_to_compute(x_value):
-    """Called by F.linear / matmul / conv paths when amp is enabled."""
+def white_black_list():
+    """Active (white, black) op-name sets."""
+    return _state.white, _state.black
+
+
+def amp_op_dtype(op, input_dtype):
+    """Accumulation/statistics dtype for `op`'s internal math: f32 when
+    the op is black (the default for softmax/norm/reduction numerics),
+    the amp compute dtype when the user white-lists it, the input dtype
+    otherwise. Callers: layer_norm stats, cross-entropy log-sum-exp."""
+    if not _state.enabled:
+        return input_dtype
+    if op in _state.black:
+        return jnp.float32
+    if op in _state.white:
+        return _state.dtype
+    return input_dtype
+
+
+def maybe_cast_to_compute(x_value, op="matmul"):
+    """Called by compute-bound functionals (linear/matmul/conv/einsum)
+    when amp is enabled: white ops cast down to the amp dtype, black ops
+    cast up to f32, everything else keeps its input dtype."""
     if not _state.enabled:
         return x_value
-    if x_value.dtype in (jnp.float32,):
+    if op in _state.black:
+        return x_value.astype(jnp.float32) \
+            if x_value.dtype != jnp.float32 else x_value
+    if op in _state.white and x_value.dtype in (jnp.float32,):
         return x_value.astype(_state.dtype)
     return x_value
 

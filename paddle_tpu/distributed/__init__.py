@@ -36,6 +36,7 @@ from .pipeline import (  # noqa: F401
 from .planner import gpt_memory_plan, MemoryPlan, HBM_BYTES  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from . import kvstore  # noqa: F401
+from .localsgd import LocalSGDStep, local_sgd_average  # noqa: F401
 from .kvstore import KVServer, KVClient  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

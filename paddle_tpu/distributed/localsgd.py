@@ -1,0 +1,98 @@
+"""LocalSGD — periodic parameter averaging instead of per-step grad sync.
+
+Parity target: the reference's LocalSGD meta-optimizer
+(`fleet/meta_optimizers/localsgd_optimizer.py`: program rewrite that
+skips the per-step c_allreduce and inserts a param average every
+k_steps). TPU-native redesign: under GSPMD there is no per-step
+all-reduce op to delete — the gradient psum is implicit in the compiled
+program. True LocalSGD therefore needs genuinely DIVERGENT per-replica
+parameters, which is exactly what `shard_map` un-replication provides:
+params carry a leading dp axis (one copy per dp rank), each rank runs
+k local optimizer steps on its own microbatch stream inside one
+compiled program (`lax.scan`), and a `pmean` over dp synchronizes at
+the boundary. One dispatch per k steps, and the ICI only carries the
+parameter average every k-th step — the LocalSGD communication saving,
+realized the XLA way.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import env
+
+__all__ = ["LocalSGDStep", "local_sgd_average"]
+
+
+def local_sgd_average(param_vals, mesh=None, axis="dp"):
+    """One synchronization: pmean each (per-replica stacked) param over
+    the dp axis. param_vals: pytree with leading dp axis."""
+    mesh = mesh or env.current_mesh()
+
+    def avg(stacked):
+        def inner(local):
+            m = jax.lax.pmean(local, axis)
+            return m
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(stacked)
+
+    return jax.tree_util.tree_map(avg, param_vals)
+
+
+class LocalSGDStep:
+    """Compiled k-local-steps-then-average trainer.
+
+    loss_fn(params, batch) -> scalar; grad_fn is jax.grad(loss_fn).
+    params: pytree of per-replica stacked arrays [dp, ...] (replicate an
+    initial point with `stack_for_replicas`). Each __call__ consumes a
+    batch pytree with leading [dp, k, ...] (k microbatches per replica),
+    runs k SGD steps per replica locally, then averages params over dp.
+    """
+
+    def __init__(self, loss_fn, k_steps, learning_rate=0.1, mesh=None,
+                 sync_every_call=True):
+        self.loss_fn = loss_fn
+        self.k = int(k_steps)
+        self.lr = learning_rate
+        self.mesh = mesh or env.current_mesh()
+        self.sync_every_call = sync_every_call
+        self._jitted = None
+
+    @staticmethod
+    def stack_for_replicas(params, n):
+        """Replicate a single-point pytree into [n, ...] per-replica."""
+        return jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), params)
+
+    def _build(self):
+        loss_fn, k, lr = self.loss_fn, self.k, self.lr
+        sync = self.sync_every_call
+
+        def per_replica(params, batches):
+            # params: local (un-stacked) pytree; batches: [1, k, ...]
+            params = jax.tree_util.tree_map(lambda a: a[0], params)
+            batches = jax.tree_util.tree_map(lambda a: a[0], batches)
+
+            def step(p, mb):
+                loss, g = jax.value_and_grad(loss_fn)(p, mb)
+                p = jax.tree_util.tree_map(
+                    lambda pv, gv: pv - lr * gv, p, g)
+                return p, loss
+
+            params, losses = jax.lax.scan(step, params, batches)
+            if sync:
+                params = jax.tree_util.tree_map(
+                    lambda p: jax.lax.pmean(p, "dp"), params)
+            mean_loss = jax.lax.pmean(jnp.mean(losses), "dp")
+            return (jax.tree_util.tree_map(lambda a: a[None], params),
+                    mean_loss)
+
+        shard = jax.shard_map(
+            per_replica, mesh=self.mesh,
+            in_specs=(P("dp"), P("dp")),
+            out_specs=(P("dp"), P()))
+        return jax.jit(shard)
+
+    def __call__(self, params, batches):
+        if self._jitted is None:
+            self._jitted = self._build()
+        return self._jitted(params, batches)

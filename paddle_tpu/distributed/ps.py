@@ -242,6 +242,8 @@ class DistributedEmbedding:
         ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor)
                             else ids).astype(np.int64)
         uniq, inverse = np.unique(ids_np.ravel(), return_inverse=True)
+        from .. import monitor
+        monitor.incr("ps.pulls")
         rows = self.table.pull(uniq)                      # [U, dim] host
         track = autograd.grad_enabled()
         rows_t = Tensor(jnp.asarray(rows), stop_gradient=not track)
@@ -259,8 +261,10 @@ class DistributedEmbedding:
     def apply_gradients(self):
         """Push the grads of every forward since the last call (invoke
         after backward())."""
+        from .. import monitor
         for rows_t, uniq in self._pending:
             if rows_t.grad is not None:
+                monitor.incr("ps.pushes")
                 self.table.push(uniq, rows_t.grad.numpy())
                 rows_t.grad = None
         self._pending = []

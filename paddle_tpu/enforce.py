@@ -1,0 +1,95 @@
+"""Rich enforcement errors — the PADDLE_ENFORCE analog.
+
+Parity target: `paddle/fluid/platform/enforce.h:423` (PADDLE_ENFORCE_*
+macros producing typed errors with operator context, a what-went-wrong
+summary, and a hint) and the python error taxonomy in
+`python/paddle/fluid/core` (InvalidArgumentError etc.). Here errors are
+ordinary exceptions, but they carry the same three layers the reference
+prints: [operator context] + message + hint — debugging a multi-host
+job from logs needs all three.
+"""
+import inspect
+import os
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PreconditionNotMetError",
+    "UnimplementedError", "enforce", "enforce_eq", "enforce_shape",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base: message + caller site + optional op context + hint."""
+
+    def __init__(self, message, op=None, hint=None, _stacklevel=None):
+        # first frame outside this module = the call site (robust under
+        # pytest's assertion-rewrite wrappers)
+        site = "?"
+        here = os.path.abspath(__file__)
+        for frame in inspect.stack()[1:]:
+            if os.path.abspath(frame.filename) != here:
+                site = f"{os.path.basename(frame.filename)}:{frame.lineno}"
+                break
+        parts = []
+        if op:
+            parts.append(f"[operator < {op} > error]")
+        parts.append(str(message))
+        if hint:
+            parts.append(f"\n  [Hint: {hint}]")
+        parts.append(f"\n  (at {site})")
+        super().__init__(" ".join(parts))
+        self.op = op
+        self.hint = hint
+        self.site = site
+
+
+class InvalidArgumentError(EnforceNotMet):
+    pass
+
+
+class NotFoundError(EnforceNotMet):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, message, op=None, hint=None,
+            error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE: raise `error_cls` with context unless cond."""
+    if not cond:
+        raise error_cls(message, op=op, hint=hint)
+
+
+def enforce_eq(a, b, what, op=None, hint=None):
+    """PADDLE_ENFORCE_EQ with both values in the message."""
+    if a != b:
+        raise InvalidArgumentError(
+            f"{what} mismatch: {a!r} vs {b!r}", op=op, hint=hint,
+            )
+
+
+def enforce_shape(tensor, expected, op=None, name="input"):
+    """Shape check with -1 wildcards: enforce_shape(x, [None, 4])."""
+    shape = tuple(tensor.shape)
+    ok = len(shape) == len(expected) and all(
+        e is None or e == -1 or e == s for s, e in zip(shape, expected))
+    if not ok:
+        raise InvalidArgumentError(
+            f"{name} has shape {list(shape)}, expected "
+            f"{[e if e is not None else -1 for e in expected]}", op=op,
+            hint="check the tensor layout/rank fed to this op",
+            )

@@ -9,6 +9,7 @@ from .serialization import save, load  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetBase, InMemoryDataset, QueueDataset, SlotDesc, dataset_factory,
 )
+from .crypto import encrypt_save, decrypt_load, CryptoError  # noqa: F401
 
 # native (C++) record-file data path — threaded prefetch into staging
 # buffers (csrc/ptio.cc); importing is lazy so g++ is only needed on use

@@ -1,0 +1,88 @@
+"""Encrypted checkpoint save/load.
+
+Parity target: the reference's crypto save path
+(`paddle/fluid/framework/io/crypto/cipher.cc` AESCipher +
+`python/paddle/fluid/core` CipherUtils — AES-GCM over serialized
+programs/params). This environment ships no AES implementation (no
+`cryptography` package), so the cipher is built from hashlib primitives:
+HMAC-SHA256 in counter mode as the keystream (a standard PRF-CTR stream
+cipher) with encrypt-then-MAC HMAC-SHA256 integrity — authenticated
+encryption with the same operational contract (wrong key/tampered file
+=> hard failure), not AES-compatible bytes.
+"""
+import hashlib
+import hmac
+import os
+import pickle
+import struct
+
+from .serialization import _to_saveable, _from_saved
+
+__all__ = ["encrypt_save", "decrypt_load", "CryptoError"]
+
+_MAGIC = b"PTPUENC1"
+
+
+class CryptoError(RuntimeError):
+    pass
+
+
+def _derive(key, salt, label):
+    if isinstance(key, str):
+        key = key.encode()
+    return hashlib.pbkdf2_hmac("sha256", key, salt + label, 100_000)
+
+
+def _keystream_xor(data, key, nonce):
+    import numpy as np
+    n = len(data)
+    block = 32
+    n_blocks = (n + block - 1) // block
+    # generate the keystream in one pass, XOR as numpy uint8 vectors —
+    # a byte-at-a-time python loop is minutes per GB of checkpoint
+    ks = bytearray(n_blocks * block)
+    for i in range(n_blocks):
+        ks[i * block:(i + 1) * block] = hmac.new(
+            key, nonce + struct.pack("<Q", i), hashlib.sha256).digest()
+    a = np.frombuffer(data, np.uint8)
+    b = np.frombuffer(bytes(ks[:n]), np.uint8)
+    return (a ^ b).tobytes()
+
+
+def encrypt_save(obj, path, key, protocol=4):
+    """Serialize `obj` (any paddle save-able pytree) and write it
+    encrypted+authenticated to `path`."""
+    payload = pickle.dumps(_to_saveable(obj), protocol=protocol)
+    salt = os.urandom(16)
+    nonce = os.urandom(16)
+    ekey = _derive(key, salt, b"enc")
+    mkey = _derive(key, salt, b"mac")
+    ct = _keystream_xor(payload, ekey, nonce)
+    body = _MAGIC + salt + nonce + ct
+    tag = hmac.new(mkey, body, hashlib.sha256).digest()
+    with open(path, "wb") as f:
+        f.write(body + tag)
+
+
+def decrypt_load(path, key, return_numpy=False):
+    """Load a file written by encrypt_save. Raises CryptoError on a
+    wrong key, truncation, or any tampering (tag verified before any
+    pickle parsing touches attacker-controllable bytes)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) < len(_MAGIC) + 16 + 16 + 32 or \
+            not blob.startswith(_MAGIC):
+        raise CryptoError(f"{path}: not a paddle_tpu encrypted file")
+    body, tag = blob[:-32], blob[-32:]
+    salt = body[len(_MAGIC):len(_MAGIC) + 16]
+    nonce = body[len(_MAGIC) + 16:len(_MAGIC) + 32]
+    ct = body[len(_MAGIC) + 32:]
+    mkey = _derive(key, salt, b"mac")
+    if not hmac.compare_digest(
+            hmac.new(mkey, body, hashlib.sha256).digest(), tag):
+        raise CryptoError(
+            f"{path}: authentication failed (wrong key or corrupted "
+            "file)")
+    ekey = _derive(key, salt, b"enc")
+    payload = _keystream_xor(ct, ekey, nonce)
+    return _from_saved(pickle.loads(payload), return_numpy)

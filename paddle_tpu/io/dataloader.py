@@ -258,13 +258,33 @@ def default_collate_fn(batch):
     return batch
 
 
+def _mp_worker_loop(dataset, index_q, result_q, worker_init_fn, wid):
+    """Worker PROCESS: fetch raw samples for each index batch; the parent
+    collates (keeps the pickle payload to raw numpy/py objects). Reference
+    analog: `fluid/dataloader/worker.py` _worker_loop."""
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        job = index_q.get()
+        if job is None:
+            break
+        seq, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            result_q.put((seq, samples, None))
+        except Exception as e:  # surface the worker error in the parent
+            result_q.put((seq, None, f"{type(e).__name__}: {e}"))
+
+
 class DataLoader:
     """Iterates a Dataset into device Tensors.
 
-    num_workers>0 uses a background thread pool for prefetch (the reference's
-    BufferedReader double-buffering, `operators/reader/buffered_reader.h:36`);
-    full multiprocess workers are provided by the C++-backed feeder in later
-    rounds.
+    Map-style datasets with num_workers>0 fetch samples in real WORKER
+    PROCESSES (reference `fluid/dataloader/worker.py` semantics — python
+    transforms escape the GIL); batches are delivered in sampler order
+    regardless of worker completion order. Iterable datasets use a
+    background-thread prefetch pipeline (the reference's BufferedReader
+    double-buffering, `operators/reader/buffered_reader.h:36`).
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -277,6 +297,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch = max(2, prefetch_factor)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -295,23 +317,36 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _batches(self):
+        from .. import monitor
         if self._iterable_mode:
             batch = []
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
+                    monitor.incr("io.batches")
                     yield self.collate_fn(batch)
                     batch = []
             if batch and not self.drop_last:
+                monitor.incr("io.batches")
                 yield self.collate_fn(batch)
             return
         for indices in self.batch_sampler:
+            monitor.incr("io.batches")
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if not self._iterable_mode:
+            import multiprocessing as mp
+            if "fork" in mp.get_all_start_methods():
+                # fork-context workers inherit the dataset — no pickling
+                # of the dataset object itself, so arbitrary python
+                # datasets work
+                yield from self._process_iter()
+                return
+            # no fork (Windows): thread prefetch below still works
         # background-thread prefetch pipeline
         q = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
@@ -330,6 +365,77 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+    def _process_iter(self):
+        """Real worker processes; results reordered to sampler order.
+        Index feeding has backpressure (<= num_workers * prefetch jobs in
+        flight) and result waits poll worker liveness so a killed worker
+        raises instead of hanging."""
+        import multiprocessing as mp
+        import queue as _q
+        from .. import monitor
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        workers = [ctx.Process(
+            target=_mp_worker_loop,
+            args=(self.dataset, index_q, result_q, self.worker_init_fn,
+                  wid),
+            daemon=True) for wid in range(self.num_workers)]
+        for w in workers:
+            w.start()
+        deadline = self.timeout or None
+        try:
+            jobs = enumerate(self.batch_sampler)
+            n_sent = 0
+            n_jobs = len(self.batch_sampler)
+            exhausted = False
+
+            def feed(limit):
+                nonlocal n_sent, exhausted
+                while not exhausted and n_sent - next_seq < limit:
+                    try:
+                        seq, indices = next(jobs)
+                    except StopIteration:
+                        exhausted = True
+                        for _ in workers:
+                            index_q.put(None)
+                        return
+                    index_q.put((seq, list(indices)))
+                    n_sent += 1
+
+            pending = {}
+            next_seq = 0
+            limit = max(2, self.num_workers * self.prefetch)
+            feed(limit)
+            while next_seq < n_jobs:
+                if next_seq in pending:
+                    samples = pending.pop(next_seq)
+                    next_seq += 1
+                    feed(limit)
+                    monitor.incr("io.batches")
+                    yield self.collate_fn(samples)
+                    continue
+                try:
+                    seq, samples, err = result_q.get(
+                        timeout=deadline or 5.0)
+                except _q.Empty:
+                    dead = [w for w in workers if not w.is_alive()]
+                    if dead or deadline:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) "
+                            f"{[w.pid for w in dead]} died or timed out "
+                            f"waiting {deadline or 5.0}s for batch "
+                            f"{next_seq}") from None
+                    continue
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                pending[seq] = samples
+        finally:
+            for w in workers:
+                w.terminate()
+            for w in workers:
+                w.join()
 
 
 def get_worker_info():

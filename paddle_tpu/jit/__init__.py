@@ -267,6 +267,8 @@ class TrainStep:
         if self._jitted is None or getattr(self, "_amp_key", None) != amp_key:
             self._jitted = self._make_step(check_nan_inf=check)
             self._amp_key = amp_key
+        from .. import monitor
+        monitor.incr("jit.train_steps")
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
         param_vals = [p._value for p in self.params]

@@ -295,7 +295,7 @@ class GPTForPretraining(Layer):
         def head(hh, ww):
             # honor the AMP policy like F.linear does: the vocab projection
             # is the single largest matmul and must hit the MXU in bf16
-            return jnp.einsum("bsd,vd->bsv", _amp(hh), _amp(ww),
+            return jnp.einsum("bsd,vd->bsv", _amp(hh, "matmul"), _amp(ww, "matmul"),
                               preferred_element_type=jnp.float32)
         logits = apply(head, h, w)
         if caches is not None:

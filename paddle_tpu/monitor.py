@@ -1,0 +1,54 @@
+"""Framework stat counters — the Monitor/StatRegistry analog.
+
+Parity target: `paddle/fluid/platform/monitor.h` (StatRegistry of named
+int64 stats, used by the data feed / PS runtimes to expose ingest and
+comm counters). Thread-safe named counters/gauges with a one-call
+snapshot; core runtimes increment a few standard stats so a stuck job
+can be triaged from `paddle_tpu.monitor.snapshot()` alone:
+
+- ``jit.train_steps``      — TrainStep executions
+- ``io.batches``           — DataLoader batches delivered
+- ``ps.pulls`` / ``ps.pushes`` — DistributedEmbedding traffic
+"""
+import threading
+
+__all__ = ["incr", "set_value", "get", "snapshot", "reset", "StatRegistry"]
+
+
+class StatRegistry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._stats = {}
+
+    def incr(self, name, delta=1):
+        with self._mu:
+            self._stats[name] = self._stats.get(name, 0) + delta
+            return self._stats[name]
+
+    def set_value(self, name, value):
+        with self._mu:
+            self._stats[name] = value
+
+    def get(self, name, default=0):
+        with self._mu:
+            return self._stats.get(name, default)
+
+    def snapshot(self):
+        with self._mu:
+            return dict(self._stats)
+
+    def reset(self, name=None):
+        with self._mu:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+
+_registry = StatRegistry()
+
+incr = _registry.incr
+set_value = _registry.set_value
+get = _registry.get
+snapshot = _registry.snapshot
+reset = _registry.reset

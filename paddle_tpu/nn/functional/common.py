@@ -20,12 +20,19 @@ def linear(x, weight, bias=None, name=None):
     hits the MXU at bf16 rate (the white-list cast the reference's tracer
     inserts, `imperative/amp_auto_cast.cc`)."""
     from ...amp import maybe_cast_to_compute as _amp
+    from ...enforce import enforce
     x, weight = ensure_tensor(x), ensure_tensor(weight)
+    enforce(x.shape[-1] == weight.shape[0],
+            f"x last dim {x.shape[-1]} != weight rows {weight.shape[0]} "
+            f"(x {list(x.shape)}, weight {list(weight.shape)})",
+            op="linear",
+            hint="paddle stores Linear weight as [in_features, "
+                 "out_features]; transpose torch-layout weights")
     if bias is None:
-        return apply(lambda v, w: jnp.matmul(_amp(v), _amp(w)), x, weight)
+        return apply(lambda v, w: jnp.matmul(_amp(v, "linear"), _amp(w, "linear")), x, weight)
     bias = ensure_tensor(bias)
-    return apply(lambda v, w, b: jnp.matmul(_amp(v), _amp(w)) +
-                 _amp(b), x, weight, bias)
+    return apply(lambda v, w, b: jnp.matmul(_amp(v, "linear"), _amp(w, "linear")) +
+                 _amp(b, "linear"), x, weight, bias)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

@@ -64,7 +64,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
     dn = _dim_numbers(nd, channel_last)
 
     def fn(v, w):
-        v, w = _amp(v), _amp(w)
+        v, w = _amp(v, "conv"), _amp(w, "conv")
         return lax.conv_general_dilated(
             v, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,

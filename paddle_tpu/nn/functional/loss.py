@@ -22,6 +22,19 @@ def _reduce(val, reduction):
     return val
 
 
+def _log_softmax_amp(lg, ax, op):
+    """log_softmax whose SUM accumulates in the amp-list dtype for `op`
+    (f32 for black ops — the default — without materializing an f32 copy
+    of the logits; bf16 end-to-end if the user white-lists the op)."""
+    from ...amp import amp_op_dtype, amp_state
+    acc = amp_op_dtype(op, lg.dtype)
+    if not amp_state().enabled or acc == lg.dtype:
+        return jnn.log_softmax(lg, axis=ax)
+    m = jax.lax.stop_gradient(jnp.max(lg, axis=ax, keepdims=True))
+    s = jnp.sum(jnp.exp(lg - m), axis=ax, keepdims=True, dtype=acc)
+    return lg - m - jnp.log(s).astype(lg.dtype)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
@@ -32,8 +45,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
 
     def fn(logits):
         ax = axis % logits.ndim
-        logp = jnn.log_softmax(logits, axis=ax) if use_softmax else \
-            jnp.log(jnp.maximum(logits, 1e-30))
+        logp = _log_softmax_amp(logits, ax, "cross_entropy") \
+            if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label:
             tgt = lv.astype(logp.dtype)
             if label_smoothing > 0:
@@ -85,7 +98,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 
     def fn(lg):
         ax = axis % lg.ndim
-        logp = jnn.log_softmax(lg, axis=ax)
+        logp = _log_softmax_amp(lg, ax, "softmax_with_cross_entropy")
         if soft_label:
             loss = -jnp.sum(lv.astype(logp.dtype) * logp, axis=ax,
                             keepdims=True)

@@ -21,10 +21,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     naxes = tuple(range(-len(normalized_shape), 0))
 
     def fn(v, *wb):
-        mean = jnp.mean(v.astype(jnp.float32), axis=naxes, keepdims=True)
-        var = jnp.var(v.astype(jnp.float32), axis=naxes, keepdims=True)
-        out = (v.astype(jnp.float32) - mean) * jnp.power(var + epsilon, -0.5)
-        out = out.astype(v.dtype)
+        # statistics accumulate in the amp-list dtype for "layer_norm"
+        # (f32 by default — black list; bf16 if the user white-lists it);
+        # elementwise math stays in the input dtype so no f32 activation
+        # copy is materialized (same bandwidth reasoning as batch_norm)
+        from ...amp import amp_op_dtype
+        acc = amp_op_dtype("layer_norm", jnp.float32)
+        mean = jnp.mean(v, axis=naxes, keepdims=True, dtype=acc)
+        d = v - mean.astype(v.dtype)
+        var = jnp.mean(jnp.square(d), axis=naxes, keepdims=True,
+                       dtype=acc)
+        out = d * jax.lax.rsqrt(var + epsilon).astype(v.dtype)
         i = 0
         if weight is not None:
             out = out * wb[i]

@@ -1,9 +1,9 @@
-"""paddle_tpu.onnx — ONNX export facade.
+"""paddle_tpu.onnx — ONNX export.
 
 Reference: `python/paddle/onnx/export.py` (delegates to the external
-paddle2onnx package). This environment ships no onnx package; the native
-deployment artifact is serialized StableHLO (`paddle_tpu.inference`), which
-is the portable format for XLA-backed runtimes. `export` raises with that
-guidance unless an onnx installation is present.
+paddle2onnx package). Here export is native: the traced jaxpr lowers
+per-primitive to ONNX opset 13, emitted with a built-in protobuf wire
+encoder — no onnx package needed. StableHLO (`paddle_tpu.inference`)
+remains the first-class artifact for XLA-backed runtimes.
 """
 from .export import export  # noqa: F401

@@ -1,0 +1,185 @@
+"""Minimal protobuf wire-format encoder for ONNX emission.
+
+The environment ships neither the `onnx` package nor a protoc/python
+gencode pair with compatible versions, so the exporter writes the ONNX
+ModelProto wire format directly. Protobuf encoding is tag-length-value:
+varints, and length-delimited submessages — ~80 lines, no dependencies,
+and a decoder below so tests can verify what was written byte-for-byte.
+
+Field numbers follow the public onnx.proto (github.com/onnx/onnx,
+IR version 8 / opset 13 era — stable for every field used here).
+"""
+import struct
+
+# ---- wire primitives ------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num, value):
+    return _varint(num << 3 | 0) + _varint(int(value))
+
+
+def field_bytes(num, payload):
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def field_float(num, value):
+    return _varint(num << 3 | 5) + struct.pack("<f", float(value))
+
+
+# ---- ONNX message builders (each returns encoded bytes) -------------------
+
+# TensorProto.DataType
+FLOAT, INT32, INT64, BOOL, FLOAT16, DOUBLE, BF16 = 1, 6, 7, 9, 10, 11, 16
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_FLOATS, AT_INTS = 1, 2, 3, 4, 6, 7
+
+
+def tensor(name, dims, data_type, raw):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    out = b""
+    for d in dims:
+        out += field_varint(1, d)
+    out += field_varint(2, data_type)
+    out += field_bytes(8, name)
+    out += field_bytes(9, raw)
+    return out
+
+
+def attribute(name, value):
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20."""
+    out = field_bytes(1, name)
+    if isinstance(value, bool):
+        out += field_varint(3, int(value)) + field_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += field_varint(3, value) + field_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += field_float(2, value) + field_varint(20, AT_FLOAT)
+    elif isinstance(value, (str, bytes)):
+        out += field_bytes(4, value) + field_varint(20, AT_STRING)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for v in value:
+            out += field_float(7, v)
+        out += field_varint(20, AT_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += field_varint(8, int(v))
+        out += field_varint(20, AT_INTS)
+    elif isinstance(value, dict) and value.get("__tensor__"):
+        out += field_bytes(5, value["bytes"]) + field_varint(20, AT_TENSOR)
+    else:
+        raise TypeError(f"attribute {name}: unsupported {type(value)}")
+    return out
+
+
+def node(op_type, inputs, outputs, name="", domain="", **attrs):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5,
+    domain=7."""
+    out = b""
+    for i in inputs:
+        out += field_bytes(1, i)
+    for o in outputs:
+        out += field_bytes(2, o)
+    if name:
+        out += field_bytes(3, name)
+    out += field_bytes(4, op_type)
+    for k, v in attrs.items():
+        out += field_bytes(5, attribute(k, v))
+    if domain:
+        out += field_bytes(7, domain)
+    return out
+
+
+def value_info(name, dims, data_type):
+    """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
+    Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
+    Dimension{dim_value=1}."""
+    shape = b""
+    for d in dims:
+        shape += field_bytes(1, field_varint(1, d))
+    tensor_type = field_varint(1, data_type) + field_bytes(2, shape)
+    type_proto = field_bytes(1, tensor_type)
+    return field_bytes(1, name) + field_bytes(2, type_proto)
+
+
+def graph(nodes, name, inputs, outputs, initializers):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b""
+    for n in nodes:
+        out += field_bytes(1, n)
+    out += field_bytes(2, name)
+    for t in initializers:
+        out += field_bytes(5, t)
+    for vi in inputs:
+        out += field_bytes(11, vi)
+    for vi in outputs:
+        out += field_bytes(12, vi)
+    return out
+
+
+def model(graph_bytes, opset_version=13, producer="paddle_tpu"):
+    """ModelProto: ir_version=1, producer_name=2, graph=7,
+    opset_import=8 (OperatorSetIdProto{domain=1, version=2})."""
+    opset = field_bytes(1, "") + field_varint(2, opset_version)
+    return (field_varint(1, 8)            # IR version 8
+            + field_bytes(2, producer)
+            + field_bytes(7, graph_bytes)
+            + field_bytes(8, opset))
+
+
+# ---- decoder (for tests) --------------------------------------------------
+
+def decode(buf):
+    """Parse a wire-format message into {field_num: [values]}; submessages
+    stay as bytes (decode recursively as needed)."""
+    out = {}
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = bytes(buf[i:i + ln])
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+        out.setdefault(num, []).append(v)
+    return out
+
+
+def _read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7

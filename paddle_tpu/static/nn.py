@@ -59,3 +59,98 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 # control flow (paddle.static.nn.while_loop etc. in the 2.x namespace)
 from .control_flow import (while_loop, cond, case,  # noqa: F401,E402
                            switch_case)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCDHW"):
+    from ..nn import Conv3D
+    from ..nn import functional as F
+    layer = Conv3D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    from ..nn import Conv2DTranspose
+    from ..nn import functional as F
+    layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    if global_pooling:
+        return F.adaptive_avg_pool2d(input, 1) if pool_type == "avg" \
+            else F.adaptive_max_pool2d(input, 1)
+    if pool_type == "avg":
+        return F.avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode, exclusive=exclusive,
+                            data_format=data_format)
+    return F.max_pool2d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, data_format=data_format)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+    from ..nn import functional as F
+    shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+    from ..nn import functional as F
+    layer = GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_layout)
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    from ..nn import functional as F
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..nn import PReLU
+    n = 1 if mode == "all" else x.shape[1]
+    return PReLU(num_parameters=n, weight_attr=param_attr)(x)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    from ..nn import functional as F
+    return F.one_hot(input, depth)

@@ -22,7 +22,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
 
     def fn(a, b):
-        a, b = _amp(a), _amp(b)
+        a, b = _amp(a, "matmul"), _amp(b, "matmul")
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:

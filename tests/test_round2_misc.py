@@ -1,0 +1,299 @@
+"""Round-2 gap closers: crypto save/load, DGC momentum, LocalSGD,
+multiprocess DataLoader workers.
+
+Reference analogs: `framework/io/crypto/cipher.cc`, fluid
+DGCMomentumOptimizer, `fleet/meta_optimizers/localsgd_optimizer.py`,
+`fluid/dataloader/worker.py`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ---- crypto ---------------------------------------------------------------
+
+def test_crypto_roundtrip(tmp_path):
+    from paddle_tpu.io import encrypt_save, decrypt_load
+
+    state = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32)
+                                   .reshape(2, 3)),
+             "step": 7}
+    p = str(tmp_path / "enc.ckpt")
+    encrypt_save(state, p, key="s3cret")
+    out = decrypt_load(p, key="s3cret", return_numpy=True)
+    np.testing.assert_allclose(out["w"], state["w"].numpy())
+    assert out["step"] == 7
+
+
+def test_crypto_wrong_key_and_tamper(tmp_path):
+    from paddle_tpu.io import encrypt_save, decrypt_load, CryptoError
+
+    p = str(tmp_path / "enc.ckpt")
+    encrypt_save({"x": 1}, p, key="right")
+    with pytest.raises(CryptoError, match="authentication failed"):
+        decrypt_load(p, key="wrong")
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(CryptoError):
+        decrypt_load(p, key="right")
+    open(p, "wb").write(b"garbage")
+    with pytest.raises(CryptoError, match="not a paddle_tpu"):
+        decrypt_load(p, key="right")
+
+
+# ---- DGC momentum ---------------------------------------------------------
+
+def test_dgc_sparsifies_with_error_feedback():
+    from paddle_tpu.optimizer import DGCMomentum
+
+    paddle.seed(0)
+    p = paddle.to_tensor(np.zeros(100, np.float32))
+    p.stop_gradient = False
+    opt = DGCMomentum(learning_rate=1.0, momentum=0.0,
+                      parameters=[p], sparsity=0.9)
+    g = np.linspace(0.5, 1.0, 100).astype(np.float32)
+    # one step: only the top-10 |grad| entries may move the param
+    p.grad = paddle.to_tensor(g)
+    opt.step()
+    moved = np.nonzero(p.numpy())[0]
+    assert len(moved) == 10
+    assert set(moved) == set(range(90, 100))     # largest magnitudes
+    # error feedback: suppressed entries accumulate until they out-rank
+    # fresh gradients (coordinate i accumulates s*g_i, so with g ratios
+    # <= 2 rotation reaches nearly all coordinates within ~15 steps)
+    for _ in range(14):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+    assert (np.abs(p.numpy()) > 0).sum() >= 95
+
+
+def test_dgc_rampup_is_dense():
+    from paddle_tpu.optimizer import DGCMomentum
+
+    p = paddle.to_tensor(np.zeros(50, np.float32))
+    p.stop_gradient = False
+    opt = DGCMomentum(learning_rate=1.0, momentum=0.0, parameters=[p],
+                      sparsity=0.9, rampup_begin_step=100)
+    p.grad = paddle.to_tensor(np.ones(50, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), -1.0)   # dense update applied
+
+
+def test_dgc_matches_momentum_when_dense():
+    """sparsity=0 (keep everything) must reduce to plain momentum."""
+    from paddle_tpu.optimizer import DGCMomentum, Momentum
+
+    rs = np.random.RandomState(0)
+    init = rs.randn(20).astype(np.float32)
+    grads = [rs.randn(20).astype(np.float32) for _ in range(5)]
+
+    def run(opt_cls, **kw):
+        p = paddle.to_tensor(init.copy())
+        p.stop_gradient = False
+        opt = opt_cls(learning_rate=0.1, momentum=0.9, parameters=[p],
+                      **kw)
+        for g in grads:
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+        return p.numpy()
+
+    np.testing.assert_allclose(run(DGCMomentum, sparsity=0.0),
+                               run(Momentum), rtol=1e-5, atol=1e-6)
+
+
+# ---- LocalSGD -------------------------------------------------------------
+
+def test_local_sgd_diverge_then_average():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.distributed.localsgd import LocalSGDStep
+
+    n = min(4, jax.device_count())
+    mesh = dist.build_mesh(dp=n, devices=jax.devices()[:n])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    rs = np.random.RandomState(0)
+    w0 = {"w": jnp.asarray(rs.randn(3, 1), jnp.float32)}
+    params = LocalSGDStep.stack_for_replicas(w0, n)
+
+    k = 4
+    true_w = rs.randn(3, 1).astype(np.float32)
+    xs = rs.randn(n, k, 8, 3).astype(np.float32)
+    ys = xs @ true_w
+    step = LocalSGDStep(loss_fn, k_steps=k, learning_rate=0.05, mesh=mesh)
+    p1, loss1 = step(params, (jnp.asarray(xs), jnp.asarray(ys)))
+    # after the sync boundary all replicas hold the SAME params
+    arr = np.asarray(p1["w"])
+    for r in range(1, n):
+        np.testing.assert_allclose(arr[0], arr[r], rtol=1e-5, atol=1e-6)
+    # and training progresses across calls
+    losses = [float(loss1)]
+    p = p1
+    for i in range(6):
+        xs = rs.randn(n, k, 8, 3).astype(np.float32)
+        ys = xs @ true_w
+        p, l2 = step(p, (jnp.asarray(xs), jnp.asarray(ys)))
+        losses.append(float(l2))
+    assert losses[-1] < losses[0] * 0.5
+    dist_env.clear_mesh()
+
+
+def test_local_sgd_average_utility():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.distributed.localsgd import local_sgd_average
+
+    n = min(4, jax.device_count())
+    mesh = dist.build_mesh(dp=n, devices=jax.devices()[:n])
+    stacked = {"w": jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2)}
+    avg = local_sgd_average(stacked, mesh=mesh)
+    expect = np.tile(np.asarray(stacked["w"]).mean(0), (n, 1))
+    np.testing.assert_allclose(np.asarray(avg["w"]), expect, rtol=1e-6)
+    dist_env.clear_mesh()
+
+
+# ---- multiprocess DataLoader ---------------------------------------------
+
+class _SquareDataset(paddle.io.Dataset):
+    def __getitem__(self, i):
+        return np.asarray([i * i], np.float32)
+
+    def __len__(self):
+        return 37
+
+
+def test_dataloader_process_workers():
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(_SquareDataset(), batch_size=5, num_workers=2,
+                    shuffle=False)
+    got = np.concatenate([b.numpy().ravel() for b in dl])
+    np.testing.assert_allclose(got, np.arange(37.0) ** 2)
+
+
+def test_dataloader_process_workers_error_propagates():
+    from paddle_tpu.io import DataLoader
+
+    class Bad(paddle.io.Dataset):
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("poison sample")
+            return np.zeros(1, np.float32)
+
+        def __len__(self):
+            return 10
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="poison"):
+        list(dl)
+
+
+# ---- enforce / monitor / amp lists / static.nn ----------------------------
+
+def test_enforce_errors():
+    from paddle_tpu.enforce import (enforce, enforce_eq, enforce_shape,
+                                    InvalidArgumentError)
+
+    with pytest.raises(InvalidArgumentError) as ei:
+        enforce(False, "bad thing", op="my_op", hint="do this instead")
+    msg = str(ei.value)
+    assert "my_op" in msg and "bad thing" in msg and "Hint" in msg \
+        and "test_round2_misc.py" in msg
+    with pytest.raises(InvalidArgumentError, match="mismatch"):
+        enforce_eq(3, 4, "channel count", op="conv2d")
+    x = paddle.randn([2, 5])
+    enforce_shape(x, [None, 5])
+    with pytest.raises(InvalidArgumentError, match="shape"):
+        enforce_shape(x, [None, 4], op="linear")
+
+
+def test_enforce_wired_into_linear():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.enforce import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="linear"):
+        F.linear(paddle.randn([2, 3]), paddle.randn([4, 5]))
+
+
+def test_monitor_counters():
+    from paddle_tpu import monitor
+    from paddle_tpu.io import DataLoader
+
+    monitor.reset()
+    assert monitor.get("io.batches") == 0
+    dl = DataLoader(_SquareDataset(), batch_size=10)
+    list(dl)
+    assert monitor.get("io.batches") == 4
+    monitor.incr("custom.stat", 5)
+    assert monitor.snapshot()["custom.stat"] == 5
+    monitor.reset("custom.stat")
+    assert monitor.get("custom.stat") == 0
+
+
+def test_monitor_train_steps():
+    from paddle_tpu import monitor, optimizer
+    import paddle_tpu.nn as pnn
+
+    monitor.reset()
+    model = pnn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda x, y: ((model(x) - y) ** 2).mean(), opt)
+    x = paddle.randn([3, 4])
+    y = paddle.randn([3, 2])
+    step(x, y)
+    step(x, y)
+    assert monitor.get("jit.train_steps") == 2
+
+
+def test_amp_white_black_lists():
+    import jax.numpy as jnp
+    from paddle_tpu import amp
+
+    x = paddle.randn([4, 4])
+    w = paddle.randn([4, 4])
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        assert paddle.matmul(x, w).dtype == jnp.bfloat16
+    # black-listing matmul forces f32 even under amp
+    with amp.auto_cast(enable=True, dtype="bfloat16",
+                       custom_black_list=["matmul"]):
+        assert paddle.matmul(x, w).dtype == jnp.float32
+        white, black = amp.white_black_list()
+        assert "matmul" in black and "matmul" not in white
+    # custom white overrides a default black entry
+    with amp.auto_cast(enable=True, custom_white_list=["layer_norm"]):
+        white, black = amp.white_black_list()
+        assert "layer_norm" in white and "layer_norm" not in black
+
+
+def test_static_nn_builders_under_program():
+    import numpy as np
+    from paddle_tpu.static import nn as snn
+
+    x = paddle.randn([2, 3, 8, 8])
+    assert tuple(snn.pool2d(x, 2, "avg", 2).shape) == (2, 3, 4, 4)
+    assert tuple(snn.pool2d(x, 2, "max", 2,
+                            global_pooling=True).shape) == (2, 3, 1, 1)
+    assert tuple(snn.conv2d_transpose(
+        x, 4, filter_size=3).shape) == (2, 4, 10, 10)
+    assert tuple(snn.layer_norm(paddle.randn([2, 6])).shape) == (2, 6)
+    g = snn.group_norm(paddle.randn([2, 4, 4, 4]), 2)
+    assert tuple(g.shape) == (2, 4, 4, 4)
+    oh = snn.one_hot(paddle.to_tensor(np.array([1, 2])), 5)
+    assert tuple(oh.shape) == (2, 5)
+    assert tuple(snn.conv3d(paddle.randn([1, 2, 4, 4, 4]), 3,
+                            3).shape) == (1, 3, 2, 2, 2)
+    # fluid "downgrade_in_infer" semantics: inference scales by (1-p)
+    d = snn.dropout(x, 0.5, is_test=True)
+    np.testing.assert_allclose(d.numpy(), x.numpy() * 0.5, rtol=1e-6)
