@@ -61,6 +61,9 @@ def __getattr__(name):
     if name == "flops":
         from .hapi.flops import flops
         return flops
+    if name == "flops_compiled":
+        from .hapi.flops import flops_compiled
+        return flops_compiled
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

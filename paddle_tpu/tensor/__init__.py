@@ -16,6 +16,8 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from . import sequence  # noqa: F401
 from .random import *  # noqa: F401,F403
 
 from . import creation, math, manipulation, linalg, logic, search, random  # noqa: F401

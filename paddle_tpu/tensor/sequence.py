@@ -1,0 +1,304 @@
+"""Sequence op family — the LoD (jagged tensor) answer.
+
+Parity target: `paddle/fluid/operators/sequence_ops/` (sequence_pad,
+_unpad, _mask, _pool, _softmax, _expand, _concat, _reverse, _slice,
+_erase, _enumerate, _conv — the LoD-tensor op family) and the LoD
+machinery itself (`framework/lod_tensor.cc`).
+
+TPU-native redesign: variable-length data is carried as a PADDED dense
+batch `[B, T, ...]` plus a `lengths [B]` vector — the jagged
+representation XLA can tile (static shapes, mask-aware ops), replacing
+the reference's level-of-detail offsets. The packed "flat" form
+`[sum(L), ...]` the reference stores appears only at the pad/unpad
+boundary. Every op here is mask-vectorized; nothing loops over rows.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_softmax", "sequence_expand_as", "sequence_concat",
+    "sequence_reverse", "sequence_slice", "sequence_erase",
+    "sequence_enumerate", "sequence_conv",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _lengths(lengths):
+    return _val(ensure_tensor(lengths)).astype(jnp.int32)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """[B] -> [B, maxlen]; 1 where t < length (reference
+    `sequence_mask_op.cc`). Delegates to the single implementation in
+    nn.functional — one op, one body."""
+    from ..nn.functional import sequence_mask as _impl
+    return _impl(lengths, maxlen=maxlen, dtype=dtype, name=name)
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0, name=None):
+    """Packed [sum(L), ...] + lengths [B] -> (padded [B, T, ...],
+    lengths). The reference's LoD->padded conversion
+    (`sequence_pad_op.cc`); T = maxlen or max(lengths) (static under
+    jit when maxlen is given)."""
+    xv = _val(ensure_tensor(x))
+    lv = _lengths(lengths)
+    B = lv.shape[0]
+    if maxlen is None:
+        maxlen = int(jnp.max(lv)) if lv.size else 0
+    T = int(maxlen)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(lv)[:-1]])
+    idx = starts[:, None] + jnp.arange(T)[None, :]       # [B, T]
+    valid = jnp.arange(T)[None, :] < lv[:, None]
+    idx = jnp.clip(idx, 0, max(xv.shape[0] - 1, 0))
+
+    def fn(v):
+        g = v[idx]                                        # [B, T, ...]
+        pad = jnp.asarray(pad_value, v.dtype)
+        return jnp.where(valid.reshape(valid.shape + (1,) *
+                                       (g.ndim - 2)), g, pad)
+
+    return apply(fn, ensure_tensor(x)), Tensor(lv)
+
+
+def sequence_unpad(x, lengths, name=None):
+    """Padded [B, T, ...] -> packed [sum(L), ...] (static total length =
+    B*T with the tail rows zero — the valid rows are LEFT-PACKED; use
+    `lengths.sum()` to know how many are real). Reference
+    `sequence_unpad_op.cc` with the fixed-shape contract."""
+    xv = _val(ensure_tensor(x))
+    lv = _lengths(lengths)
+    B, T = xv.shape[:2]
+    valid = (jnp.arange(T)[None, :] < lv[:, None]).reshape(-1)
+    # stable argsort on ~valid left-packs valid rows preserving order
+    order = jnp.argsort(~valid, stable=True)
+
+    def fn(v):
+        flat = v.reshape((B * T,) + v.shape[2:])
+        packed = flat[order]
+        keep = valid[order]
+        return jnp.where(keep.reshape((-1,) + (1,) * (flat.ndim - 1)),
+                         packed, 0)
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_pool(x, lengths, pool_type="sum", name=None):
+    """Per-row pooling over the valid prefix: sum/mean/sqrt/max/first/
+    last (reference `sequence_pool_op.h` SequencePoolFunctor)."""
+    lv = _lengths(lengths)
+    T = _val(ensure_tensor(x)).shape[1]
+    mask = (jnp.arange(T)[None, :] < lv[:, None])
+    pool_type = pool_type.lower()
+
+    def fn(v):
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        if pool_type == "max":
+            neg = jnp.asarray(-np.inf, v.dtype)
+            out = jnp.where(m, v, neg).max(axis=1)
+            return jnp.where(lv.reshape((-1,) + (1,) * (out.ndim - 1))
+                             > 0, out, 0)
+        s = jnp.where(m, v, 0).sum(axis=1)
+        denom = jnp.maximum(lv, 1).astype(v.dtype)
+        denom = denom.reshape((-1,) + (1,) * (s.ndim - 1))
+        if pool_type == "mean" or pool_type == "average":
+            return s / denom
+        if pool_type == "sqrt":
+            return s / jnp.sqrt(denom)
+        if pool_type == "sum":
+            return s
+        if pool_type == "first":
+            ok = (lv > 0).reshape((-1,) + (1,) * (v.ndim - 2))
+            return jnp.where(ok, v[:, 0], 0)
+        if pool_type == "last":
+            i = jnp.maximum(lv - 1, 0)
+            out = v[jnp.arange(v.shape[0]), i]
+            ok = (lv > 0).reshape((-1,) + (1,) * (out.ndim - 1))
+            return jnp.where(ok, out, 0)
+        raise ValueError(f"sequence_pool: unknown pool_type {pool_type}")
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax over the time axis per row (reference
+    `sequence_softmax_op.h`); padding positions get 0."""
+    lv = _lengths(lengths)
+    T = _val(ensure_tensor(x)).shape[1]
+    mask = (jnp.arange(T)[None, :] < lv[:, None])
+
+    def fn(v):
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        neg = jnp.asarray(-1e30, v.dtype)
+        z = jnp.where(m, v, neg)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(m, p, 0)
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_expand_as(x, lengths, name=None):
+    """[B, ...] per-row features -> [B, T, ...] broadcast over each
+    row's timeline, padding zeroed (reference `sequence_expand_as_op.cc`
+    semantics on the padded layout)."""
+    lv = _lengths(lengths)
+    T = int(jnp.max(lv)) if lv.size else 0
+    mask = (jnp.arange(T)[None, :] < lv[:, None])
+
+    def fn(v):
+        g = jnp.broadcast_to(v[:, None], (v.shape[0], T) + v.shape[1:])
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 1))
+        return jnp.where(m, g, 0)
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate along TIME per row: rows are the same batch, each
+    input contributes its valid prefix (reference
+    `sequence_concat_op.cc`). Returns (padded concat, new lengths)."""
+    vals = [_val(ensure_tensor(x)) for x in xs]
+    lens = [_lengths(lv) for lv in lengths_list]
+    total = sum(int(v.shape[1]) for v in vals)
+    new_len = sum(lens)
+    B = vals[0].shape[0]
+
+    def fn(*vs):
+        # scatter each input's valid tokens to its packed offset per row
+        offset = jnp.zeros((B,), jnp.int32)
+        canvas = jnp.zeros((B, total) + vs[0].shape[2:], vs[0].dtype)
+        pos = jnp.arange(total)
+        for v, lv in zip(vs, lens):
+            T = v.shape[1]
+            t = jnp.arange(T)
+            valid = t[None, :] < lv[:, None]                  # [B, T]
+            dest = offset[:, None] + t[None, :]               # [B, T]
+            dest = jnp.where(valid, dest, total)              # drop pads
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dest.shape)
+            canvas = canvas.at[bidx, dest].set(
+                jnp.where(valid.reshape(valid.shape + (1,) *
+                                        (v.ndim - 2)), v, 0),
+                mode="drop")
+            offset = offset + lv
+        return canvas
+
+    tensors = [ensure_tensor(x) for x in xs]
+    return apply(fn, *tensors), Tensor(new_len)
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each row's valid prefix in place; padding stays at the
+    tail (reference `sequence_reverse_op.h`)."""
+    lv = _lengths(lengths)
+    T = _val(ensure_tensor(x)).shape[1]
+    t = jnp.arange(T)
+    src = jnp.where(t[None, :] < lv[:, None],
+                    lv[:, None] - 1 - t[None, :], t[None, :])
+    bidx = jnp.arange(lv.shape[0])[:, None]
+
+    def fn(v):
+        return v[bidx, src]
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row slice [offset_i, offset_i + length_i) -> padded
+    [B, max(length), ...] + new lengths (reference
+    `sequence_slice_op.h`)."""
+    xv = _val(ensure_tensor(x))
+    off = _lengths(offset)
+    ln = _lengths(length)
+    Tmax = int(jnp.max(ln)) if ln.size else 0
+    t = jnp.arange(Tmax)
+    src = jnp.clip(off[:, None] + t[None, :], 0, xv.shape[1] - 1)
+    valid = t[None, :] < ln[:, None]
+    bidx = jnp.arange(xv.shape[0])[:, None]
+
+    def fn(v):
+        g = v[bidx, src]
+        m = valid.reshape(valid.shape + (1,) * (v.ndim - 2))
+        return jnp.where(m, g, 0)
+
+    return apply(fn, ensure_tensor(x)), Tensor(ln)
+
+
+def sequence_erase(x, lengths, tokens, name=None):
+    """Remove every occurrence of `tokens` from each row, left-packing
+    the survivors (reference `sequence_erase_op.h`). x int [B, T].
+    Returns (erased [B, T] padded 0, new lengths)."""
+    xv = _val(ensure_tensor(x)).astype(jnp.int32)
+    lv = _lengths(lengths)
+    toks = jnp.asarray(list(tokens), jnp.int32)
+    B, T = xv.shape
+    valid = (jnp.arange(T)[None, :] < lv[:, None])
+    keep = valid & ~jnp.isin(xv, toks)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(xv, order, axis=1)
+    kept_sorted = jnp.take_along_axis(keep, order, axis=1)
+    out = jnp.where(kept_sorted, packed, 0)
+    return Tensor(out), Tensor(keep.sum(axis=1).astype(jnp.int32))
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """Sliding windows over each timeline: [B, T] -> [B, T, win]
+    (reference `sequence_enumerate_op.cc`); window positions past each
+    row's valid length (per `lengths`, or the padded width when None)
+    fill with pad_value — windows never read padding content."""
+    xv = _val(ensure_tensor(x))
+    B, T = xv.shape[:2]
+    t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]  # [T, w]
+    if lengths is None:
+        ok = (t < T)[None]                                # [1, T, w]
+    else:
+        lv = _lengths(lengths)
+        ok = t[None] < lv[:, None, None]                  # [B, T, w]
+    t = jnp.clip(t, 0, T - 1)
+
+    def fn(v):
+        g = v[:, t]                                       # [B, T, w]
+        return jnp.where(ok, g, jnp.asarray(pad_value, v.dtype))
+
+    return apply(fn, ensure_tensor(x))
+
+
+def sequence_conv(x, lengths, weight, context_length, context_start=None,
+                  bias=None, name=None):
+    """Context-window projection (reference `sequence_conv_op.h`): for
+    each position, concatenate `context_length` neighboring frames
+    (starting at context_start, default -(ctx-1)//2) and project with
+    weight [ctx*D, M]. Out-of-row frames are zero. Padded positions are
+    zeroed in the output."""
+    xv = _val(ensure_tensor(x))
+    lv = _lengths(lengths)
+    B, T, D = xv.shape
+    ctx = int(context_length)
+    start = -((ctx - 1) // 2) if context_start is None else \
+        int(context_start)
+    t = jnp.arange(T)[:, None] + start + jnp.arange(ctx)[None, :]
+    in_row = (t >= 0) & (t < T)
+    tc = jnp.clip(t, 0, T - 1)
+    valid_t = (jnp.arange(T)[None, :] < lv[:, None])      # [B, T]
+
+    def fn(v, w, *b):
+        g = v[:, tc]                                      # [B, T, ctx, D]
+        ok = in_row[None, :, :, None] & \
+            (tc[None] < lv[:, None, None])[..., None]
+        g = jnp.where(ok, g, 0).reshape(B, T, ctx * D)
+        out = jnp.einsum("btc,cm->btm", g, w)
+        if b:
+            out = out + b[0]
+        return jnp.where(valid_t[..., None], out, 0)
+
+    tensors = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return apply(fn, *tensors)

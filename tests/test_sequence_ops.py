@@ -1,0 +1,186 @@
+"""Sequence op family vs per-row numpy loops.
+
+The reference tests these against LoD fixtures
+(`tests/unittests/test_sequence_*.py`); here the jagged representation
+is padded [B, T, ...] + lengths, and every oracle below loops rows in
+plain python — the thing the vectorized implementation never does.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import sequence as S
+
+RS = np.random.RandomState(3)
+LENS = np.array([3, 0, 5, 2], np.int32)
+B, T, D = 4, 5, 3
+
+
+def _x():
+    return RS.randn(B, T, D).astype(np.float32)
+
+
+def test_sequence_mask():
+    m = S.sequence_mask(LENS, maxlen=6, dtype="float32").numpy()
+    assert m.shape == (4, 6)
+    for i, n in enumerate(LENS):
+        assert m[i, :n].sum() == n and m[i, n:].sum() == 0
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = RS.randn(int(LENS.sum()), D).astype(np.float32)
+    padded, lens = S.sequence_pad(flat, LENS, maxlen=T, pad_value=-1.0)
+    p = padded.numpy()
+    ofs = 0
+    for i, n in enumerate(LENS):
+        np.testing.assert_allclose(p[i, :n], flat[ofs:ofs + n])
+        assert (p[i, n:] == -1.0).all()
+        ofs += n
+    back = S.sequence_unpad(padded, lens).numpy()
+    np.testing.assert_allclose(back[:int(LENS.sum())], flat)
+    assert (back[int(LENS.sum()):] == 0).all()
+
+
+@pytest.mark.parametrize("ptype", ["sum", "mean", "sqrt", "max", "first",
+                                   "last"])
+def test_sequence_pool(ptype):
+    x = _x()
+    out = S.sequence_pool(x, LENS, ptype).numpy()
+    for i, n in enumerate(LENS):
+        seg = x[i, :n]
+        if n == 0:
+            if ptype == "max":
+                np.testing.assert_allclose(out[i], 0)
+            continue
+        ref = {"sum": seg.sum(0), "mean": seg.mean(0),
+               "sqrt": seg.sum(0) / np.sqrt(n), "max": seg.max(0),
+               "first": x[i, 0], "last": seg[-1]}[ptype]
+        np.testing.assert_allclose(out[i], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax():
+    x = RS.randn(B, T).astype(np.float32)
+    out = S.sequence_softmax(x[..., None], LENS).numpy()[..., 0]
+    for i, n in enumerate(LENS):
+        if n:
+            e = np.exp(x[i, :n] - x[i, :n].max())
+            np.testing.assert_allclose(out[i, :n], e / e.sum(),
+                                       rtol=1e-5)
+        assert (out[i, n:] == 0).all()
+
+
+def test_sequence_expand_as():
+    feat = RS.randn(B, D).astype(np.float32)
+    out = S.sequence_expand_as(feat, LENS).numpy()
+    for i, n in enumerate(LENS):
+        for t in range(n):
+            np.testing.assert_allclose(out[i, t], feat[i])
+        assert (out[i, n:] == 0).all()
+
+
+def test_sequence_concat():
+    la = np.array([2, 1, 0, 3], np.int32)
+    lb = np.array([1, 2, 2, 0], np.int32)
+    a = RS.randn(B, 3, D).astype(np.float32)
+    b = RS.randn(B, 3, D).astype(np.float32)
+    out, lens = S.sequence_concat([a, b], [la, lb])
+    o = out.numpy()
+    assert lens.numpy().tolist() == (la + lb).tolist()
+    for i in range(B):
+        ref = np.concatenate([a[i, :la[i]], b[i, :lb[i]]], 0)
+        np.testing.assert_allclose(o[i, :la[i] + lb[i]], ref)
+        assert (o[i, la[i] + lb[i]:] == 0).all()
+
+
+def test_sequence_reverse():
+    x = _x()
+    out = S.sequence_reverse(x, LENS).numpy()
+    for i, n in enumerate(LENS):
+        np.testing.assert_allclose(out[i, :n], x[i, :n][::-1])
+        np.testing.assert_allclose(out[i, n:], x[i, n:])
+
+
+def test_sequence_slice():
+    x = _x()
+    off = np.array([1, 0, 2, 0], np.int32)
+    ln = np.array([2, 0, 3, 1], np.int32)
+    out, lens = S.sequence_slice(x, off, ln)
+    o = out.numpy()
+    assert lens.numpy().tolist() == ln.tolist()
+    for i in range(B):
+        np.testing.assert_allclose(o[i, :ln[i]],
+                                   x[i, off[i]:off[i] + ln[i]])
+        assert (o[i, ln[i]:] == 0).all()
+
+
+def test_sequence_erase():
+    ids = np.array([[1, 2, 3, 2, 0],
+                    [2, 2, 2, 0, 0],
+                    [4, 5, 6, 7, 8],
+                    [9, 0, 0, 0, 0]], np.int32)
+    lens = np.array([5, 3, 5, 1], np.int32)
+    out, new_lens = S.sequence_erase(ids, lens, [2, 5])
+    o = out.numpy()
+    expect = [[1, 3, 0], [], [4, 6, 7, 8], [9]]
+    assert new_lens.numpy().tolist() == [len(e) for e in expect]
+    for i, e in enumerate(expect):
+        assert o[i, :len(e)].tolist() == e
+        assert (o[i, len(e):] == 0).all()
+
+
+def test_sequence_enumerate():
+    ids = np.arange(10, dtype=np.int32).reshape(2, 5)
+    out = S.sequence_enumerate(ids, 3, pad_value=-1).numpy()
+    assert out.shape == (2, 5, 3)
+    assert out[0, 0].tolist() == [0, 1, 2]
+    assert out[0, 3].tolist() == [3, 4, -1]
+    assert out[1, 4].tolist() == [9, -1, -1]
+    # with lengths: windows never read padding content
+    out2 = S.sequence_enumerate(ids, 3, pad_value=-1,
+                                lengths=np.array([2, 5], np.int32)).numpy()
+    assert out2[0, 0].tolist() == [0, 1, -1]
+    assert out2[0, 2].tolist() == [-1, -1, -1]
+    assert out2[1, 2].tolist() == [7, 8, 9]
+
+
+def test_sequence_pool_empty_rows_first_last():
+    x = np.full((2, 3, 2), -5.0, np.float32)       # padding content -5
+    lens = np.array([0, 2], np.int32)
+    for ptype in ("first", "last"):
+        out = S.sequence_pool(x, lens, ptype).numpy()
+        assert (out[0] == 0).all()                 # empty row -> zeros
+        assert (out[1] == -5.0).all()
+
+
+def test_sequence_conv_grad():
+    x = paddle.to_tensor(_x())
+    x.stop_gradient = False
+    w = paddle.to_tensor(RS.randn(3 * D, 4).astype(np.float32) * 0.3)
+    w.stop_gradient = False
+    out = S.sequence_conv(x, LENS, w, context_length=3)
+    assert tuple(out.shape) == (B, T, 4)
+    o = out.numpy()
+    # padded positions emit zeros
+    for i, n in enumerate(LENS):
+        assert (o[i, n:] == 0).all()
+    # middle position of row 2 sees frames 1,2,3
+    xi = x.numpy()[2]
+    ref = np.concatenate([xi[1], xi[2], xi[3]]) @ w.numpy()
+    np.testing.assert_allclose(o[2, 2], ref, rtol=1e-5)
+    out.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.isfinite(w.grad.numpy()).all()
+
+
+def test_sequence_ops_jit_clean():
+    import jax
+
+    @paddle.jit.to_static
+    def f(x):
+        pooled = S.sequence_pool(x, LENS, "mean")
+        sm = S.sequence_softmax(x, LENS)
+        return pooled.sum() + sm.sum()
+
+    x = paddle.to_tensor(_x())
+    v = f(x)
+    assert np.isfinite(v.numpy()).all()

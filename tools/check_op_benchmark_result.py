@@ -1,0 +1,68 @@
+"""Compare two op_bench.py result files — the op-benchmark CI gate.
+
+Parity target: `tools/check_op_benchmark_result.py:1` in the reference
+(compares develop vs PR op-benchmark logs and fails CI on speed/accuracy
+regressions). Same contract: exit non-zero when any case regresses more
+than --threshold (relative), print a table of per-case deltas.
+
+Usage:
+    python tools/check_op_benchmark_result.py baseline.json current.json \
+        [--threshold 0.15]
+"""
+import argparse
+import json
+import sys
+
+
+def compare(baseline, current, threshold):
+    rows = []
+    failures = []
+    for name, base in baseline.items():
+        if name.startswith("_") or name not in current:
+            continue
+        b, c = base["ms"], current[name]["ms"]
+        ratio = (c - b) / b if b > 0 else 0.0
+        status = "OK"
+        if ratio > threshold:
+            status = "REGRESSED"
+            failures.append(name)
+        elif ratio < -threshold:
+            status = "improved"
+        rows.append((name, b, c, ratio, status))
+    missing = [n for n in baseline
+               if not n.startswith("_") and n not in current]
+    return rows, failures, missing
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("current")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max allowed relative slowdown (0.15 = +15%%)")
+    args = ap.parse_args(argv)
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    with open(args.current) as f:
+        current = json.load(f)
+
+    rows, failures, missing = compare(baseline, current, args.threshold)
+    print(f"{'case':20s} {'base ms':>10s} {'cur ms':>10s} "
+          f"{'delta':>8s}  status")
+    for name, b, c, ratio, status in rows:
+        print(f"{name:20s} {b:10.3f} {c:10.3f} {ratio:+7.1%}  {status}")
+    for name in missing:
+        print(f"{name:20s} {'-':>10s} {'-':>10s} {'-':>8s}  MISSING")
+
+    if failures or missing:
+        print(f"\nFAIL: {len(failures)} regressed "
+              f"(> {args.threshold:.0%}), {len(missing)} missing",
+              file=sys.stderr)
+        return 8                      # reference exit code for regression
+    print(f"\nOK: {len(rows)} cases within {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
