@@ -294,9 +294,21 @@ class GPTForPretraining(Layer):
 
         def head(hh, ww):
             # honor the AMP policy like F.linear does: the vocab projection
-            # is the single largest matmul and must hit the MXU in bf16
-            return jnp.einsum("bsd,vd->bsv", _amp(hh, "matmul"), _amp(ww, "matmul"),
-                              preferred_element_type=jnp.float32)
+            # is the single largest matmul and must hit the MXU in bf16.
+            # Accumulate in f32 but EMIT logits in the compute dtype — an
+            # f32 [B,S,V] logits tensor is 3.3GB/write at 125M-bench scale
+            # and every CE pass re-reads it (measured ~10GB/step of the
+            # train step's HBM traffic); CE accumulates its log-sum-exp in
+            # f32 regardless (amp black list), so bf16 logits cost ~1e-3
+            # loss noise for ~2x less head+CE traffic
+            hh, ww = _amp(hh, "matmul"), _amp(ww, "matmul")
+            out = jnp.einsum("bsd,vd->bsv", hh, ww,
+                             preferred_element_type=jnp.float32)
+            # compute-dtype logits ONLY under amp (where CE's f32-
+            # accumulating LSE is active); otherwise keep the f32
+            # accumulator output so a hand-bf16 model still gets f32 CE
+            from ..amp import amp_state
+            return out.astype(hh.dtype) if amp_state().enabled else out
         logits = apply(head, h, w)
         if caches is not None:
             return logits, new_caches

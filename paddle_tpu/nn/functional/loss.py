@@ -52,7 +52,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
             if label_smoothing > 0:
                 k = logits.shape[ax]
                 tgt = (1 - label_smoothing) * tgt + label_smoothing / k
-            loss = -jnp.sum(tgt * logp, axis=ax)
+            # vocab-sized reduction: accumulate f32 off bf16 operands
+            loss = -jnp.sum(tgt * logp, axis=ax, dtype=jnp.float32)
             if reduction == "mean":
                 return jnp.mean(loss)
             return _reduce(loss, reduction)
@@ -68,10 +69,14 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
         picked = jnp.squeeze(picked, axis=ax)
         if label_smoothing > 0:
             k = logits.shape[ax]
-            smooth = jnp.mean(logp, axis=ax)
-            loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+            smooth = jnp.mean(logp, axis=ax, dtype=jnp.float32)
+            loss = -((1 - label_smoothing) * picked.astype(jnp.float32)
+                     + label_smoothing * smooth)
         else:
             loss = -picked
+        # the per-token losses are tiny [N]; summing them in the logits
+        # dtype (bf16 under amp) loses ~2 decimal digits over 16k tokens
+        loss = loss.astype(jnp.float32)
         if wv is not None:
             w = jnp.take(wv.astype(loss.dtype), safe_idx)
             loss = loss * w
@@ -101,7 +106,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
         logp = _log_softmax_amp(lg, ax, "softmax_with_cross_entropy")
         if soft_label:
             loss = -jnp.sum(lv.astype(logp.dtype) * logp, axis=ax,
-                            keepdims=True)
+                            keepdims=True, dtype=jnp.float32)
         else:
             idx = lv.astype(jnp.int32)
             if idx.ndim == lg.ndim and idx.shape[ax] == 1:

@@ -13,6 +13,31 @@ from ...core.tensor import Tensor, apply
 from ...tensor._helpers import ensure_tensor
 
 
+@jax.custom_vjp
+def _scale_shift(x, w, b):
+    """y = x * w + b applied in x's dtype (no f32 stream upcast), with a
+    hand-written vjp whose PARAM-GRAD reductions accumulate in f32 — the
+    automatic vjp of a bf16 multiply would sum the [B*S]-long bias/weight
+    gradients in bf16 (~2 digits lost over 16k tokens)."""
+    return x * w.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _scale_shift_fwd(x, w, b):
+    return _scale_shift(x, w, b), (x, w)
+
+
+def _scale_shift_bwd(res, g):
+    x, w = res
+    red = tuple(range(g.ndim - w.ndim))
+    dx = g * w.astype(g.dtype)
+    dw = jnp.sum(g * x, axis=red, dtype=jnp.float32).astype(w.dtype)
+    db = jnp.sum(g, axis=red, dtype=jnp.float32).astype(w.dtype)
+    return dx, dw, db
+
+
+_scale_shift.defvjp(_scale_shift_fwd, _scale_shift_bwd)
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
     x = ensure_tensor(x)
@@ -32,9 +57,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
         var = jnp.mean(jnp.square(d), axis=naxes, keepdims=True,
                        dtype=acc)
         out = d * jax.lax.rsqrt(var + epsilon).astype(v.dtype)
+        # scale/shift applied in the INPUT dtype: multiplying by the f32
+        # params would upcast the whole [B,S,D] stream to f32 (measured
+        # ~6.7GB/step of residual-stream traffic on the GPT bench);
+        # _scale_shift's custom vjp keeps the param-grad reductions f32
+        if weight is not None and bias is not None:
+            return _scale_shift(out, wb[0], wb[1])
         i = 0
         if weight is not None:
-            out = out * wb[i]
+            out = out * wb[i]        # f32 upcast: rare config, safe grads
             i += 1
         if bias is not None:
             out = out + wb[i]
