@@ -42,6 +42,19 @@ class Linear(Layer):
 
 
 class Embedding(Layer):
+    """Reference `nn/layer/common.py` Embedding + `lookup_table_v2_op`.
+
+    `sparse=True` (the reference's SelectedRows gradient container) is
+    accepted and DISSOLVED by design: on TPU the vjp of a gather is an
+    XLA scatter-add into the dense parameter buffer, which beats any
+    sparse row container for ICI/HBM (no host-side row bookkeeping, no
+    variable shapes). The genuinely-sparse regime — tables too big for
+    HBM with few touched rows — is served by
+    `distributed.ps.DistributedEmbedding` over the C++ parameter server
+    (pull/push of touched rows only), which is the real SelectedRows
+    successor here.
+    """
+
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):
         super().__init__()
