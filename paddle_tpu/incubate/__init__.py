@@ -9,5 +9,9 @@ dygraph recompute/LookAhead-style utilities.
 from .. import sparsity as asp  # noqa: F401
 from . import nn  # noqa: F401
 from ..distributed.recompute import recompute  # noqa: F401
+# paddle.incubate.LookAhead / ModelAverage compat aliases
+from ..optimizer.extras import (  # noqa: F401
+    Lookahead as LookAhead, ModelAverage,
+)
 
-__all__ = ["asp", "nn", "recompute"]
+__all__ = ["asp", "nn", "recompute", "LookAhead", "ModelAverage"]

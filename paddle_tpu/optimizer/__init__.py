@@ -1,5 +1,8 @@
 """paddle_tpu.optimizer — mirrors `python/paddle/optimizer/`."""
 from . import lr  # noqa: F401
+from .extras import (  # noqa: F401
+    ExponentialMovingAverage, ModelAverage, Lookahead, GradientMerge,
+)
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
     RMSProp, Lamb, LarsMomentum, DGCMomentum, L1Decay, L2Decay,

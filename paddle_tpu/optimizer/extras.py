@@ -1,0 +1,186 @@
+"""Optimizer wrappers: EMA, ModelAverage, Lookahead, GradientMerge.
+
+Parity targets in the reference `python/paddle/fluid/optimizer.py`:
+ExponentialMovingAverage:3927, ModelAverage:3618,
+LookaheadOptimizer:6608, GradientMergeOptimizer:6780. The reference
+implements each as a static-program rewrite (extra ops + control flow
+appended to the Program); here they are small eager/jit-agnostic state
+machines over parameter values — the tape/TrainStep sees ordinary
+optimizers.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage",
+           "Lookahead", "GradientMerge"]
+
+
+class ExponentialMovingAverage:
+    """Shadow copies: ema = decay*ema + (1-decay)*param, with the
+    reference's optional Adam-style bias correction (thres_steps
+    analog omitted; `update()` after each optimizer step)."""
+
+    def __init__(self, parameters, decay=0.999, bias_correction=True):
+        self._params = list(parameters)
+        self._decay = float(decay)
+        self._bias = bias_correction
+        self._step = 0
+        self._shadow = [p._value.astype(jnp.float32) for p in self._params]
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        self._shadow = [
+            d * s + (1.0 - d) * p._value.astype(jnp.float32)
+            for s, p in zip(self._shadow, self._params)]
+
+    def _corrected(self):
+        if not self._bias:
+            return self._shadow
+        c = 1.0 - self._decay ** max(self._step, 1)
+        return [s / c for s in self._shadow]
+
+    @contextlib.contextmanager
+    def apply(self, need_restore=True):
+        """Swap EMA weights in (evaluation); restore on exit."""
+        self._backup = [p._value for p in self._params]
+        for p, s in zip(self._params, self._corrected()):
+            p._value = s.astype(p._value.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._value = b
+            self._backup = None
+
+
+class ModelAverage:
+    """Running average of parameter trajectories over a sliding window
+    (reference ModelAverage accumulators sum_1/sum_2/sum_3 with
+    min/max_average_window); `accumulate()` each step, `apply()` swaps
+    the averaged weights in for evaluation."""
+
+    def __init__(self, parameters, average_window_rate=0.15,
+                 min_average_window=10000, max_average_window=10000):
+        self._params = list(parameters)
+        self._rate = average_window_rate
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._sum = [jnp.zeros_like(p._value, jnp.float32)
+                     for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def accumulate(self):
+        self._count += 1
+        window = max(self._min_w,
+                     min(self._max_w, int(self._count * self._rate) or 1))
+        if self._count > window:
+            # sliding restart (the reference rotates sum_1/2/3; a simple
+            # restart keeps the same bounded-window semantics)
+            self._sum = [s * 0.5 for s in self._sum]
+            self._count = max(1, self._count // 2)
+        self._sum = [s + p._value.astype(jnp.float32)
+                     for s, p in zip(self._sum, self._params)]
+
+    @contextlib.contextmanager
+    def apply(self, need_restore=True):
+        self._backup = [p._value for p in self._params]
+        n = max(self._count, 1)
+        for p, s in zip(self._params, self._sum):
+            p._value = (s / n).astype(p._value.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._value = b
+            self._backup = None
+
+
+class Lookahead:
+    """Lookahead (k steps forward, 1 step back): wraps an inner
+    optimizer; every k `step()`s the slow weights move
+    slow += alpha * (fast - slow) and fast resets to slow (reference
+    LookaheadOptimizer:6608)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self._alpha = float(alpha)
+        self._k = int(k)
+        self._steps = 0
+        self._params = list(inner_optimizer._parameter_list or [])
+        # wrappers nest (e.g. GradientMerge(Lookahead(sgd))): expose the
+        # same parameter-list surface the base Optimizer has
+        self._parameter_list = self._params
+        self._slow = [p._value.astype(jnp.float32) for p in self._params]
+
+    def step(self):
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self._k == 0:
+            a = self._alpha
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + a * (
+                    p._value.astype(jnp.float32) - self._slow[i])
+                self._slow[i] = slow
+                p._value = slow.astype(p._value.dtype)
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+
+class GradientMerge:
+    """Accumulate gradients over k micro-steps, apply the (averaged)
+    merged gradient once (reference GradientMergeOptimizer:6780 /
+    meta_optimizers/gradient_merge_optimizer.py). Call `step()` after
+    every backward; the inner optimizer runs on multiples of k."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+        self._steps = 0
+        self._params = list(inner_optimizer._parameter_list or [])
+        self._parameter_list = self._params
+        self._acc = [None] * len(self._params)
+
+    def step(self):
+        self._steps += 1
+        for i, p in enumerate(self._params):
+            if p.grad is None:
+                continue
+            g = p.grad._value
+            self._acc[i] = g if self._acc[i] is None else self._acc[i] + g
+            p.grad = None
+        if self._steps % self._k != 0:
+            return
+        from ..core.tensor import Tensor
+        scale = (1.0 / self._k) if self._avg else 1.0
+        for p, a in zip(self._params, self._acc):
+            if a is not None:
+                p.grad = Tensor(a * scale)
+        self.inner.step()
+        self.inner.clear_grad()
+        self._acc = [None] * len(self._params)
+
+    def clear_grad(self):
+        for p in self._params:
+            p.grad = None
+
+    def get_lr(self):
+        return self.inner.get_lr()

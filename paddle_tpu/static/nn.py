@@ -154,3 +154,12 @@ def prelu(x, mode="all", param_attr=None, name=None):
 def one_hot(input, depth, allow_out_of_range=False):
     from ..nn import functional as F
     return F.one_hot(input, depth)
+
+
+# sequence family (paddle.static.nn.sequence_* re-exports over the
+# padded+lengths jagged representation — see tensor/sequence.py)
+from ..tensor.sequence import (  # noqa: F401,E402
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_concat, sequence_reverse, sequence_slice, sequence_erase,
+    sequence_enumerate, sequence_conv, sequence_expand_as,
+)

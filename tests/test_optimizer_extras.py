@@ -1,0 +1,90 @@
+"""EMA / ModelAverage / Lookahead / GradientMerge wrappers.
+
+Reference analogs: `fluid/optimizer.py` ExponentialMovingAverage:3927,
+ModelAverage:3618, LookaheadOptimizer:6608, GradientMergeOptimizer:6780.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+
+
+def _param(v):
+    p = paddle.to_tensor(np.asarray(v, np.float32))
+    p.stop_gradient = False
+    return p
+
+
+def test_ema_update_and_apply():
+    p = _param([1.0, 2.0])
+    ema = opt.ExponentialMovingAverage([p], decay=0.5,
+                                       bias_correction=False)
+    p._value = p._value * 0 + 3.0          # params moved by training
+    ema.update()                            # ema = .5*1 + .5*3 = [2, 2.5]
+    np.testing.assert_allclose(np.asarray(ema._shadow[0]), [2.0, 2.5])
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [2.0, 2.5])
+    np.testing.assert_allclose(p.numpy(), 3.0)   # restored
+
+
+def test_ema_bias_correction():
+    p = _param([0.0])
+    ema = opt.ExponentialMovingAverage([p], decay=0.9)
+    p._value = p._value + 1.0
+    ema.update()
+    # shadow = 0.9*0 + 0.1*1 = 0.1; corrected by (1-0.9^1) -> 1.0
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [1.0], rtol=1e-6)
+
+
+def test_model_average():
+    p = _param([0.0])
+    ma = opt.ModelAverage([p], min_average_window=100)
+    for v in (1.0, 2.0, 3.0):
+        p._value = p._value * 0 + v
+        ma.accumulate()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), [2.0], rtol=1e-6)
+    np.testing.assert_allclose(p.numpy(), [3.0])
+
+
+def test_lookahead():
+    p = _param([0.0])
+    sgd = opt.SGD(learning_rate=1.0, parameters=[p])
+    la = opt.Lookahead(sgd, alpha=0.5, k=2)
+    for _ in range(2):                       # two fast steps of grad 1
+        p.grad = paddle.to_tensor(np.array([1.0], np.float32))
+        la.step()
+    # fast went 0 -> -1 -> -2; slow = 0 + .5*(-2 - 0) = -1; fast := slow
+    np.testing.assert_allclose(p.numpy(), [-1.0], rtol=1e-6)
+    assert np.allclose(np.asarray(la._slow[0]), -1.0)
+
+
+def test_gradient_merge_matches_big_batch():
+    rs = np.random.RandomState(0)
+    grads = [rs.randn(3).astype(np.float32) for _ in range(4)]
+
+    # merged: 4 micro-steps, k=4, averaged
+    p1 = _param(np.zeros(3))
+    gm = opt.GradientMerge(opt.SGD(learning_rate=0.1, parameters=[p1]),
+                           k_steps=4, avg=True)
+    for g in grads:
+        p1.grad = paddle.to_tensor(g)
+        gm.step()
+    # equivalent single step on the mean gradient
+    p2 = _param(np.zeros(3))
+    sgd = opt.SGD(learning_rate=0.1, parameters=[p2])
+    p2.grad = paddle.to_tensor(np.mean(grads, 0))
+    sgd.step()
+    np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
+    # inner optimizer ran exactly once
+    assert gm._steps == 4
+
+
+def test_gradient_merge_no_step_midway():
+    p = _param(np.zeros(2))
+    gm = opt.GradientMerge(opt.SGD(learning_rate=1.0, parameters=[p]),
+                           k_steps=3)
+    p.grad = paddle.to_tensor(np.ones(2, np.float32))
+    gm.step()
+    np.testing.assert_allclose(p.numpy(), 0.0)   # not applied yet
