@@ -73,10 +73,20 @@ def load_checkpoint(path, model, optimizer=None):
                     f"checkpoint shape mismatch for '{k}': saved "
                     f"{tuple(v.shape)} vs model {tuple(t._value.shape)}")
             v = v.astype(t._value.dtype)
-            # re-shard from the persistent mesh_axes tag (survives
-            # set_value), falling back to the live array's placement
-            sh = dist_env.param_sharding(t, mesh) if mesh is not None \
-                else getattr(t._value, "sharding", None)
+            # restore onto the LIVE array's placement first — a ZeRO-3
+            # run keeps parameters dp-sharded between steps, and
+            # re-deriving the spec from mesh_axes alone would silently
+            # inflate them back to full per-rank copies; the mesh_axes
+            # tag is the fallback when the live value carries no
+            # addressable sharding (fresh model, mesh changed)
+            live = getattr(t._value, "sharding", None)
+            if live is not None and mesh is not None and \
+                    getattr(live, "mesh", None) is mesh:
+                sh = live
+            elif mesh is not None:
+                sh = dist_env.param_sharding(t, mesh)
+            else:
+                sh = live
             t._value = jax.device_put(v, sh) if sh is not None else v
     if optimizer is not None and "optimizer" in restored:
         params = {k: p for k, p in model.named_parameters()}

@@ -75,6 +75,8 @@ def gpt_memory_plan(cfg, dp=1, mp=1, pp=1, sp=1, micro_batch=1,
     stage_params = int(n_params * stage_frac) if pp > 1 else n_params
     p_bytes = stage_params * param_dtype_bytes // mp
     g_bytes = stage_params * grad_dtype_bytes // mp
+    if zero_stage >= 3:
+        p_bytes //= dp           # stage 3: parameters dp-sharded too
     if zero_stage >= 2:
         g_bytes //= dp
 

@@ -62,9 +62,12 @@ def shard_batch(batch, mesh=None, seq_axis=False):
 class ShardedTrainStep:
     """pjit'd fwd+bwd+update over the global mesh.
 
-    zero_stage: 0 = replicated states (pure DP/TP), 1/2 = optimizer states
-    sharded over dp (reference sharding stage1/2; stage 3 == weights also
-    sharded is expressed the same way via param extra_axis)."""
+    zero_stage: 0 = replicated states (pure DP/TP); 1/2 = optimizer
+    states sharded over dp (reference sharding stage1/2); 3 = PARAMETERS
+    also sharded over dp — GSPMD then inserts the all-gather before each
+    use and the reduce-scatter on the gradient, which IS ZeRO-3
+    (reference `sharding_optimizer.py` stage 3 / `group_sharded`): no
+    rank ever holds a full parameter copy between steps."""
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=1,
                  seq_shard_batch=False, donate=True):
@@ -81,9 +84,18 @@ class ShardedTrainStep:
         self.buffers = [b for _, b in model.named_buffers() if b is not None]
         for p in self.params:
             self.optimizer._get_state(p)
+        if self.zero_stage >= 3:
+            # stage 3: re-place the live parameters dp-sharded so the
+            # persistent copies are 1/dp-sized from the start
+            for p in self.params:
+                p._value = jax.device_put(p._value, self._param_sharding(p))
         self._place_states()
         self._jitted = None
         self._donate = donate
+
+    def _param_sharding(self, p):
+        extra = "dp" if self.zero_stage >= 3 else None
+        return env.param_sharding(p, self.mesh, extra_axis=extra)
 
     def _state_sharding(self, p):
         extra = "dp" if self.zero_stage >= 1 else None
@@ -104,7 +116,7 @@ class ShardedTrainStep:
         loss_fn = self.loss_fn
         mesh = self.mesh
 
-        param_sh = [env.param_sharding(p, mesh) for p in params]
+        param_sh = [self._param_sharding(p) for p in params]
         state_sh = []
         for p in params:
             psh = self._state_sharding(p)
