@@ -84,3 +84,109 @@ def in_dynamic_mode():
 def is_grad_enabled():
     from .core import autograd
     return autograd.grad_enabled()
+
+
+# ---------------------------------------------------------------------------
+# top-level API-parity shims (reference python/paddle/__init__.py surface)
+# ---------------------------------------------------------------------------
+from .nn import ParamAttr  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+
+VarBase = Tensor                       # 1.x alias
+full_version = __version__
+commit = "paddle-tpu-native"
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter factory (reference
+    `fluid/layers/tensor.py create_parameter`)."""
+    from .nn.layer.layers import Layer
+    if attr is None and name is not None:
+        attr = ParamAttr(name=name)
+    helper = Layer()
+    return helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch reader decorator (reference `fluid/../batch.py`)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def rank(input):  # noqa: A002
+    """Number of dimensions, as a 0-d int Tensor (fluid.layers.rank)."""
+    import numpy as _np
+    n = input.ndim if hasattr(input, "ndim") else _np.asarray(input).ndim
+    return Tensor(_np.asarray(n))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr prints via numpy, so numpy's printoptions state is
+    the single source of truth — just forward."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def enable_dygraph(place=None):
+    """No-op: always eager."""
+
+
+def disable_dygraph():
+    import warnings
+    warnings.warn("paddle_tpu has no static mode; use jit.to_static",
+                  stacklevel=2)
+
+
+def in_dygraph_mode():
+    return True
+
+
+def disable_signal_handler():
+    """No-op (the reference unhooks its C++ fault handlers)."""
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_cuda_rng_state():
+    """CUDA-API-parity shim: returns the framework RNG state."""
+    from .core.random import default_generator
+    return [default_generator().get_state()]
+
+
+def set_cuda_rng_state(state):
+    from .core.random import default_generator
+    if state:
+        default_generator().set_state(state[0])

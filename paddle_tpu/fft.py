@@ -1,0 +1,80 @@
+"""paddle.fft — spectral ops over jnp.fft.
+
+Parity target: `python/paddle/fft.py` (reference delegates to cuFFT
+kernels `operators/spectral_op.cc`); here every transform is the jnp
+primitive routed through `apply()`, so FFTs record on the autograd tape
+and fuse under jit like any other op (XLA lowers to the FFT HLO).
+
+NOTE: complex-dtype coverage on TPU depends on the libtpu toolchain —
+some builds report UNIMPLEMENTED for complex ops; CPU (and any backend
+with complex support) runs the full surface.
+"""
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+from .tensor._helpers import ensure_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]
+
+
+def _wrap1(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        x = ensure_tensor(x)
+        return apply(lambda v: jfn(v, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} — jnp.fft.{name} on the tape."
+    return op
+
+
+def _wrap_n(name, axes_default=None):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=axes_default, norm="backward", name_=None):
+        x = ensure_tensor(x)
+        return apply(lambda v: jfn(v, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft")
+ifft = _wrap1("ifft")
+rfft = _wrap1("rfft")
+irfft = _wrap1("irfft")
+hfft = _wrap1("hfft")
+ihfft = _wrap1("ihfft")
+
+fft2 = _wrap_n("fft2", (-2, -1))
+ifft2 = _wrap_n("ifft2", (-2, -1))
+rfft2 = _wrap_n("rfft2", (-2, -1))
+irfft2 = _wrap_n("irfft2", (-2, -1))
+fftn = _wrap_n("fftn")
+ifftn = _wrap_n("ifftn")
+rfftn = _wrap_n("rfftn")
+irfftn = _wrap_n("irfftn")
+
+
+def fftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))

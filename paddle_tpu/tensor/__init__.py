@@ -31,7 +31,8 @@ _METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search, random]
 _SKIP = {"to_tensor", "apply", "ensure_tensor", "binary", "unary",
          "normalize_axis", "shape_arg", "meshgrid", "arange", "linspace",
          "eye", "zeros", "ones", "full", "empty", "rand", "randn", "randint",
-         "randperm", "uniform", "normal", "scatter_nd", "Tensor", "Parameter"}
+         "randperm", "uniform", "normal", "scatter_nd", "Tensor", "Parameter",
+         "broadcast_shape", "tolist"}
 
 for _mod in _METHOD_SOURCES:
     for _name in dir(_mod):
@@ -74,6 +75,30 @@ def add_n(inputs, name=None):
 
 
 register_method("scale", math.scale)
+
+# in-place variants (reference varbase inplace ops: tanh_, squeeze_, ...)
+# routed through _inplace_apply so the tape records the mutation
+
+
+def _register_inplace(name, fn):
+    register_method(name, lambda self, *a, **k: self._inplace_apply(
+        lambda v: fn(ensure_tensor(v), *a, **k)._value))
+
+
+_register_inplace("tanh_", math.tanh)
+_register_inplace("exp_", math.exp)
+_register_inplace("sqrt_", math.sqrt)
+_register_inplace("rsqrt_", math.rsqrt)
+_register_inplace("reciprocal_", math.reciprocal)
+_register_inplace("clip_", math.clip)
+_register_inplace("squeeze_", manipulation.squeeze)
+_register_inplace("unsqueeze_", manipulation.unsqueeze)
+register_method("scatter_", lambda self, index, updates, overwrite=True:
+                self._inplace_apply(
+                    lambda v, u: manipulation.scatter(
+                        Tensor(v), index, Tensor(u),
+                        overwrite=overwrite)._value,
+                    ensure_tensor(updates)))
 
 # ---------------------------------------------------------------------------
 # operator dunders

@@ -160,11 +160,20 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
 
 
 def crop(x, shape=None, offsets=None, name=None):
+    """paddle.crop / fluid crop_tensor: sub-box at `offsets` with
+    extents `shape`; -1 extends to the end of that dim."""
     x = ensure_tensor(x)
-    shp = shape_arg(shape)
-    offs = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
-    shp = [x._value.shape[i] if s == -1 else s for i, s in enumerate(shp)]
-    return apply(lambda v: jax.lax.dynamic_slice(v, offs, shp), x)
+    nd = x.ndim
+    offs = [0] * nd if offsets is None else [int(o) for o in offsets]
+    shp = list(x.shape) if shape is None else list(shape_arg(shape))
+    shp = [x.shape[i] - offs[i] if shp[i] == -1 else int(shp[i])
+           for i in range(nd)]
+    sl = tuple(builtins_slice(offs[i], offs[i] + shp[i])
+               for i in range(nd))
+    return apply(lambda v: v[sl], x)
+
+
+crop_tensor = crop
 
 
 def tile(x, repeat_times, name=None):
@@ -398,3 +407,34 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
         in_range = (v >= lo) & (v < hi)
         return jnp.where(in_range, v - lo, ignore_value)
     return apply(fn, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split into `num` (or shape[axis]) tensors along axis
+    (reference `operators/unstack_op.cc`)."""
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = apply(lambda v: tuple(
+        jnp.squeeze(s, axis=axis)
+        for s in jnp.split(v, n, axis=axis)), x)
+    return list(outs)
+
+
+def reverse(x, axis, name=None):
+    """fluid.layers.reverse == flip."""
+    return flip(x, axis)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tolist(x):
+    return np.asarray(ensure_tensor(x).numpy()).tolist()

@@ -1,0 +1,97 @@
+"""Top-level API-parity shims, inplace tensor ops, and paddle.fft.
+
+Reference surface: `python/paddle/__init__.py` exports.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_top_level_names_resolve():
+    for name in ["ParamAttr", "create_parameter", "batch", "rank",
+                 "set_printoptions", "enable_dygraph", "disable_dygraph",
+                 "in_dygraph_mode", "disable_signal_handler",
+                 "is_compiled_with_xpu", "is_compiled_with_npu",
+                 "is_compiled_with_rocm", "get_cuda_rng_state",
+                 "set_cuda_rng_state", "VarBase", "fft", "full_version",
+                 "diagonal", "unstack", "reverse", "broadcast_shape",
+                 "crop", "Model", "summary", "flops", "DataParallel"]:
+        assert getattr(paddle, name) is not None, name
+
+
+def test_create_parameter_and_batch():
+    w = paddle.create_parameter([3, 4])
+    assert tuple(w.shape) == (3, 4) and not w.stop_gradient
+    b = paddle.create_parameter([4], is_bias=True)
+    np.testing.assert_allclose(b.numpy(), 0.0)
+    r = paddle.batch(lambda: iter(range(7)), 3)
+    assert [len(x) for x in r()] == [3, 3, 1]
+    r2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+    assert [len(x) for x in r2()] == [3, 3]
+
+
+def test_manipulation_compat():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(paddle.diagonal(x).numpy(),
+                               np.diagonal(x))
+    parts = paddle.unstack(paddle.to_tensor(x), axis=1)
+    assert len(parts) == 4
+    np.testing.assert_allclose(parts[2].numpy(), x[:, 2])
+    np.testing.assert_allclose(paddle.reverse(x, [0]).numpy(), x[::-1])
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    c = paddle.crop(paddle.to_tensor(x), shape=[2, -1], offsets=[1, 2])
+    np.testing.assert_allclose(c.numpy(), x[1:3, 2:])
+    assert paddle.to_tensor(x).tolist() == x.tolist()
+
+
+def test_inplace_variants_record_grads():
+    x = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+    x.stop_gradient = False
+    y = x * 2.0
+    y.tanh_()
+    y.sum().backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), 2 * (1 - np.tanh([1.0, -1.0]) ** 2), rtol=1e-5)
+    z = paddle.zeros([2, 1, 3])
+    z.squeeze_(1)
+    assert tuple(z.shape) == (2, 3)
+    z.unsqueeze_(0)
+    assert tuple(z.shape) == (1, 2, 3)
+    t = paddle.zeros([4, 2])
+    t.scatter_(paddle.to_tensor(np.array([1, 3])),
+               paddle.to_tensor(np.ones((2, 2), np.float32)))
+    assert t.numpy()[1].tolist() == [1, 1]
+    assert t.numpy()[0].tolist() == [0, 0]
+
+
+def test_fft_roundtrip_and_grads():
+    rs = np.random.RandomState(0)
+    x = rs.randn(8).astype(np.float32)
+    X = paddle.fft.rfft(x)
+    np.testing.assert_allclose(X.numpy(), np.fft.rfft(x), rtol=1e-4,
+                               atol=1e-5)
+    back = paddle.fft.irfft(X, n=8)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+    c = rs.randn(4, 6).astype(np.complex64)
+    np.testing.assert_allclose(paddle.fft.fft2(c).numpy(),
+                               np.fft.fft2(c), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(np.arange(6.0)).numpy(),
+        np.fft.fftshift(np.arange(6.0)))
+    np.testing.assert_allclose(paddle.fft.fftfreq(5, 0.1).numpy(),
+                               np.fft.fftfreq(5, 0.1), rtol=1e-6)
+    # gradient through rfft (real input)
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    paddle.as_real(paddle.fft.rfft(xt)).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_rng_state_shims():
+    paddle.seed(5)
+    st = paddle.get_cuda_rng_state()
+    a = paddle.randn([3]).numpy()
+    paddle.set_cuda_rng_state(st)
+    b = paddle.randn([3]).numpy()
+    np.testing.assert_allclose(a, b)
