@@ -12,12 +12,15 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+    max_unpool2d,
 )
+from ...tensor.manipulation import diag_embed  # noqa: F401
 from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, square_error_cost, log_loss, sigmoid_focal_loss,
+    dice_loss, hsigmoid_loss, margin_cross_entropy,
     ctc_loss, npair_loss,
 )
 from .vision import (  # noqa: F401

@@ -157,3 +157,24 @@ def glu(x, axis=-1, name=None):
 
 def thresholded_relu(x, threshold=1.0, name=None):
     return unary(lambda v: jnp.where(v > threshold, v, 0.0), ensure_tensor(x))
+
+
+# in-place functional variants (reference relu_/elu_/softmax_/tanh_):
+# mutate the input tensor through the recorded in-place path and
+# return it
+
+def relu_(x, name=None):
+    return x._inplace_apply(lambda v: jnp.maximum(v, 0))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_apply(
+        lambda v: jnp.where(v > 0, v, alpha * jnp.expm1(v)))
+
+
+def tanh_(x, name=None):
+    return x._inplace_apply(jnp.tanh)
+
+
+def softmax_(x, axis=-1, name=None):
+    return x._inplace_apply(lambda v: jnn.softmax(v, axis=axis))

@@ -307,3 +307,47 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
                     v[:, :, i, j])
         return out[:, :, pd[0]: pd[0] + os_[0], pd[1]: pd[1] + os_[1]]
     return apply(fn, x)
+
+
+def gather_tree(ids, parents):
+    """Walk beam-search ancestry back from the last step so each beam
+    holds its full token path (reference `operators/gather_tree_op.cc`).
+    ids/parents: [max_time, batch, beam] -> gathered ids, same shape."""
+    ids = ensure_tensor(ids)
+    pv = ensure_tensor(parents)._value.astype(jnp.int32)
+
+    def fn(iv):
+        T, B, W = iv.shape
+        bidx = jnp.arange(B)[:, None]
+
+        def step(carry, t):
+            beams = carry                         # [B, W] beam index at t+1
+            tok = iv[t][bidx, beams]              # tokens along the path
+            par = pv[t][bidx, beams]
+            return par, tok
+
+        _, toks = jax.lax.scan(step, jnp.broadcast_to(jnp.arange(W), (B, W)),
+                               jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, axis=0)            # back to time order
+
+    return apply(fn, ids)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers for partial-FC training (reference
+    `operators/class_center_sample_op.cu`): EVERY positive class is
+    kept (paddle contract — the output grows past num_samples when the
+    batch touches more classes than that), then deterministic negative
+    classes fill the remainder. Host-side eager op (the output size is
+    data-dependent, like the reference's); returns
+    (remapped_label, sampled_class_index)."""
+    lv = np.asarray(ensure_tensor(label).numpy()).astype(np.int64).ravel()
+    pos = np.unique(lv)
+    n_out = max(int(num_samples), len(pos))
+    negatives = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                             assume_unique=True)
+    sampled = np.concatenate([pos, negatives[:n_out - len(pos)]])
+    lookup = {int(c): i for i, c in enumerate(sampled)}
+    remap = np.asarray([lookup[int(c)] for c in lv], np.int32)
+    return Tensor(jnp.asarray(remap)), Tensor(jnp.asarray(
+        sampled.astype(np.int32)))

@@ -365,3 +365,109 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
                         jnp.mean(jnp.sum(jnp.square(p), axis=1))) * 0.25
         return xent + reg
     return apply(fn, anchor, positive)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Dice loss for segmentation (reference `nn/functional/loss.py`
+    dice_loss): 1 - 2*|X∩Y| / (|X|+|Y|), reduced over all but batch."""
+    input = ensure_tensor(input)  # noqa: A001
+    lv = ensure_tensor(label)._value
+
+    def fn(p):
+        oh = jax.nn.one_hot(jnp.squeeze(lv, -1).astype(jnp.int32),
+                            p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, input)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    `operators/hierarchical_sigmoid_op.cc` default mode; the custom-tree
+    path_table/path_code inputs select per-sample node paths)."""
+    input = ensure_tensor(input)  # noqa: A001
+    weight = ensure_tensor(weight)
+    lv = ensure_tensor(label)._value.astype(jnp.int32).reshape(-1)
+    code_len = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+
+    if path_table is not None:
+        # paddle custom-tree contract: PER-SAMPLE rows [N, L]
+        tb = jnp.asarray(ensure_tensor(path_table)._value, jnp.int32)
+        cd = jnp.asarray(ensure_tensor(path_code)._value, jnp.float32)
+    else:
+        # complete-binary-tree codes for each class id: node indices and
+        # left/right bits from the root
+        tables, codes = [], []
+        for c in range(num_classes):
+            node = c + num_classes - 1   # leaf position in the heap
+            t, b = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                t.append(parent)
+                b.append(float(node == 2 * parent + 2))  # right child -> 1
+                node = parent
+            t = t[::-1][:code_len]
+            b = b[::-1][:code_len]
+            pad = code_len - len(t)
+            tables.append(t + [0] * pad)
+            codes.append(b + [-1.0] * pad)   # -1 marks padding
+        table_np = np.asarray(tables, np.int32)
+        code_np = np.asarray(codes, np.float32)
+        tb = jnp.asarray(table_np)[lv]   # [N, L] node ids per sample
+        cd = jnp.asarray(code_np)[lv]    # [N, L] bits (-1 padding)
+
+    def fn(x, w, *b):
+        logits = jnp.einsum("bd,bld->bl", x, w[tb])
+        if b:
+            logits = logits + b[0].reshape(-1)[tb]
+        valid = cd >= 0
+        # sigmoid CE with target = bit; paddle returns the per-sample
+        # path sum with shape [N, 1] (no batch reduction)
+        ce = jnp.maximum(logits, 0) - logits * cd + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(jnp.where(valid, ce, 0.0), axis=1,
+                       keepdims=True)
+
+    tensors = [input, weight]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return apply(fn, *tensors)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference
+    `operators/margin_cross_entropy_op.cu`): the target-class logit
+    cos(theta) becomes cos(margin1*theta + margin2) - margin3, then
+    scaled softmax CE. Single-group (non-model-parallel) semantics; the
+    class-parallel sharding composes via mp_layers.ParallelCrossEntropy."""
+    logits = ensure_tensor(logits)
+    lv = ensure_tensor(label)._value.astype(jnp.int32).reshape(-1)
+
+    def fn(lg):
+        n, c = lg.shape
+        onehot = jax.nn.one_hot(lv, c, dtype=lg.dtype)
+        # keep cos strictly inside (-1, 1): d/dx arccos is inf at +-1 and
+        # the inf poisons grads through where() (inf * 0 = NaN)
+        eps = 1e-6
+        cos = jnp.clip(lg, -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, axis=-1)
+        return loss
+
+    return apply(fn, logits)

@@ -185,3 +185,33 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "max")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values to
+    the flat H*W positions recorded in `indices`
+    (reference `operators/unpool_op.cc`)."""
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    n, c, oh, ow = x._value.shape
+    if output_size is not None:
+        H, W = int(output_size[-2]), int(output_size[-1])
+    else:
+        H = (oh - 1) * s[0] + k[0] - 2 * _norm_tuple(padding, 2)[0]
+        W = (ow - 1) * s[1] + k[1] - 2 * _norm_tuple(padding, 2)[1]
+    iv = indices._value.astype(jnp.int32)
+
+    def fn(v):
+        flat = jnp.zeros((n, c, H * W), v.dtype)
+        nidx = jnp.arange(n)[:, None, None]
+        cidx = jnp.arange(c)[None, :, None]
+        # set, not add: overlapping windows (stride < kernel) can share
+        # one argmax position and must place the value once
+        flat = flat.at[nidx, cidx, iv.reshape(n, c, -1)].set(
+            v.reshape(n, c, -1), mode="drop")
+        return flat.reshape(n, c, H, W)
+
+    return apply(fn, x)

@@ -438,3 +438,32 @@ def broadcast_shape(x_shape, y_shape):
 
 def tolist(x):
     return np.asarray(ensure_tensor(x).numpy()).tolist()
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Batched diagonal construction (reference
+    `nn/functional/extension.py diag_embed`): last dim becomes the
+    diagonal of a new square matrix placed at (dim1, dim2)."""
+    x = ensure_tensor(input)
+
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        out = base.at[..., rows, cols].set(v)   # row axis = ndim-2
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        # dim1 is the ROW axis, dim2 the COLUMN axis (paddle/torch
+        # semantics): with dim1 > dim2 and offset != 0 the result is the
+        # transpose of the default placement
+        order = [a for a in range(out.ndim) if a not in (out.ndim - 2,
+                                                         out.ndim - 1)]
+        first, second = (out.ndim - 2, out.ndim - 1) if d1 < d2 else \
+            (out.ndim - 1, out.ndim - 2)
+        order.insert(min(d1, d2), first)
+        order.insert(max(d1, d2), second)
+        return jnp.transpose(out, order)
+
+    return apply(fn, x)

@@ -95,3 +95,100 @@ def test_rng_state_shims():
     paddle.set_cuda_rng_state(st)
     b = paddle.randn([3]).numpy()
     np.testing.assert_allclose(a, b)
+
+
+def test_nn_functional_gap_closers():
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+
+    # dice loss: perfect one-hot prediction -> ~0
+    lbl = np.array([[0], [2]], np.int64)
+    perfect = np.eye(3, dtype=np.float32)[lbl.ravel()]
+    d = F.dice_loss(perfect, lbl).numpy()
+    assert d < 0.01
+    bad = np.full((2, 3), 1 / 3, np.float32)
+    assert F.dice_loss(bad, lbl).numpy() > d
+
+    # diag_embed
+    v = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    m = F.diag_embed(v).numpy()
+    assert m.shape == (2, 2, 2)
+    np.testing.assert_allclose(m[0], np.diag([1.0, 2.0]))
+    off = F.diag_embed(np.array([5.0], np.float32), offset=1).numpy()
+    np.testing.assert_allclose(off, [[0, 5], [0, 0]])
+    # swapped dims transpose the placement
+    sw = F.diag_embed(np.array([5.0], np.float32), offset=1,
+                      dim1=-1, dim2=-2).numpy()
+    np.testing.assert_allclose(sw, [[0, 0], [5, 0]])
+
+    # max_unpool2d inverts max_pool2d(return_mask=True)
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                               return_mask=True)
+    up = F.max_unpool2d(pooled, idx, 2, 2).numpy()
+    # overlapping windows place (not accumulate) the shared max
+    xo = np.zeros((1, 1, 3, 3), np.float32)
+    xo[0, 0, 1, 1] = 9.0
+    po, io = F.max_pool2d(paddle.to_tensor(xo), 2, 1, return_mask=True)
+    uo = F.max_unpool2d(po, io, 2, 1, output_size=(3, 3)).numpy()
+    assert uo[0, 0, 1, 1] == 9.0 and uo.sum() == 9.0
+    # every pooled max lands back at its argmax position
+    flat = up.reshape(2, -1)
+    for c in range(2):
+        for val in pooled.numpy()[0, c].ravel():
+            assert val in flat[c]
+    assert up.shape == x.shape
+
+    # hsigmoid loss: per-sample [N, 1] costs, finite grads
+    xh = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+    lab = paddle.to_tensor((rs.rand(8) * 6).astype(np.int64))
+    w = paddle.to_tensor(rs.randn(5, 4).astype(np.float32) * 0.1)
+    w.stop_gradient = False
+    l1 = F.hsigmoid_loss(xh, lab, 6, w)
+    assert tuple(l1.shape) == (8, 1) and (l1.numpy() > 0).all()
+    l1.sum().backward()
+    assert np.isfinite(w.grad.numpy()).all()
+
+    # margin_cross_entropy: finite even at saturated cosines (arccos
+    # endpoint used to emit NaN grads)
+    cos = np.clip(rs.randn(4, 10) * 0.3, -0.9, 0.9).astype(np.float32)
+    cos[0, 0] = 1.0
+    ct = paddle.to_tensor(cos)
+    ct.stop_gradient = False
+    lab2 = paddle.to_tensor(np.arange(4, dtype=np.int64))
+    m1 = F.margin_cross_entropy(ct, lab2)
+    m1.backward()
+    assert np.isfinite(float(m1.numpy())) and float(m1.numpy()) > 0
+    assert np.isfinite(ct.grad.numpy()).all()
+
+    # gather_tree walks parents
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)   # T,B,W
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+    out = F.gather_tree(ids, parents).numpy()
+    # beam 0 at last step came from parent 1: path 1->4->... check walk
+    assert out.shape == (3, 1, 2)
+    assert out[2, 0, 0] == 5 and out[1, 0, 0] == 4   # parent 1 at t=2
+
+    # class_center_sample keeps ALL positives (growing past num_samples
+    # when needed) and remaps correctly
+    lab3 = np.array([3, 7, 3], np.int64)
+    remap, sampled = F.class_center_sample(lab3, 10, 5)
+    sv = sampled.numpy()
+    assert 3 in sv and 7 in sv and len(sv) == 5
+    for i, orig in enumerate(lab3):
+        assert sv[remap.numpy()[i]] == orig
+    # more positives than num_samples: every positive survives
+    lab4 = np.arange(6, dtype=np.int64)
+    remap4, sampled4 = F.class_center_sample(lab4, 10, 3)
+    sv4 = sampled4.numpy()
+    assert len(sv4) == 6
+    for i in range(6):
+        assert sv4[remap4.numpy()[i]] == i
+
+    # functional inplace variants
+    t = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+    F.relu_(t)
+    np.testing.assert_allclose(t.numpy(), [0.0, 1.0])
+    t2 = paddle.to_tensor(np.array([0.0, 0.0], np.float32))
+    F.softmax_(t2)
+    np.testing.assert_allclose(t2.numpy(), [0.5, 0.5])
