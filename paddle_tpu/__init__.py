@@ -64,6 +64,9 @@ def __getattr__(name):
     if name == "flops_compiled":
         from .hapi.flops import flops_compiled
         return flops_compiled
+    if name == "callbacks":
+        from .hapi import callbacks
+        return callbacks
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
@@ -190,3 +193,51 @@ def set_cuda_rng_state(state):
     from .core.random import default_generator
     if state:
         default_generator().set_state(state[0])
+
+
+# Place shims for API parity — framework.py owns the canonical aliases
+from .framework import CUDAPinnedPlace, XPUPlace, NPUPlace  # noqa: F401,E402
+
+
+def get_cudnn_version():
+    return None                         # no cudnn in an XLA/TPU build
+
+
+def check_shape(shape):
+    """Reference creation-op shape validation (`all` must be the builtin
+    — the tensor reduction op shadows it in this namespace)."""
+    import builtins
+    import numpy as _np
+    from .enforce import enforce
+    shape = list(shape)
+    ok = builtins.all(
+        isinstance(s, (builtins.int, _np.integer))
+        and not isinstance(s, builtins.bool) for s in shape)
+    enforce(ok, f"shape must be ints, got {shape}", op="check_shape")
+    return shape
+
+
+def monkey_patch_math_varbase():
+    """No-op: Tensor methods are registered at import time."""
+
+
+def monkey_patch_variable():
+    """No-op: there is no static Variable to patch."""
+
+
+from .core import dtype  # noqa: F401,E402
+
+
+class _HubStub:
+    """paddle.hub placeholder: model hub downloads need egress; load
+    local checkpoints with paddle_tpu.load instead."""
+
+    def __getattr__(self, item):
+        # AttributeError so hasattr()/getattr(default) degrade gracefully
+        raise AttributeError(
+            f"paddle_tpu.hub.{item}: the model hub needs network access; "
+            "load local checkpoints with paddle_tpu.load / "
+            "hapi.Model.load")
+
+
+hub = _HubStub()

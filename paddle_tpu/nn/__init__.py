@@ -29,12 +29,13 @@ from .layer.activation import (  # noqa: F401
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
-    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, MaxUnPool2D,
 )
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, CTCLoss, HingeEmbeddingLoss,
     CosineEmbeddingLoss, TripletMarginLoss,
+ HSigmoidLoss,
 )
 from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
@@ -48,3 +49,5 @@ from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
 )
 from .layer.vision import PixelShuffle, PixelUnshuffle, ChannelShuffle  # noqa: F401
+
+from ..generation import BeamSearchDecoder  # noqa: F401,E402

@@ -155,3 +155,24 @@ class TripletMarginLoss(Layer):
         return F.triplet_margin_loss(input, positive, negative, self.margin,
                                      self.p, self.epsilon, self.swap,
                                      self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Reference `nn/layer/loss.py` HSigmoidLoss over F.hsigmoid_loss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([n_nodes, 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table,
+                               path_code=path_code)

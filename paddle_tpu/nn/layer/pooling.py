@@ -113,3 +113,21 @@ class AdaptiveMaxPool3D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self._output_size)
+
+
+class MaxUnPool2D(Layer):
+    """Reference `nn/layer/pooling.py` MaxUnPool2D over F.max_unpool2d."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._kernel = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._format = data_format
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self._kernel, self._stride,
+                              self._padding, self._format,
+                              self._output_size)

@@ -86,6 +86,15 @@ def _register_inplace(name, fn):
 
 
 _register_inplace("tanh_", math.tanh)
+_register_inplace("ceil_", math.ceil)
+_register_inplace("floor_", math.floor)
+_register_inplace("round_", math.round)
+_register_inplace("flatten_", manipulation.flatten)
+_register_inplace("scale_", math.scale)
+register_method("add_", lambda self, o: self._inplace_apply(
+    lambda v, w: v + w, ensure_tensor(o)))
+register_method("subtract_", lambda self, o: self._inplace_apply(
+    lambda v, w: v - w, ensure_tensor(o)))
 _register_inplace("exp_", math.exp)
 _register_inplace("sqrt_", math.sqrt)
 _register_inplace("rsqrt_", math.rsqrt)
@@ -142,3 +151,53 @@ def _setup_dunders():
 
 
 _setup_dunders()
+
+
+# module-level forms of the in-place ops (paddle.tensor exports them as
+# free functions too: paddle.tanh_(x) == x.tanh_())
+def _free_inplace(name):
+    def op(x, *a, **k):
+        return getattr(ensure_tensor(x), name)(*a, **k)
+    op.__name__ = name
+    return op
+
+
+for _n in ("tanh_", "exp_", "sqrt_", "rsqrt_", "reciprocal_", "clip_",
+           "squeeze_", "unsqueeze_", "scatter_", "ceil_", "floor_",
+           "round_", "flatten_", "scale_", "add_", "subtract_"):
+    globals()[_n] = _free_inplace(_n)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray ops (reference LoDTensorArray + array_read/write/length,
+# `fluid/layers/control_flow.py`): eager python-list semantics — under
+# jit use lax-native containers instead
+# ---------------------------------------------------------------------------
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    from ..enforce import enforce, OutOfRangeError
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = []
+    enforce(i <= len(array),
+            f"array_write index {i} past array length {len(array)}",
+            op="array_write", error_cls=OutOfRangeError)
+    if i == len(array):
+        array.append(ensure_tensor(x))
+    else:
+        array[i] = ensure_tensor(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    return array[i]
+
+
+def array_length(array):
+    import numpy as _np
+    return Tensor(_np.asarray(len(array), _np.int64))
