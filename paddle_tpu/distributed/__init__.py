@@ -39,6 +39,13 @@ from . import kvstore  # noqa: F401
 from .localsgd import LocalSGDStep, local_sgd_average  # noqa: F401
 from .kvstore import KVServer, KVClient  # noqa: F401
 from . import checkpoint  # noqa: F401
-from .checkpoint import save_checkpoint, load_checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_checkpoint, load_checkpoint, TrainEpochRange, train_epoch_range,
+)
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401
+from . import metrics  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy

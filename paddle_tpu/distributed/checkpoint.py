@@ -102,3 +102,97 @@ def load_checkpoint(path, model, optimizer=None):
                         cur[sk] = jax.device_put(v, sh) if sh is not None \
                             else v
     return restored
+
+
+class TrainEpochRange:
+    """Epoch-granular auto-checkpoint/resume bookkeeping.
+
+    Reference surface: `fluid/incubate/checkpoint/auto_checkpoint.py`
+    (`train_epoch_range`, `ExeTrainStatus`, HDFS-backed job-keyed dirs).
+    The TPU build keys a directory by job id (PADDLE_JOB_ID or explicit
+    `name`), persists a tiny JSON status next to orbax checkpoints, and
+    the generator skips already-completed epochs after a restart,
+    restoring model+optimizer from the newest checkpoint.
+    """
+
+    def __init__(self, max_epoch_num, name=None, checkpoint_dir=None,
+                 model=None, optimizer=None, save_interval=1):
+        import json
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name or os.environ.get("PADDLE_JOB_ID", "job_default")
+        root = checkpoint_dir or os.environ.get(
+            "PADDLE_CHECKPOINT_DIR", "/tmp/paddle_tpu_auto_checkpoint")
+        self.dir = os.path.join(root, self.name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.model = model
+        self.optimizer = optimizer
+        self.save_interval = int(save_interval)
+        self._status_path = os.path.join(self.dir, "status.json")
+        self.restored_from = None
+        if os.path.exists(self._status_path):
+            with open(self._status_path) as f:
+                self._status = json.load(f)
+        else:
+            self._status = {"epoch_no": -1}
+        self._pending = None
+
+    @property
+    def epoch_no(self):
+        return self._status["epoch_no"]
+
+    def _commit_status(self, epoch):
+        """Durably record `epoch` as completed. Only called once the
+        checkpoint for `epoch` is fully on disk — a crash between the
+        array write and this rename resumes from the PREVIOUS epoch, never
+        from a half-written one."""
+        import json
+        self._status = {"epoch_no": epoch}
+        tmp = self._status_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._status, f)
+        os.replace(tmp, self._status_path)
+
+    def _drain_pending(self):
+        if self._pending is not None:
+            ckptr, epoch = self._pending
+            ckptr.wait_until_finished()
+            self._pending = None
+            self._commit_status(epoch)
+
+    def _save(self, epoch):
+        # at most one async save in flight: finish (and commit) the
+        # previous one before starting this epoch's
+        self._drain_pending()
+        if self.model is not None:
+            ckpt = os.path.join(self.dir, f"epoch_{epoch}")
+            c = save_checkpoint(ckpt, self.model, self.optimizer,
+                                async_save=True)
+            if c is not None:
+                self._pending = (c, epoch)
+                return
+        self._commit_status(epoch)
+
+    def __iter__(self):
+        start = self.epoch_no + 1
+        if start > 0 and self.model is not None:
+            ckpt = os.path.join(self.dir, f"epoch_{self.epoch_no}")
+            if os.path.exists(ckpt):
+                load_checkpoint(ckpt, self.model, self.optimizer)
+                self.restored_from = ckpt
+        try:
+            for epoch in range(start, self.max_epoch_num):
+                yield epoch
+                if (epoch + 1) % self.save_interval == 0 or \
+                        epoch == self.max_epoch_num - 1:
+                    self._save(epoch)
+        finally:
+            # also runs on GeneratorExit (caller broke out early): the
+            # in-flight save still lands and its status gets committed
+            self._drain_pending()
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, **kwargs):
+    """`acp.train_epoch_range` analog (reference
+    `auto_checkpoint.py:train_epoch_range`)."""
+    return TrainEpochRange(max_epoch_num,
+                           save_interval=save_checkpoint_inter, **kwargs)

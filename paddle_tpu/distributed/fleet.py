@@ -148,3 +148,102 @@ def init_server(*args, **kwargs):
 def run_server():
     raise NotImplementedError(
         "parameter-server mode: use paddle_tpu.distributed.ps (round 2)")
+
+
+# ---------------------------------------------------------------------------
+# fleet.util / fleet.utils (reference `fleet/base/util_factory.py` UtilBase +
+# `fleet/utils/` namespace: fs, http_server KV)
+class UtilBase:
+    """Worker-side utility collection (reference `util_factory.py:UtilBase`).
+    On TPU the collective members ride the same global-array regime as
+    `distributed.collective`; file sharding mirrors `get_file_shard`."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        """Element-wise reduction of `input` across workers (reference
+        semantics: shape-preserving; only the worker dim collapses)."""
+        import jax
+        import numpy as np
+        arr = np.asarray(input, dtype=np.float64)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            gathered = np.asarray(multihost_utils.process_allgather(
+                jax.numpy.asarray(arr, dtype=jax.numpy.float32)),
+                dtype=np.float64)
+            if mode == "sum":
+                return gathered.sum(axis=0)
+            if mode == "max":
+                return gathered.max(axis=0)
+            if mode == "min":
+                return gathered.min(axis=0)
+            raise ValueError(f"unsupported mode {mode!r}")
+        if mode not in ("sum", "max", "min"):
+            raise ValueError(f"unsupported mode {mode!r}")
+        return arr
+
+    def barrier(self, comm_world="worker"):
+        from . import collective
+        collective.barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        """One entry per worker. Cross-host the values must be numeric
+        (ridden over process_allgather); arbitrary objects would need a
+        side-channel store and raise instead of returning a wrong-length
+        list."""
+        import jax
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            try:
+                arr = jax.numpy.asarray(np.asarray(input, dtype=np.float32))
+            except (TypeError, ValueError):
+                raise NotImplementedError(
+                    "fleet.util.all_gather across hosts supports numeric "
+                    "values only; use distributed.kvstore for objects")
+            return list(np.asarray(multihost_utils.process_allgather(arr)))
+        return [input]
+
+    def get_file_shard(self, files):
+        """Deterministic contiguous split of `files` for this worker
+        (reference `util_factory.py:get_file_shard`)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        trainer_id = worker_index()
+        trainers = worker_num()
+        base = len(files) // trainers
+        rem = len(files) % trainers
+        start = base * trainer_id + min(trainer_id, rem)
+        return files[start:start + base + (1 if trainer_id < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class _UtilsNamespace:
+    """`paddle.distributed.fleet.utils` — fs + recompute re-exports."""
+
+    @property
+    def fs(self):
+        from . import fs as fs_mod
+        return fs_mod
+
+    @property
+    def LocalFS(self):
+        from .fs import LocalFS as cls
+        return cls
+
+    @property
+    def HDFSClient(self):
+        from .fs import HDFSClient as cls
+        return cls
+
+    @property
+    def recompute(self):
+        from .recompute import recompute as fn
+        return fn
+
+
+utils = _UtilsNamespace()

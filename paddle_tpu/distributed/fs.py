@@ -1,0 +1,219 @@
+"""Filesystem abstraction for distributed checkpoint/dataset IO.
+
+Reference surface: `python/paddle/distributed/fleet/utils/fs.py` — `FS`
+abstract base (`:57`), `LocalFS`, `HDFSClient` (shells out to the hadoop
+CLI).  The TPU build keeps the same API because hapi auto-checkpoint and
+PS dataset sharding are written against it; `HDFSClient` is gated on the
+hadoop binary actually existing (zero-egress images don't ship one) and
+raises a clear error otherwise instead of half-working.
+"""
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    """Abstract filesystem (reference `fs.py:57`)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, src, dst):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference `fs.py:102`)."""
+
+    def ls_dir(self, path):
+        """Returns (dirs, files) under `path` (reference semantics)."""
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            if os.path.isdir(os.path.join(path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(f"{src} not found")
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(f"{dst} exists")
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        # local<->local "upload" is a copy, mirroring reference behavior
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise FSFileExistsError(f"{path} exists")
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a"):
+            pass
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """HDFS via the hadoop CLI (reference `fs.py:214`).  Requires a hadoop
+    binary; constructor fails fast when one is absent (this image has
+    none) rather than erroring on first use."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base = os.path.join(hadoop_home, "bin", "hadoop")
+        if not os.path.exists(self._base):
+            raise ExecuteError(
+                f"hadoop CLI not found at {self._base}; HDFSClient needs a "
+                "hadoop install (unavailable in this environment)")
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args):
+        cmd = [self._base, "fs"] + self._cfg + list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self._timeout)
+        if proc.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)}: {proc.stderr}")
+        return proc.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        if self.is_exist(path):
+            self._run("-rm", "-r", path)
+
+    def need_upload_download(self):
+        return True
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    rename = mv
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise FSFileExistsError(path)
+            return
+        self._run("-touchz", path)

@@ -1,0 +1,104 @@
+"""Distributed metrics: global AUC/acc/MAE/... from per-worker stats.
+
+Reference surface: `python/paddle/distributed/fleet/metrics/metric.py` —
+`sum/max/min/auc/mae/rmse/mse/acc`, each all-reducing a local stat array
+across trainers before computing the final scalar.
+
+TPU-native mechanism: on a single process the local stats ARE the global
+stats (the global-array regime — a dp-sharded eval already psums inside
+the compiled step).  Across processes (`jax.distributed` over DCN) the
+reduction rides `multihost_utils.process_allgather`, the JAX analog of
+the reference's gloo/NCCL allreduce on stat tensors.
+"""
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+_py_max = max  # kept before the reference-named shadows below
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+def _global_sum_array(arr):
+    arr = np.asarray(arr, dtype=np.float64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jax.numpy.asarray(arr, dtype=jax.numpy.float32))
+        return np.asarray(gathered, dtype=np.float64).sum(axis=0)
+    return arr
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 — reference name
+    return float(_global_sum_array(_np(input)).sum())
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    local = float(np.max(_np(input)))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jax.numpy.asarray([local], dtype=jax.numpy.float32))
+        return float(np.max(np.asarray(gathered)))
+    return local
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    local = float(np.min(_np(input)))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jax.numpy.asarray([local], dtype=jax.numpy.float32))
+        return float(np.min(np.asarray(gathered)))
+    return local
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative prediction histograms
+    (same bucketed-stat formulation as the reference `metric.py:134` and
+    the C++ auc op): stat_pos[i]/stat_neg[i] count pos/neg examples whose
+    predicted score falls in bucket i."""
+    pos = _global_sum_array(_np(stat_pos)).reshape(-1)
+    neg = _global_sum_array(_np(stat_neg)).reshape(-1)
+    # AUC = P(score_pos > score_neg), ties at half credit: walk buckets in
+    # ascending score order; each pos bucket wins against all negs strictly
+    # below it and half of the negs sharing its bucket
+    area = 0.0
+    tot_pos = 0.0
+    tot_neg = 0.0
+    for i in range(len(pos)):
+        area += pos[i] * (tot_neg + neg[i] / 2.0)
+        tot_pos += pos[i]
+        tot_neg += neg[i]
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    err = float(_global_sum_array(_np(abserr)).sum())
+    cnt = float(_global_sum_array(_np(total_ins_num)).sum())
+    return err / _py_max(cnt, 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    err = float(_global_sum_array(_np(sqrerr)).sum())
+    cnt = float(_global_sum_array(_np(total_ins_num)).sum())
+    return err / _py_max(cnt, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None):
+    ok = float(_global_sum_array(_np(correct)).sum())
+    cnt = float(_global_sum_array(_np(total)).sum())
+    return ok / _py_max(cnt, 1.0)

@@ -313,6 +313,53 @@ class TrainStep:
             raise FloatingPointError(msg)
 
 
+class TracedLayer:
+    """Trace a dygraph Layer into a reusable compiled program.
+
+    Reference surface: `fluid/dygraph/jit.py:1157` (`TracedLayer.trace`
+    returns (outputs, traced); traced(inputs) replays;
+    `save_inference_model` exports).  Here "trace" is a jit-compiled
+    StaticFunction over the layer's forward with its parameters captured —
+    no Program recording, the jaxpr IS the program.
+    """
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._static = static_fn
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (tuple, list)) \
+            else [inputs]
+        static_fn = StaticFunction(layer.forward, layer=layer)
+        out = static_fn(*inputs)
+        traced = TracedLayer(layer, static_fn, inputs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        return list(outs), traced
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (tuple, list)) \
+            else [inputs]
+        out = self._static(*inputs)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        # XLA owns scheduling/fusion; the reference's knobs have no analog
+        return None
+
+    def save_inference_model(self, path, feed=None, fetch=None, **configs):
+        if feed is not None or fetch is not None:
+            import warnings
+            warnings.warn(
+                "TracedLayer.save_inference_model: feed/fetch slot "
+                "selection is not supported; exporting ALL traced "
+                "inputs/outputs", stacklevel=2)
+        from ..inference.export import save_inference_model
+        save_inference_model(path, self._layer,
+                             example_inputs=self._example_inputs)
+
+
 def save(layer, path, input_spec=None, **configs):
     """Export for inference: StableHLO via jax.export + params
     (paddle.jit.save analog — see paddle_tpu.inference)."""

@@ -1,0 +1,212 @@
+"""Tests for the round-2 surface batch: auto-parallel annotate API,
+fleet.utils.fs, distributed metrics, TracedLayer, auto-checkpoint
+TrainEpochRange, fleet.util."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import (
+    ProcessMesh, shard_tensor, shard_op, LocalFS, metrics,
+    TrainEpochRange, fleet,
+)
+
+
+# ---------------------------------------------------------------- auto_parallel
+def test_process_mesh_topology():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert pm.shape == [2, 4]
+    assert pm.ndim == 2
+    assert pm.process_ids == list(range(8))
+    assert pm.mesh.shape["x"] == 2 and pm.mesh.shape["y"] == 4
+
+
+def test_shard_tensor_places_and_tags():
+    pm = ProcessMesh((2, 4), dim_names=["x", "y"])
+    t = Tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    out = shard_tensor(t, pm, ["x", None])
+    assert out.mesh_axes == ("x", None)
+    # eager placement onto the mesh really shards dim 0 over x
+    sh = out._value.sharding
+    assert sh.shard_shape(out._value.shape)[0] == 4
+
+
+def test_shard_tensor_drops_nondivisible():
+    pm = ProcessMesh((2, 4), dim_names=["x", "y"])
+    t = Tensor(np.ones((7, 4), dtype=np.float32))
+    out = shard_tensor(t, pm, ["x", None])  # 7 % 2 != 0 -> dropped
+    assert out.mesh_axes == (None, None)
+
+
+def test_shard_tensor_under_jit_constrains():
+    import jax
+    pm = ProcessMesh((2, 4), dim_names=["x", "y"])
+
+    def f(v):
+        t = Tensor(v)
+        t2 = shard_tensor(t, pm, ["x", "y"])
+        return (t2 * 2)._value
+
+    out = jax.jit(f)(np.ones((4, 8), dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_shard_op_wraps_outputs():
+    pm = ProcessMesh((2, 4), dim_names=["x", "y"])
+
+    def matmul(a, b):
+        return paddle.matmul(a, b)
+
+    f = paddle.distributed.shard_op(
+        matmul, pm, out_shard_specs=[["x", None]])
+    a = Tensor(np.ones((4, 6), dtype=np.float32))
+    b = Tensor(np.ones((6, 8), dtype=np.float32))
+    out = f(a, b)
+    assert out.mesh_axes == ("x", None)
+    np.testing.assert_allclose(out.numpy(), 6.0)
+
+
+def test_shard_spec_unknown_axis_raises():
+    pm = ProcessMesh((2,), dim_names=["x"])
+    with pytest.raises(ValueError):
+        shard_tensor(Tensor(np.ones((4,), np.float32)), pm, ["bogus"])
+
+
+# ------------------------------------------------------------------------- fs
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == []
+    dirs, files = fs.ls_dir(d)
+    assert files == ["x.txt"]
+    fs.mv(f, os.path.join(d, "y.txt"))
+    assert not fs.is_exist(f)
+    with pytest.raises(Exception):
+        fs.mv(os.path.join(d, "nope"), os.path.join(d, "z"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert fs.ls_dir(d) == ([], [])
+
+
+def test_hdfs_client_fails_fast_without_hadoop():
+    from paddle_tpu.distributed.fs import HDFSClient, ExecuteError
+    with pytest.raises(ExecuteError):
+        HDFSClient("/nonexistent/hadoop_home")
+
+
+# -------------------------------------------------------------------- metrics
+def test_metrics_auc_matches_pairwise_bruteforce():
+    rng = np.random.RandomState(0)
+    n_buckets = 32
+    pos = rng.randint(0, 50, size=n_buckets).astype(np.float64)
+    neg = rng.randint(0, 50, size=n_buckets).astype(np.float64)
+    got = metrics.auc(pos, neg)
+    # brute force over bucket pairs with half credit for ties
+    wins = 0.0
+    for i in range(n_buckets):
+        for j in range(n_buckets):
+            if i > j:
+                wins += pos[i] * neg[j]
+            elif i == j:
+                wins += 0.5 * pos[i] * neg[j]
+    want = wins / (pos.sum() * neg.sum())
+    assert abs(got - want) < 1e-12
+
+
+def test_metrics_scalars():
+    assert metrics.sum([1.0, 2.0, 3.0]) == 6.0
+    assert metrics.max([1.0, 5.0]) == 5.0
+    assert metrics.min([1.0, 5.0]) == 1.0
+    assert metrics.acc([8.0], [10.0]) == pytest.approx(0.8)
+    assert metrics.mae([4.0], [8.0]) == pytest.approx(0.5)
+    assert metrics.rmse([16.0], [4.0]) == pytest.approx(2.0)
+    assert metrics.auc(np.zeros(4), np.zeros(4)) == 0.5  # degenerate
+
+
+# ---------------------------------------------------------------- TracedLayer
+def test_traced_layer_matches_eager_and_exports(tmp_path):
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = Tensor(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    eager = net(x).numpy()
+    outs, traced = paddle.jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(outs[0].numpy(), eager, rtol=1e-6)
+    # replay
+    again = traced([x])
+    np.testing.assert_allclose(again[0].numpy(), eager, rtol=1e-6)
+    path = str(tmp_path / "traced_model")
+    traced.save_inference_model(path)
+    from paddle_tpu.inference.export import load_inference_model
+    loaded = load_inference_model(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded(x.numpy())), eager, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------- auto-checkpoint
+def test_train_epoch_range_resumes(tmp_path):
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    root = str(tmp_path)
+
+    seen = []
+    r = TrainEpochRange(3, name="job_a", checkpoint_dir=root, model=net,
+                        optimizer=opt)
+    for epoch in r:
+        seen.append(epoch)
+        # mutate a weight so the checkpoint has something real
+        net.weight.set_value(net.weight.numpy() + 1.0)
+    assert seen == [0, 1, 2]
+    w_after = net.weight.numpy().copy()
+
+    # "restart": fresh model, same job dir -> no epochs left, state restored
+    paddle.seed(1)
+    net2 = nn.Linear(4, 4)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=net2.parameters())
+    r2 = TrainEpochRange(3, name="job_a", checkpoint_dir=root, model=net2,
+                         optimizer=opt2)
+    seen2 = list(r2)
+    assert seen2 == []
+    np.testing.assert_allclose(net2.weight.numpy(), w_after, rtol=1e-6)
+
+    # partial-resume: more epochs than completed continues from epoch 3
+    r3 = TrainEpochRange(5, name="job_a", checkpoint_dir=root, model=net2,
+                         optimizer=opt2)
+    assert list(r3) == [3, 4]
+
+
+def test_train_epoch_range_early_break_commits(tmp_path):
+    net = nn.Linear(4, 4)
+    r = TrainEpochRange(5, name="job_b", checkpoint_dir=str(tmp_path),
+                        model=net)
+    for epoch in r:
+        if epoch == 1:
+            break  # GeneratorExit path: in-flight save must still commit
+    r2 = TrainEpochRange(5, name="job_b", checkpoint_dir=str(tmp_path),
+                         model=net)
+    assert r2.epoch_no == 0  # epoch 0 completed+saved; epoch 1 did not
+    assert list(r2) == [1, 2, 3, 4]
+
+
+# ----------------------------------------------------------------- fleet.util
+def test_fleet_util_surface():
+    assert fleet.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    # element-wise (shape-preserving) reduction semantics
+    np.testing.assert_allclose(
+        fleet.util.all_reduce(np.array([1.0, 2.0]), mode="sum"), [1.0, 2.0])
+    with pytest.raises(ValueError):
+        fleet.util.all_reduce([1.0], mode="prod")
+    assert fleet.util.all_gather(3.5) == [3.5]
+    assert fleet.utils.LocalFS is LocalFS
+    fleet.util.print_on_rank("hello", 0)
