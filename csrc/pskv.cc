@@ -15,6 +15,7 @@
 // C ABI throughout (ctypes binding, no pybind).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -37,11 +38,22 @@ namespace {
 
 constexpr int kShards = 64;
 
-enum class Opt : int32_t { SGD = 0, ADAGRAD = 1 };
+enum class Opt : int32_t { SGD = 0, ADAGRAD = 1, SUM = 2 };
+// SUM: row += g. Delta-merge mode for GeoSGD-style async training (workers
+// push (local - last_synced)/n_trainers parameter deltas, the table is the
+// accumulator — analog of the reference's geo_sgd_transpiler.py mode).
 
 struct Shard {
   std::mutex mu;
   std::unordered_map<int64_t, std::vector<float>> rows;  // value (+accum)
+  // ---- disk spill (SSD-table analog of distributed/table/ssd_sparse_table
+  // .cc, which backs cold rows with rocksdb): rows beyond the per-shard
+  // memory budget live in a fixed-stride per-shard file; pulls/pushes of a
+  // spilled key promote it back, evicting some other resident row.
+  std::unordered_map<int64_t, int64_t> disk_slot;  // key -> file slot
+  std::vector<int64_t> free_slots;
+  int spill_fd = -1;
+  int64_t next_slot = 0;
 };
 
 struct Table {
@@ -52,6 +64,9 @@ struct Table {
   uint64_t seed = 0;
   Shard shards[kShards];
   std::atomic<int64_t> size{0};
+  // spill config (0 = pure in-memory)
+  int64_t mem_budget_per_shard = 0;
+  std::string spill_dir;
 
   size_t row_floats() const {
     return opt == Opt::ADAGRAD ? 2 * (size_t)dim : (size_t)dim;
@@ -61,16 +76,96 @@ struct Table {
     return shards[(uint64_t)key % kShards];
   }
 
+  bool enable_spill(const char* dir, int64_t max_mem_rows) {
+    spill_dir = dir;
+    mem_budget_per_shard = max_mem_rows / kShards;
+    if (mem_budget_per_shard < 1) mem_budget_per_shard = 1;
+    for (int i = 0; i < kShards; ++i) {
+      std::string path = spill_dir + "/shard_" + std::to_string(i) + ".bin";
+      std::lock_guard<std::mutex> lk(shards[i].mu);
+      shards[i].spill_fd =
+          ::open(path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+      if (shards[i].spill_fd < 0) return false;
+    }
+    return true;
+  }
+
+  // write `row` to the shard's spill file, recording its slot. caller holds
+  // the shard lock.
+  bool spill_row(Shard& sh, int64_t key, const std::vector<float>& row) {
+    int64_t slot;
+    if (!sh.free_slots.empty()) {
+      slot = sh.free_slots.back();
+      sh.free_slots.pop_back();
+    } else {
+      slot = sh.next_slot++;
+    }
+    size_t bytes = row_floats() * sizeof(float);
+    ssize_t w = ::pwrite(sh.spill_fd, row.data(), bytes, (off_t)slot * bytes);
+    if (w != (ssize_t)bytes) {  // ENOSPC etc: keep the row resident
+      sh.free_slots.push_back(slot);
+      return false;
+    }
+    sh.disk_slot[key] = slot;
+    return true;
+  }
+
+  // if the shard is over budget, move one resident row (not `keep`) to disk.
+  void maybe_evict(Shard& sh, int64_t keep) {
+    if (sh.spill_fd < 0) return;
+    while ((int64_t)sh.rows.size() > mem_budget_per_shard) {
+      auto victim = sh.rows.end();
+      for (auto it = sh.rows.begin(); it != sh.rows.end(); ++it) {
+        if (it->first != keep) { victim = it; break; }
+      }
+      if (victim == sh.rows.end()) return;  // only `keep` resident
+      if (!spill_row(sh, victim->first, victim->second)) {
+        // disk full/broken: stop evicting rather than lose data; memory
+        // grows past budget but every value stays correct
+        return;
+      }
+      sh.rows.erase(victim);
+    }
+  }
+
   std::vector<float>& lookup_init(int64_t key, Shard& sh) {
     auto it = sh.rows.find(key);
     if (it != sh.rows.end()) return it->second;
+    if (sh.spill_fd >= 0) {
+      auto dit = sh.disk_slot.find(key);
+      if (dit != sh.disk_slot.end()) {  // promote from disk
+        std::vector<float> row(row_floats());
+        size_t bytes = row_floats() * sizeof(float);
+        ssize_t r = ::pread(sh.spill_fd, row.data(), bytes,
+                            (off_t)dit->second * bytes);
+        if (r != (ssize_t)bytes) {
+          std::fprintf(stderr,
+                       "pskv: spill read failed for key %lld (slot %lld)\n",
+                       (long long)key, (long long)dit->second);
+          std::fill(row.begin(), row.end(), 0.0f);
+        }
+        sh.free_slots.push_back(dit->second);
+        sh.disk_slot.erase(dit);
+        auto& ref = sh.rows.emplace(key, std::move(row)).first->second;
+        maybe_evict(sh, key);
+        return ref;
+      }
+    }
     std::vector<float> row(row_floats(), 0.0f);
     // deterministic per-key init (same row on every server restart)
     std::mt19937_64 rng(seed ^ (uint64_t)key * 0x9E3779B97F4A7C15ull);
     std::uniform_real_distribution<float> dist(-init_range, init_range);
     for (int i = 0; i < dim; ++i) row[i] = dist(rng);
     size.fetch_add(1);
-    return sh.rows.emplace(key, std::move(row)).first->second;
+    auto& ref = sh.rows.emplace(key, std::move(row)).first->second;
+    maybe_evict(sh, key);
+    return ref;
+  }
+
+  ~Table() {
+    for (auto& sh : shards) {
+      if (sh.spill_fd >= 0) ::close(sh.spill_fd);
+    }
   }
 
   void pull(const int64_t* keys, int64_t n, float* out) {
@@ -90,6 +185,8 @@ struct Table {
       const float* g = grads + i * dim;
       if (opt == Opt::SGD) {
         for (int d = 0; d < dim; ++d) row[d] -= lr * g[d];
+      } else if (opt == Opt::SUM) {
+        for (int d = 0; d < dim; ++d) row[d] += g[d];
       } else {  // adagrad: accumulator stored after the value
         float* acc = row.data() + dim;
         for (int d = 0; d < dim; ++d) {
@@ -247,6 +344,21 @@ int64_t pskv_table_size(void* tp) {
   return static_cast<Table*>(tp)->size.load();
 }
 
+int32_t pskv_table_enable_spill(void* tp, const char* dir,
+                                int64_t max_mem_rows) {
+  return static_cast<Table*>(tp)->enable_spill(dir, max_mem_rows) ? 0 : -1;
+}
+
+int64_t pskv_table_mem_rows(void* tp) {
+  auto* t = static_cast<Table*>(tp);
+  int64_t n = 0;
+  for (auto& sh : t->shards) {
+    std::lock_guard<std::mutex> lk(sh.mu);
+    n += (int64_t)sh.rows.size();
+  }
+  return n;
+}
+
 void pskv_pull(void* tp, const int64_t* keys, int64_t n, float* out) {
   static_cast<Table*>(tp)->pull(keys, n, out);
 }
@@ -266,11 +378,23 @@ int64_t pskv_save(void* tp, const char* path) {
   std::fwrite(&t->dim, sizeof(int32_t), 1, f);
   int32_t opt = (int32_t)t->opt;
   std::fwrite(&opt, sizeof(int32_t), 1, f);
+  std::vector<float> tmp(rf);
   for (auto& sh : t->shards) {
     std::lock_guard<std::mutex> lk(sh.mu);
     for (auto& kv : sh.rows) {
       std::fwrite(&kv.first, sizeof(int64_t), 1, f);
       std::fwrite(kv.second.data(), sizeof(float), rf, f);
+      ++count;
+    }
+    for (auto& kv : sh.disk_slot) {  // spilled rows are live rows too
+      ssize_t r = ::pread(sh.spill_fd, tmp.data(), rf * sizeof(float),
+                          (off_t)kv.second * rf * sizeof(float));
+      if (r != (ssize_t)(rf * sizeof(float))) {
+        std::fclose(f);
+        return -1;  // refuse to write a corrupt checkpoint
+      }
+      std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      std::fwrite(tmp.data(), sizeof(float), rf, f);
       ++count;
     }
   }
@@ -297,7 +421,14 @@ int64_t pskv_load(void* tp, const char* path) {
     if (std::fread(row.data(), sizeof(float), rf, f) != rf) break;
     Shard& sh = t->shard_of(key);
     std::lock_guard<std::mutex> lk(sh.mu);
-    if (sh.rows.emplace(key, row).second) t->size.fetch_add(1);
+    // consistent no-overwrite semantics: an existing live row — resident
+    // in memory OR spilled to disk — keeps its current value
+    if (sh.disk_slot.find(key) == sh.disk_slot.end()) {
+      if (sh.rows.emplace(key, row).second) {
+        t->size.fetch_add(1);
+        t->maybe_evict(sh, key);
+      }
+    }
     ++count;
   }
   std::fclose(f);

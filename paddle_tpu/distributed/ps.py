@@ -24,7 +24,8 @@ _lib_lock = threading.Lock()
 
 OPT_SGD = 0
 OPT_ADAGRAD = 1
-_OPTS = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD}
+OPT_SUM = 2  # delta-merge (GeoSGD accumulator)
+_OPTS = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD, "sum": OPT_SUM}
 
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _f32p = ctypes.POINTER(ctypes.c_float)
@@ -60,6 +61,12 @@ def _load():
         lib.pskv_push.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64,
                                   _f32p]
         lib.pskv_set_lr.argtypes = [ctypes.c_void_p, ctypes.c_float]
+        lib.pskv_table_enable_spill.restype = ctypes.c_int32
+        lib.pskv_table_enable_spill.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p,
+                                                ctypes.c_int64]
+        lib.pskv_table_mem_rows.restype = ctypes.c_int64
+        lib.pskv_table_mem_rows.argtypes = [ctypes.c_void_p]
         lib.pskv_save.restype = ctypes.c_int64
         lib.pskv_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.pskv_load.restype = ctypes.c_int64
@@ -89,10 +96,15 @@ def _keys_arr(keys):
 
 
 class SparseTable:
-    """In-process sparse embedding table (the common_sparse_table analog)."""
+    """In-process sparse embedding table (the common_sparse_table analog).
+
+    `ssd_path` + `max_mem_rows` turn on the disk-spill mode (the
+    `distributed/table/ssd_sparse_table.cc` analog: cold rows live in
+    per-shard stride files on disk, hot rows stay in DRAM; promotion and
+    eviction are transparent to pull/push)."""
 
     def __init__(self, dim, optimizer="sgd", lr=0.01, init_range=0.05,
-                 seed=0):
+                 seed=0, ssd_path=None, max_mem_rows=0):
         self._lib = _load()
         self.dim = dim
         self.optimizer = optimizer
@@ -100,6 +112,20 @@ class SparseTable:
             dim, _OPTS[optimizer], lr, init_range, seed)
         if not self._h:
             raise RuntimeError("table creation failed")
+        if ssd_path is not None:
+            if int(max_mem_rows) <= 0:
+                raise ValueError(
+                    "ssd_path needs max_mem_rows > 0 (the DRAM row budget); "
+                    "a zero budget would thrash every access through disk")
+            os.makedirs(ssd_path, exist_ok=True)
+            rc = self._lib.pskv_table_enable_spill(
+                self._h, ssd_path.encode(), int(max_mem_rows))
+            if rc != 0:
+                raise OSError(f"spill dir not writable: {ssd_path}")
+
+    def mem_rows(self):
+        """Rows currently resident in DRAM (spilled rows excluded)."""
+        return int(self._lib.pskv_table_mem_rows(self._h))
 
     def pull(self, keys):
         k, kp = _keys_arr(keys)
@@ -161,9 +187,14 @@ class PSServer:
 
 class PSClient:
     """Sharded client: key k lives on server hash(k) % len(endpoints)
-    (the reference's table-shard routing, `brpc_ps_client.cc`)."""
+    (the reference's table-shard routing, `brpc_ps_client.cc`).
 
-    def __init__(self, endpoints, dim):
+    `optimizer` declares the REMOTE tables' mode (the wire protocol does
+    not carry it); callers that depend on the mode — GeoCommunicator
+    needs "sum" — must state it here."""
+
+    def __init__(self, endpoints, dim, optimizer=None):
+        self.optimizer = optimizer
         self._lib = _load()
         self.dim = dim
         self._conns = []
@@ -268,3 +299,147 @@ class DistributedEmbedding:
                 self.table.push(uniq, rows_t.grad.numpy())
                 rows_t.grad = None
         self._pending = []
+
+
+class AsyncCommunicator:
+    """Background gradient-push queue.
+
+    Reference: the async `Communicator` (`paddle/fluid/distributed/
+    communicator.h` — per-table send queues drained by send threads so
+    trainers never block on the PS RPC). Here a bounded queue + one
+    drainer thread; `flush()` barriers the queue empty (the analog of
+    Communicator::Stop's final drain)."""
+
+    def __init__(self, table, max_queue=64):
+        import queue as _q
+        self.table = table
+        self._q = _q.Queue(maxsize=max_queue)
+        self._err = None
+        self._stop = False
+        self._lock = threading.Lock()  # orders push() vs stop()'s sentinel
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            keys, grads = item
+            try:
+                self.table.push(keys, grads)
+            except Exception as e:  # surfaced on next push/flush
+                self._err = e
+            self._q.task_done()
+
+    def _check(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def push(self, keys, grads):
+        self._check()
+        item = (np.asarray(keys, np.int64).copy(),
+                np.asarray(grads, np.float32).copy())
+        with self._lock:  # no push can land after stop()'s sentinel
+            if self._stop:
+                raise RuntimeError("communicator stopped")
+            self._q.put(item)
+
+    def flush(self):
+        self._q.join()
+        self._check()
+
+    def stop(self):
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._q.put(None)
+        self._t.join()
+        self._check()
+
+
+class GeoCommunicator:
+    """GeoSGD async-training communicator for DENSE parameters.
+
+    Reference: `fluid/transpiler/geo_sgd_transpiler.py` + the geo mode of
+    the PS `Communicator` — every trainer optimizes locally; every
+    `k_steps` it pushes `(local - last_synced) / n_trainers` parameter
+    deltas to the PS, pulls the merged global value back, and resets its
+    snapshot. The table must be in "sum" (delta-merge) mode.
+
+    Each parameter maps to a contiguous key range of `ceil(size/dim)`
+    rows (flattened, zero-padded); key ranges never overlap because keys
+    are allocated sequentially at registration."""
+
+    def __init__(self, table_or_client, parameters, k_steps=10, trainers=1,
+                 is_chief=True):
+        if getattr(table_or_client, "optimizer", None) != "sum":
+            raise ValueError(
+                "GeoCommunicator needs a 'sum'-mode table; for a PSClient "
+                "pass optimizer='sum' to declare the remote table's mode")
+        self.table = table_or_client
+        self.dim = table_or_client.dim
+        self.k_steps = int(k_steps)
+        self.trainers = int(trainers)
+        self._step = 0
+        self._params = []          # (param, keys, n_rows, pad_size)
+        next_key = 0
+        for p in parameters:
+            size = int(np.prod(p.shape)) if p.shape else 1
+            n_rows = -(-size // self.dim)
+            keys = np.arange(next_key, next_key + n_rows, dtype=np.int64)
+            next_key += n_rows
+            self._params.append((p, keys, n_rows, n_rows * self.dim - size))
+        self._snapshots = {}
+        if is_chief:
+            self.init_params()
+        else:
+            self.pull_params()
+
+    def _rows_of(self, arr, n_rows, pad):
+        flat = np.asarray(arr, np.float32).ravel()
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        return flat.reshape(n_rows, self.dim)
+
+    def init_params(self):
+        """CHIEF-ONLY: seed the table with this trainer's initial values
+        (pull-then-push set; a sum-mode row starts at its random init, so
+        the pushed delta lands the row exactly on `want`). Exactly one
+        trainer may do this, before the others construct with
+        is_chief=False — the reference serializes startup the same way
+        (trainer 0 broadcasts startup params, the rest wait)."""
+        for p, keys, n_rows, pad in self._params:
+            cur = self.table.pull(keys)
+            want = self._rows_of(p.numpy(), n_rows, pad)
+            self.table.push(keys, want - cur)     # set = delta from current
+            self._snapshots[id(p)] = p.numpy().copy()
+
+    def pull_params(self):
+        """NON-CHIEF: adopt the chief-seeded global values as the local
+        start + snapshot."""
+        for p, keys, n_rows, pad in self._params:
+            merged = self.table.pull(keys).ravel()[:int(np.prod(p.shape))]
+            merged = merged.reshape(p.numpy().shape)
+            p.set_value(merged)
+            self._snapshots[id(p)] = merged.copy()
+
+    def step(self):
+        """Call once per local optimizer step; syncs every k_steps."""
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self.sync()
+
+    def sync(self):
+        for p, keys, n_rows, pad in self._params:
+            local = p.numpy()
+            snap = self._snapshots[id(p)]
+            delta = (local - snap) / float(self.trainers)
+            self.table.push(keys, self._rows_of(delta, n_rows, pad))
+            merged = self.table.pull(keys).ravel()[:local.size]
+            merged = merged.reshape(local.shape)
+            p.set_value(merged)
+            self._snapshots[id(p)] = merged.copy()
