@@ -63,6 +63,12 @@ _register(
     "Sequence length at which attention dispatch switches from the composed "
     "XLA path to the Pallas blockwise kernel (measured crossover on v5e).")
 _register(
+    "use_fused_ce", False, bool,
+    "Use the chunked fused projection+cross-entropy for LM losses "
+    "(ops/fused_ce.py): the full-vocab logits tensor is never "
+    "materialized; backward recomputes chunk logits (flash-style). "
+    "Off falls back to logits + F.cross_entropy.")
+_register(
     "use_pallas_attention", True, bool,
     "Master switch for the Pallas flash-attention kernel; off forces the "
     "composed XLA attention everywhere.")

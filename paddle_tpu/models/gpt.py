@@ -336,11 +336,37 @@ class GPTForPretraining(Layer):
             pad_token_id=pad_token_id, seed=seed)
 
     def loss(self, input_ids, labels, loss_mask=None):
-        logits = self(input_ids)
-        vocab = logits.shape[-1]
-        flat_logits = reshape(logits, [-1, vocab])
-        flat_labels = reshape(labels, [-1])
-        losses = F.cross_entropy(flat_logits, flat_labels, reduction="none")
+        from ..flags import get_flag
+        if get_flag("use_fused_ce"):
+            # fused head+CE: the [B*S, V] logits tensor never exists —
+            # measured ~16 GB/step of vocab-tensor HBM traffic on the
+            # 125M bench collapses to chunk-sized working sets
+            from ..ops.fused_ce import fused_linear_cross_entropy
+            h = self.gpt(input_ids)
+            w = self.gpt.wte.weight
+            d = h.shape[-1]
+            lbl = labels._value if isinstance(labels, Tensor) else \
+                jnp.asarray(np.asarray(labels))
+            flat_lbl = lbl.reshape(-1)
+
+            from ..amp import maybe_cast_to_compute as _amp
+
+            def fn(hh, ww):
+                # same AMP policy as forward()'s head: the chunk dots must
+                # run bf16 on the MXU; w stays full precision (the op
+                # casts per chunk and returns f32-accumulated dW)
+                hh = _amp(hh, "matmul")
+                return fused_linear_cross_entropy(
+                    hh.reshape(-1, d), ww, flat_lbl)
+
+            losses = apply(fn, h, w)
+        else:
+            logits = self(input_ids)
+            vocab = logits.shape[-1]
+            flat_logits = reshape(logits, [-1, vocab])
+            flat_labels = reshape(labels, [-1])
+            losses = F.cross_entropy(flat_logits, flat_labels,
+                                     reduction="none")
         if loss_mask is not None:
             m = reshape(loss_mask, [-1])
             return (losses * m).sum() / m.sum()

@@ -210,3 +210,24 @@ def test_fleet_util_surface():
     assert fleet.util.all_gather(3.5) == [3.5]
     assert fleet.utils.LocalFS is LocalFS
     fleet.util.print_on_rank("hello", 0)
+
+
+# ----------------------------------------------------------------- cost model
+def test_cost_model_measures_and_profiles():
+    import jax.numpy as jnp
+    from paddle_tpu.cost_model import ProgramCostModel
+
+    def f(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 32), jnp.float32)
+    cm = ProgramCostModel()
+    r = cm.profile_measure(f, (a, b), warmup=1, repeat=2)
+    assert r["flops"] >= 2 * 64 * 128 * 32 * 0.9  # matmul dominates
+    assert r["time_s"] > 0
+    static = cm.static_cost(f, (a, b))
+    assert static["flops"] == r["flops"]
+    prof = cm.instruction_profile(f, (a, b))
+    assert prof["n_instructions"] > 0
+    assert all(row["count"] > 0 for row in prof["by_op"])
