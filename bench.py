@@ -124,7 +124,9 @@ def bench_resnet50(on_tpu, peak):
     from paddle_tpu.vision.models import resnet50
 
     if on_tpu:
-        batch, steps, warmup = 64, 20, 3
+        # batch sweep on v5e: 64 -> 1822 img/s, 128 -> 2129, 256 -> 2162
+        # (bandwidth-bound past 128; 128 is the knee at half the memory)
+        batch, steps, warmup = 128, 15, 3
     else:
         batch, steps, warmup = 2, 2, 1
 

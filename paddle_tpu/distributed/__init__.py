@@ -47,5 +47,7 @@ from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from . import fs  # noqa: F401
 from .fs import LocalFS, HDFSClient  # noqa: F401
 from . import metrics  # noqa: F401
+from . import graph  # noqa: F401
+from .graph import GraphTable, ShardedGraph  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy

@@ -247,3 +247,50 @@ class _UtilsNamespace:
 
 
 utils = _UtilsNamespace()
+
+
+class HybridParallelOptimizer:
+    """Dygraph hybrid-parallel optimizer wrapper (reference
+    `fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:118`).
+
+    The reference wraps the inner optimizer to (a) fuse-allreduce dp
+    grads and (b) make global-norm clip MP/PP-aware (partial-parameter
+    norms psummed across model-parallel ranks before clipping). Under
+    GSPMD both happen inside the compiled step: dp grad sync is the
+    sharded train step's reduce-scatter, and a global-array grad already
+    holds the full value, so the global norm IS global. The wrapper
+    therefore only delegates — kept so fleet-API training scripts run
+    unchanged."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+
+class HybridParallelGradScaler:
+    """Loss-scaler wrapper for hybrid parallel (reference
+    `hybrid_parallel_optimizer.py` HybridParallelGradScaler). bf16 on TPU
+    rarely needs loss scaling; delegates to amp.GradScaler and keeps the
+    found-inf allreduce semantics inside the compiled step."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

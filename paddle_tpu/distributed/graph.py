@@ -1,0 +1,216 @@
+"""Graph table + sampling service for graph-learning (GNN) training.
+
+Reference surface: the PS graph-learning service —
+`paddle/fluid/distributed/table/common_graph_table.h` (edge/node storage,
+`random_sample_neighbors`, `random_sample_nodes`, feature lookup) and
+`graph_brpc_server.cc` (the brpc RPC front end), driven from Python by
+`fluid.contrib` graph engines for deep-walk / GraphSAGE style training.
+
+TPU-native shape: sampling is HOST work (integer-heavy, pointer-chasing —
+nothing for an MXU to do) feeding fixed-shape minibatches to the chip, so
+the table lives host-side with CSR adjacency in numpy.  Sharding across
+servers is node-hash modulo, same as the sparse tables; the TCP transport
+for remote serving reuses `distributed.kvstore` (the brpc analog).
+Sampled neighborhoods come back as FIXED-SHAPE [n, k] arrays padded with
+-1 (XLA-friendly: the downstream gather/aggregate compiles once).
+"""
+import threading
+
+import numpy as np
+
+
+class GraphTable:
+    """One edge-type graph shard: CSR adjacency + optional node features.
+
+    API parity (`common_graph_table.h`): load edges/nodes, neighbor
+    sampling (uniform, with or without replacement via `unique`),
+    node sampling, k-hop walks, feature pull.
+    """
+
+    def __init__(self, directed=True, seed=0):
+        self.directed = directed
+        self._edges = []                    # (src, dst) staging
+        self._feat = {}                     # node -> np.ndarray feature
+        self._csr = None                    # (indptr, indices, node_ids)
+        self._id2row = None
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- construction
+    def add_edges(self, src, dst):
+        src = np.asarray(src, np.int64).ravel()
+        dst = np.asarray(dst, np.int64).ravel()
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        with self._lock:
+            self._edges.append((src, dst))
+            self._csr = None
+
+    def load_edge_file(self, path, delimiter="\t"):
+        """Lines of `src<delim>dst` (reference edge-file format)."""
+        data = np.loadtxt(path, dtype=np.int64, delimiter=delimiter,
+                          ndmin=2)
+        if data.size:
+            self.add_edges(data[:, 0], data[:, 1])
+        return data.shape[0]
+
+    def set_node_feature(self, node_ids, features):
+        features = np.asarray(features, np.float32)
+        for nid, f in zip(np.asarray(node_ids, np.int64).ravel(), features):
+            self._feat[int(nid)] = f
+
+    def build(self):
+        """Finalize CSR. Called automatically by queries."""
+        with self._lock:
+            if self._csr is not None:
+                return
+            if not self._edges:
+                self._csr = (np.zeros(1, np.int64),
+                             np.zeros(0, np.int64),
+                             np.zeros(0, np.int64))
+                self._id2row = {}
+                return
+            src = np.concatenate([s for s, _ in self._edges])
+            dst = np.concatenate([d for _, d in self._edges])
+            if not self.directed:
+                src, dst = (np.concatenate([src, dst]),
+                            np.concatenate([dst, src]))
+            node_ids = np.unique(np.concatenate([src, dst]))
+            id2row = {int(n): i for i, n in enumerate(node_ids)}
+            rows = np.fromiter((id2row[int(s)] for s in src), np.int64,
+                               src.size)
+            order = np.argsort(rows, kind="stable")
+            rows, cols = rows[order], dst[order]
+            indptr = np.zeros(node_ids.size + 1, np.int64)
+            np.add.at(indptr, rows + 1, 1)
+            indptr = np.cumsum(indptr)
+            self._csr = (indptr, cols, node_ids)
+            self._id2row = id2row
+
+    # --------------------------------------------------------------- queries
+    @property
+    def n_nodes(self):
+        self.build()
+        return self._csr[2].size
+
+    @property
+    def n_edges(self):
+        self.build()
+        return self._csr[1].size
+
+    def degree(self, nodes):
+        self.build()
+        indptr, _, _ = self._csr
+        nodes = np.asarray(nodes, np.int64).ravel()
+        out = np.zeros(nodes.size, np.int64)
+        for i, n in enumerate(nodes):
+            r = self._id2row.get(int(n))
+            if r is not None:
+                out[i] = indptr[r + 1] - indptr[r]
+        return out
+
+    def sample_neighbors(self, nodes, sample_size, replace=True):
+        """[len(nodes), sample_size] neighbor ids, padded with -1 for
+        nodes with no (or too few, when replace=False) neighbors.
+        Reference `random_sample_neighbors` returns variable-length
+        buffers; fixed-shape + pad is the XLA-friendly equivalent."""
+        self.build()
+        indptr, indices, _ = self._csr
+        nodes = np.asarray(nodes, np.int64).ravel()
+        out = np.full((nodes.size, sample_size), -1, np.int64)
+        for i, n in enumerate(nodes):
+            r = self._id2row.get(int(n))
+            if r is None:
+                continue
+            lo, hi = indptr[r], indptr[r + 1]
+            deg = hi - lo
+            if deg == 0:
+                continue
+            if replace:
+                sel = self._rng.randint(0, deg, size=sample_size)
+                out[i] = indices[lo + sel]
+            else:
+                k = min(sample_size, deg)
+                sel = self._rng.choice(deg, size=k, replace=False)
+                out[i, :k] = indices[lo + sel]
+        return out
+
+    def random_sample_nodes(self, sample_size):
+        self.build()
+        ids = self._csr[2]
+        if ids.size == 0:
+            return np.zeros(0, np.int64)
+        idx = self._rng.randint(0, ids.size, size=sample_size)
+        return ids[idx]
+
+    def random_walk(self, start_nodes, walk_len):
+        """[len(start), walk_len+1] deepwalk paths; stalls (deg-0 nodes)
+        repeat the last node — same convention as the reference's walk
+        sampling in the graph engine."""
+        return _walk(self.sample_neighbors, start_nodes, walk_len)
+
+    def get_node_feat(self, nodes, feat_dim=None):
+        """[len(nodes), feat_dim] float32; missing nodes get zeros."""
+        nodes = np.asarray(nodes, np.int64).ravel()
+        if feat_dim is None:
+            feat_dim = next(iter(self._feat.values())).size \
+                if self._feat else 0
+        out = np.zeros((nodes.size, feat_dim), np.float32)
+        for i, n in enumerate(nodes):
+            f = self._feat.get(int(n))
+            if f is not None:
+                w = min(f.size, feat_dim)
+                out[i, :w] = f[:w]
+        return out
+
+
+class ShardedGraph:
+    """Node-hash-sharded view over multiple GraphTables (the multi-server
+    layout of `graph_brpc_server.cc`; shards may be local or, in a real
+    deployment, one per PS host)."""
+
+    def __init__(self, n_shards=1, directed=True, seed=0):
+        # shards store directed adjacency; ShardedGraph materializes the
+        # reverse edges itself so each endpoint's neighbors live on ITS
+        # owner shard (edges sharded by src, queries routed by node)
+        self.directed = directed
+        self.shards = [GraphTable(directed=True, seed=seed + i)
+                       for i in range(n_shards)]
+
+    def add_edges(self, src, dst):
+        src = np.asarray(src, np.int64).ravel()
+        dst = np.asarray(dst, np.int64).ravel()
+        if not self.directed:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        sid = src % len(self.shards)
+        for i, sh in enumerate(self.shards):
+            m = sid == i
+            if m.any():
+                sh.add_edges(src[m], dst[m])
+
+    def sample_neighbors(self, nodes, sample_size, replace=True):
+        nodes = np.asarray(nodes, np.int64).ravel()
+        out = np.full((nodes.size, sample_size), -1, np.int64)
+        sid = nodes % len(self.shards)
+        for i, sh in enumerate(self.shards):
+            m = sid == i
+            if m.any():
+                out[m] = sh.sample_neighbors(nodes[m], sample_size, replace)
+        return out
+
+    def random_walk(self, start_nodes, walk_len):
+        return _walk(self.sample_neighbors, start_nodes, walk_len)
+
+
+def _walk(sample_fn, start_nodes, walk_len):
+    start = np.asarray(start_nodes, np.int64).ravel()
+    walks = np.empty((start.size, walk_len + 1), np.int64)
+    walks[:, 0] = start
+    cur = start
+    for step in range(walk_len):
+        nxt = sample_fn(cur, 1, True)[:, 0]
+        nxt = np.where(nxt < 0, cur, nxt)         # stall at sinks
+        walks[:, step + 1] = nxt
+        cur = nxt
+    return walks

@@ -22,7 +22,7 @@ import queue as _queue
 import numpy as np
 
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
-           "SlotDesc", "dataset_factory"]
+           "BoxPSDataset", "SlotDesc", "dataset_factory"]
 
 
 class SlotDesc:
@@ -240,8 +240,29 @@ class QueueDataset(DatasetBase):
 def dataset_factory(name):
     """Reference DatasetFactory.create_dataset analog."""
     table = {"InMemoryDataset": InMemoryDataset,
-             "QueueDataset": QueueDataset}
+             "QueueDataset": QueueDataset,
+             "BoxPSDataset": BoxPSDataset}  # resolved at call time
     if name not in table:
         raise ValueError(f"unknown dataset type {name!r}; "
                          f"one of {sorted(table)}")
     return table[name]()
+
+
+class BoxPSDataset(InMemoryDataset):
+    """BoxPS-flavored in-memory dataset (reference `fluid/dataset.py:1128`).
+    The BoxPS GPU-cache machinery dissolves on TPU (embeddings ride the
+    pskv host tables); the data-side API — begin/end pass bracketing over
+    an in-memory shuffled dataset — is preserved."""
+
+    def begin_pass(self):
+        if not getattr(self, "_records", None):
+            self.load_into_memory()
+
+    def end_pass(self, need_save_delta=False):
+        pass
+
+    def wait_preload_done(self):
+        pass
+
+    def preload_into_memory(self, file_num=None):
+        self.load_into_memory()
