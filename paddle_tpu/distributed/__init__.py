@@ -49,5 +49,7 @@ from .fs import LocalFS, HDFSClient  # noqa: F401
 from . import metrics  # noqa: F401
 from . import graph  # noqa: F401
 from .graph import GraphTable, ShardedGraph  # noqa: F401
+from . import heter  # noqa: F401
+from .heter import HeterClient, HeterServer  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy

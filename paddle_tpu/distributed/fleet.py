@@ -268,6 +268,8 @@ class HybridParallelOptimizer:
         self._strategy = strategy
 
     def __getattr__(self, item):
+        if item == "_inner_opt":      # unpickling probes before __init__
+            raise AttributeError(item)
         return getattr(self._inner_opt, item)
 
     def step(self):
@@ -293,4 +295,6 @@ class HybridParallelGradScaler:
         self._hcg = hcg
 
     def __getattr__(self, item):
+        if item == "_scaler":
+            raise AttributeError(item)
         return getattr(self._scaler, item)

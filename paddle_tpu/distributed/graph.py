@@ -77,8 +77,8 @@ class GraphTable:
                             np.concatenate([dst, src]))
             node_ids = np.unique(np.concatenate([src, dst]))
             id2row = {int(n): i for i, n in enumerate(node_ids)}
-            rows = np.fromiter((id2row[int(s)] for s in src), np.int64,
-                               src.size)
+            # node_ids is sorted (np.unique) -> vectorized row mapping
+            rows = np.searchsorted(node_ids, src)
             order = np.argsort(rows, kind="stable")
             rows, cols = rows[order], dst[order]
             indptr = np.zeros(node_ids.size + 1, np.int64)
@@ -100,14 +100,13 @@ class GraphTable:
 
     def degree(self, nodes):
         self.build()
-        indptr, _, _ = self._csr
+        indptr, _, node_ids = self._csr
         nodes = np.asarray(nodes, np.int64).ravel()
-        out = np.zeros(nodes.size, np.int64)
-        for i, n in enumerate(nodes):
-            r = self._id2row.get(int(n))
-            if r is not None:
-                out[i] = indptr[r + 1] - indptr[r]
-        return out
+        if node_ids.size == 0:
+            return np.zeros(nodes.size, np.int64)
+        r = np.searchsorted(node_ids, nodes).clip(0, node_ids.size - 1)
+        known = node_ids[r] == nodes
+        return np.where(known, indptr[r + 1] - indptr[r], 0)
 
     def sample_neighbors(self, nodes, sample_size, replace=True):
         """[len(nodes), sample_size] neighbor ids, padded with -1 for

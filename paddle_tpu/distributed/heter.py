@@ -1,0 +1,151 @@
+"""Heterogeneous PS: offload compute stages to remote worker processes.
+
+Reference surface: the heter parameter-server —
+`paddle/fluid/distributed/service/heter_client.cc` / `heter_server.cc`
+and `operators/pscore/heter_listen_and_serv_op.cc`: CPU trainers run the
+embedding/sparse half of the model and RPC the dense/GPU half (a named
+sub-program) to heter workers, which run it and send results back.
+
+TPU-native shape: the split-program machinery dissolves — a TPU trainer
+runs the whole dense model in one compiled program — but the
+capability (ship a named stage's tensors to a remote worker pool, run a
+registered function there, get tensors back) is still useful for
+CPU-heavy stages (data augmentation, sampling, eval scoring).  The
+transport rides the C++ TCP KV store (`csrc/kvstore.cc`), polling
+task/result keys — the brpc-queue analog with at-most-one worker per
+task guaranteed by an atomic claim counter.
+"""
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from .kvstore import KVServer, KVClient
+
+
+class HeterServer:
+    """Worker pool endpoint: registers named stage functions and serves
+    them (reference `heter_server.cc` RegisterServiceHandler)."""
+
+    def __init__(self, host="127.0.0.1", port=0, kv=None):
+        self._own = kv is None
+        if kv is None:
+            self._server = KVServer(port)
+            self.port = self._server.port
+            kv = KVClient(host, self.port)
+        else:
+            self._server = None
+            self.port = kv.port or port
+        self._kv = kv
+        self._handlers = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def register(self, name, fn):
+        """fn: dict[str, np.ndarray] -> dict[str, np.ndarray]"""
+        self._handlers[name] = fn
+
+    def start(self, poll_s=0.01):
+        self._thread = threading.Thread(target=self._serve, args=(poll_s,),
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self, poll_s):
+        while not self._stop.is_set():
+            served = False
+            for name in list(self._handlers):
+                # per-task claim keys: the first worker whose atomic
+                # add(claim/<tid>) returns 1 owns that task, so a lost
+                # claim race can never orphan a FUTURE tid (the bug a
+                # single shared claim counter has: the loser's increment
+                # pre-claims the next, not-yet-submitted task)
+                head = self._kv.add(f"__heter__/{name}/head", 0)
+                floor = self._kv.add(f"__heter__/{name}/done", 0)
+                for tid in range(floor + 1, head + 1):
+                    if self._kv.add(f"__heter__/{name}/claim/{tid}", 1) == 1:
+                        self._run_one(name, tid)
+                        self._kv.add(f"__heter__/{name}/done", 1)
+                        served = True
+            if not served:
+                time.sleep(poll_s)
+
+    def _run_one(self, name, tid):
+        key = f"__heter__/{name}/task/{tid}"
+        # submit bumps the head counter BEFORE the task blob is visible;
+        # a fast claimer must wait for the payload, not drop the task
+        deadline = time.monotonic() + 5.0
+        blob = self._kv.get(key)
+        while blob is None and time.monotonic() < deadline:
+            time.sleep(0.002)
+            blob = self._kv.get(key)
+        if blob is None:
+            self._kv.delete(key)      # drop a late-arriving payload too
+            self._kv.set(f"__heter__/{name}/result/{tid}", pickle.dumps(
+                {"ok": False, "error": "task payload never arrived"},
+                protocol=4))
+            return
+        try:
+            inputs = pickle.loads(blob)
+            outputs = self._handlers[name](inputs)
+            payload = pickle.dumps(
+                {"ok": True, "outputs": outputs}, protocol=4)
+        except Exception as e:  # ship the error back, don't kill the pool
+            payload = pickle.dumps(
+                {"ok": False, "error": f"{type(e).__name__}: {e}"},
+                protocol=4)
+        self._kv.set(f"__heter__/{name}/result/{tid}", payload)
+        self._kv.delete(key)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._own and self._server is not None:
+            self._server.stop()
+
+
+class HeterClient:
+    """Trainer-side handle (reference `heter_client.cc` SendAndRecvAsync):
+    `call(stage, tensors)` ships numpy tensors to the worker pool and
+    blocks for the stage's outputs; `submit`/`wait` is the async form."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._kv = KVClient(host, port)
+
+    def submit(self, name, inputs):
+        blob = pickle.dumps(
+            {k: np.asarray(v) for k, v in inputs.items()}, protocol=4)
+        tid = self._kv.add(f"__heter__/{name}/head", 1)
+        self._kv.set(f"__heter__/{name}/task/{tid}", blob)
+        return (name, tid)
+
+    def wait(self, handle, timeout_s=30.0, poll_s=0.005):
+        name, tid = handle
+        key = f"__heter__/{name}/result/{tid}"
+        deadline = time.monotonic() + timeout_s
+        while True:
+            blob = self._kv.get(key)
+            if blob is not None:
+                self._kv.delete(key)
+                result = pickle.loads(blob)
+                if not result["ok"]:
+                    raise RuntimeError(
+                        f"heter stage {name!r} failed remotely: "
+                        f"{result['error']}")
+                return result["outputs"]
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"heter stage {name!r} task {tid}")
+            time.sleep(poll_s)
+
+    def call(self, name, inputs, timeout_s=30.0):
+        return self.wait(self.submit(name, inputs), timeout_s)
+
+    def purge(self, name):
+        """Delete every key of a stage (abandoned results after client
+        timeouts, claim markers, stale tasks). Call between jobs — the
+        store otherwise grows one small claim key per completed task and
+        one result blob per abandoned one."""
+        for key in self._kv.list(f"__heter__/{name}/"):
+            self._kv.delete(key)

@@ -94,6 +94,7 @@ class KVClient:
 
     def __init__(self, host="127.0.0.1", port=0, timeout_s=30.0,
                  retry_s=10.0):
+        self.host, self.port = host, port
         lib = _load()
         deadline = time.monotonic() + retry_s
         self._h = None

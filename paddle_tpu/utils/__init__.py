@@ -1,2 +1,61 @@
 """paddle_tpu.utils — mirrors `python/paddle/utils/`."""
 from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference
+    `python/paddle/utils/deprecated.py`): warns once per call site."""
+    import functools
+    import warnings
+
+    def decorate(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+        if level == 2:
+            @functools.wraps(fn)
+            def dead(*a, **k):
+                raise RuntimeError(msg)
+            return dead
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return wrapper
+    return decorate
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency with a clear install hint (reference
+    `python/paddle/utils/lazy_import.py` try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required for this "
+            "feature but is not installed (installs are disabled in this "
+            "environment; gate the caller instead)")
+
+
+def run_check():
+    """Install sanity check (reference `paddle.utils.install_check
+    .run_check`): run a tiny compiled computation on the default backend
+    and report."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    out = jax.jit(lambda a, b: (a @ b).sum())(
+        jnp.ones((8, 8), jnp.float32), jnp.ones((8, 8), jnp.float32))
+    np.testing.assert_allclose(float(out), 512.0)
+    n = jax.device_count()
+    backend = jax.default_backend()
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={backend}, {n} device(s) visible.")
+    return True

@@ -1,0 +1,43 @@
+"""Unique-name generator (reference `python/paddle/utils/unique_name.py`,
+backing `fluid/unique_name.py`): thread-shared counter per prefix, with
+`guard` providing a fresh namespace for program-building blocks."""
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+        self.lock = threading.Lock()
+
+    def __call__(self, key):
+        with self.lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Install (or reset) the namespace; returns the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

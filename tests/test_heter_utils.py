@@ -1,0 +1,98 @@
+"""Heter PS worker pool (reference heter_client/server.cc) and
+paddle.utils parity (unique_name / deprecated / try_import / run_check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.heter import HeterServer, HeterClient
+
+
+def test_heter_roundtrip_and_async():
+    srv = HeterServer(port=0)
+    srv.register("dense", lambda t: {"y": t["x"] * 2 + 1})
+    srv.start()
+    try:
+        cli = HeterClient(port=srv.port)
+        out = cli.call("dense", {"x": np.arange(6, dtype=np.float32)})
+        np.testing.assert_allclose(out["y"], np.arange(6) * 2 + 1)
+        # async pipeline: several in flight
+        handles = [cli.submit("dense", {"x": np.full(4, i, np.float32)})
+                   for i in range(5)]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(cli.wait(h)["y"], i * 2 + 1)
+    finally:
+        srv.stop()
+
+
+def test_heter_remote_error_propagates():
+    srv = HeterServer(port=0)
+    def boom(t):
+        raise ValueError("stage exploded")
+    srv.register("bad", boom)
+    srv.start()
+    try:
+        cli = HeterClient(port=srv.port)
+        with pytest.raises(RuntimeError, match="stage exploded"):
+            cli.call("bad", {"x": np.zeros(1)})
+        # pool survives the failure
+        srv.register("ok", lambda t: {"y": t["x"]})
+        np.testing.assert_allclose(
+            cli.call("ok", {"x": np.ones(2)})["y"], 1.0)
+    finally:
+        srv.stop()
+
+
+def test_heter_two_workers_share_queue():
+    srv1 = HeterServer(port=0)
+    srv1.register("sq", lambda t: {"y": t["x"] ** 2})
+    srv1.start()
+    # second worker joins the same store
+    from paddle_tpu.distributed.kvstore import KVClient
+    kv2 = KVClient(port=srv1.port)
+    srv2 = HeterServer(kv=kv2)
+    srv2.register("sq", lambda t: {"y": t["x"] ** 2})
+    srv2.start()
+    try:
+        cli = HeterClient(port=srv1.port)
+        handles = [cli.submit("sq", {"x": np.full(2, i, np.float32)})
+                   for i in range(12)]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(cli.wait(h)["y"], i * i)
+    finally:
+        srv2.stop()
+        srv1.stop()
+
+
+def test_unique_name_guard():
+    un = paddle.utils.unique_name
+    a = un.generate("w")
+    b = un.generate("w")
+    assert a != b
+    with un.guard():
+        inner = un.generate("w")
+    assert inner.endswith("_0")
+
+
+def test_deprecated_warns_and_dead_level():
+    @paddle.utils.deprecated(update_to="new_api", since="2.0")
+    def old():
+        return 42
+
+    with pytest.warns(DeprecationWarning, match="new_api"):
+        assert old() == 42
+
+    @paddle.utils.deprecated(level=2)
+    def gone():
+        return 0
+
+    with pytest.raises(RuntimeError):
+        gone()
+
+
+def test_try_import_and_run_check(capsys):
+    import numpy as real_np
+    assert paddle.utils.try_import("numpy") is real_np
+    with pytest.raises(ImportError, match="not installed"):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+    assert paddle.utils.run_check() is True
+    assert "installed successfully" in capsys.readouterr().out
