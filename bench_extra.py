@@ -118,13 +118,21 @@ def bench_long_context():
 
         o = multi(q)
         float(jnp.sum(o.astype(jnp.float32)).item())
-        t0 = time.perf_counter()
-        float(jnp.sum(o.astype(jnp.float32)).item())
-        fetch = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        o = multi(q)
-        float(jnp.sum(o.astype(jnp.float32)).item())
-        dt = max(1e-9, time.perf_counter() - t0 - fetch) / reps
+
+        def run(k):
+            nonlocal o
+            t0 = time.perf_counter()
+            for _ in range(k):
+                o = multi(o)
+            float(jnp.sum(o.astype(jnp.float32)).item())
+            return time.perf_counter() - t0
+        # two-point measurement: t(3K) - t(K) cancels the constant
+        # dispatch+fetch overhead of the tunnel, which otherwise swamps
+        # the short-sequence timings
+        K = 4
+        t1 = run(K)
+        t2 = run(3 * K)
+        dt = max(1e-9, (t2 - t1) / (2 * K * reps))
         # causal attention train flops ~ 3x fwd; fwd = 2*2*B*H*S^2*D/2
         flops = 3 * 2 * B * H * S * S * D
         rows.append({"seq": S, "ms": round(dt * 1000, 1),
@@ -133,9 +141,49 @@ def bench_long_context():
             "value": rows[-1]["ms"], "unit": "ms@16k", "rows": rows}
 
 
+def bench_ocr():
+    """PP-OCRv2-style CRNN recognizer train step (BASELINE capability
+    config: OCR) — images/sec through conv backbone + BiLSTM + CTC."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.ocr import CRNN
+
+    on_tpu = __import__("jax").default_backend() == "tpu"
+    batch, steps, warmup = (64, 15, 3) if on_tpu else (2, 2, 1)
+    paddle.seed(0)
+    model = CRNN(num_classes=37)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rs.randn(batch, 3, 32, 100).astype(np.float32))
+    labels = paddle.to_tensor(rs.randint(1, 37, (batch, 12)), "int32")
+    lens = paddle.to_tensor(np.full((batch,), 12, np.int32))
+
+    def loss_fn(x, y, yl):
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return model.loss(x, y, yl)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    for _ in range(warmup):
+        loss = step(imgs, labels, lens)
+    float(loss.item())
+    t0 = time.perf_counter()
+    float(loss.item())
+    fetch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(imgs, labels, lens)
+    float(loss.item())
+    dt = max(1e-9, (time.perf_counter() - t0 - fetch) / steps)
+    return {"metric": "crnn_ocr_train_images_per_sec", "unit": "img/s",
+            "value": round(batch / dt, 1),
+            "step_ms": round(dt * 1000, 2)}
+
+
 def main():
     wrapped = None
-    for fn in (bench_decode, bench_bert, bench_long_context):
+    for fn in (bench_decode, bench_bert, bench_long_context, bench_ocr):
         try:
             print(json.dumps(fn()))
         except Exception as e:  # keep later phases running

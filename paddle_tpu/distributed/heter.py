@@ -81,10 +81,12 @@ class HeterServer:
             time.sleep(0.002)
             blob = self._kv.get(key)
         if blob is None:
-            self._kv.delete(key)      # drop a late-arriving payload too
+            # a payload landing after this point stays in the store until
+            # HeterClient.purge(); the failure result tells the client
             self._kv.set(f"__heter__/{name}/result/{tid}", pickle.dumps(
                 {"ok": False, "error": "task payload never arrived"},
                 protocol=4))
+            self._kv.delete(key)
             return
         try:
             inputs = pickle.loads(blob)

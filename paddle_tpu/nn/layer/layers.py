@@ -16,12 +16,11 @@ from ...core.tensor import Tensor, Parameter
 from ...core.dtype import convert_dtype, get_default_dtype
 from ..initializer import Initializer, Constant, XavierUniform
 
-_name_counters = collections.defaultdict(int)
-
-
 def _unique_name(prefix):
-    _name_counters[prefix] += 1
-    return f"{prefix}_{_name_counters[prefix] - 1}"
+    # routed through paddle.utils.unique_name so `unique_name.guard()`
+    # scopes layer/parameter names exactly like the reference
+    from ...utils import unique_name as _un
+    return _un.generate(prefix)
 
 
 class ParamAttr:

@@ -51,7 +51,9 @@ def _lstm_step(x, h, c, wih, whh, bih, bhh, hidden_size):
     g = jnp.tanh(g)
     c_new = f * c + i * g
     h_new = o * jnp.tanh(c_new)
-    return h_new, c_new
+    # cast back to the carry dtype: under AMP, bf16 x against f32 weights
+    # promotes the gates to f32, and a scan carry must keep its dtype
+    return h_new.astype(h.dtype), c_new.astype(c.dtype)
 
 
 def _gru_step(x, h, wih, whh, bih, bhh, hidden_size):
@@ -62,12 +64,12 @@ def _gru_step(x, h, wih, whh, bih, bhh, hidden_size):
     r = jax.nn.sigmoid(xr + hr)
     z = jax.nn.sigmoid(xz + hz)
     n = jnp.tanh(xn + r * hn)
-    return (1.0 - z) * n + z * h
+    return ((1.0 - z) * n + z * h).astype(h.dtype)
 
 
 def _simple_step(x, h, wih, whh, bih, bhh, hidden_size, activation="tanh"):
     act = jnp.tanh if activation == "tanh" else jax.nn.relu
-    return act(x @ wih.T + bih + h @ whh.T + bhh)
+    return act(x @ wih.T + bih + h @ whh.T + bhh).astype(h.dtype)
 
 
 class SimpleRNNCell(RNNCellBase):
@@ -252,7 +254,10 @@ class _RNNBase(Layer):
                 init_vals = [ensure_tensor(initial_states)]
 
         def fn(x, *args):
-            ws = args[:len(flat_w)]
+            from ...amp import maybe_cast_to_compute as _ampc
+            # AMP: run the recurrent matmuls in the compute dtype (the
+            # cudnn-fp16-LSTM analog); carries then stay bf16 end to end
+            ws = [_ampc(w, "matmul") for w in args[:len(flat_w)]]
             inits = args[len(flat_w):]
             if not tm:
                 x = jnp.swapaxes(x, 0, 1)  # -> [T, B, F]

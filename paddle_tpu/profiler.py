@@ -17,7 +17,7 @@ import jax
 
 _state = threading.local()
 _GLOBAL = {"enabled": False, "events": defaultdict(lambda: [0, 0.0]),
-           "lock": threading.Lock(), "trace_dir": None}
+           "lock": threading.Lock(), "trace_dir": None, "spans": []}
 
 
 class ProfilerTarget:
@@ -41,15 +41,20 @@ class RecordEvent:
     start = begin
 
     def end(self):
-        if self._t0 is None:
+        t0 = self._t0
+        if t0 is None:
             return
-        dt = time.perf_counter() - self._t0
+        dt = time.perf_counter() - t0
         self._t0 = None
         if _GLOBAL["enabled"]:
             with _GLOBAL["lock"]:
                 rec = _GLOBAL["events"][self.name]
                 rec[0] += 1
                 rec[1] += dt
+                # individual spans feed export_chrome_tracing / the
+                # multi-rank merge (CrossStackProfiler analog)
+                _GLOBAL["spans"].append(
+                    (self.name, t0, dt, threading.get_ident()))
 
     stop = end
 
@@ -86,6 +91,7 @@ def start_profiler(trace_dir=None, targets=None):
     trace viewable in TensorBoard."""
     _GLOBAL["enabled"] = True
     _GLOBAL["events"].clear()
+    _GLOBAL["spans"] = []
     if trace_dir:
         _GLOBAL["trace_dir"] = trace_dir
         jax.profiler.start_trace(trace_dir)
@@ -154,3 +160,31 @@ class Profiler:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+def export_chrome_tracing(path, rank=None, process_name=None):
+    """Write the recorded host spans as a chrome-trace JSON (open in
+    chrome://tracing or Perfetto). Reference analog: the profiler's
+    chrome-trace output via `profiler.proto` + `tools/CrossStackProfiler`
+    per-rank files. `rank` becomes the trace pid so per-rank files merge
+    cleanly (tools/merge_profiles.py)."""
+    import json
+    import os
+
+    pid = 0 if rank is None else int(rank)
+    with _GLOBAL["lock"]:
+        spans = list(_GLOBAL["spans"])
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
+               "args": {"name": process_name or
+                        (f"rank {pid}" if rank is not None else "host")}}]
+    tids = {}
+    for name, t0, dur, tid in spans:
+        tids.setdefault(tid, len(tids))
+        events.append({
+            "name": name, "ph": "X", "pid": pid, "tid": tids[tid],
+            "ts": t0 * 1e6, "dur": dur * 1e6, "cat": "host",
+        })
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(spans)

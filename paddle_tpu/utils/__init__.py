@@ -5,7 +5,9 @@ from . import unique_name  # noqa: F401
 
 def deprecated(update_to="", since="", reason="", level=0):
     """Decorator marking an API deprecated (reference
-    `python/paddle/utils/deprecated.py`): warns once per call site."""
+    `python/paddle/utils/deprecated.py`): the warning is forced visible
+    (library DeprecationWarnings are filtered out by default) and fires
+    once per function."""
     import functools
     import warnings
 
@@ -23,9 +25,15 @@ def deprecated(update_to="", since="", reason="", level=0):
                 raise RuntimeError(msg)
             return dead
 
+        warned = []
+
         @functools.wraps(fn)
         def wrapper(*a, **k):
-            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            if not warned:
+                warned.append(True)
+                with warnings.catch_warnings():
+                    warnings.simplefilter("always", DeprecationWarning)
+                    warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*a, **k)
         return wrapper
     return decorate

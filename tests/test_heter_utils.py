@@ -96,3 +96,16 @@ def test_try_import_and_run_check(capsys):
         paddle.utils.try_import("definitely_not_a_module_xyz")
     assert paddle.utils.run_check() is True
     assert "installed successfully" in capsys.readouterr().out
+
+
+def test_unique_name_guard_scopes_layer_names():
+    """guard() must govern Layer/Parameter naming (reference behavior)."""
+    from paddle_tpu import nn
+    un = paddle.utils.unique_name
+    with un.guard():
+        l1 = nn.Linear(2, 2)
+        n1 = l1.weight.name
+    with un.guard():
+        l2 = nn.Linear(2, 2)
+        n2 = l2.weight.name
+    assert n1 == n2  # fresh namespace per guard

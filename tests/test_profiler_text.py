@@ -76,3 +76,41 @@ def test_viterbi_decoder_matches_bruteforce():
                 best, bp = s, seq
         assert abs(best - float(scores.numpy()[b])) < 1e-4
         assert list(bp) == paths.numpy()[b].tolist()
+
+
+def test_chrome_trace_export_and_merge(tmp_path):
+    """export_chrome_tracing + tools/merge_profiles (CrossStackProfiler
+    analog): spans from two 'ranks' merge into one aligned timeline."""
+    import json
+    import subprocess
+    import sys
+    import time as _time
+    import os
+    from paddle_tpu import profiler
+
+    paths = []
+    for rank in range(2):
+        profiler.start_profiler()
+        with profiler.RecordEvent("__sync__"):
+            pass
+        with profiler.RecordEvent("work"):
+            _time.sleep(0.01)
+        profiler.stop_profiler(print_table=False)
+        p = str(tmp_path / f"rank{rank}.json")
+        n = profiler.export_chrome_tracing(p, rank=rank)
+        assert n >= 2
+        paths.append(p)
+
+    out = str(tmp_path / "merged.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "merge_profiles.py"),
+         out] + paths, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    trace = json.load(open(out))
+    evs = [e for e in trace["traceEvents"] if e.get("name") == "work"]
+    assert len(evs) == 2
+    assert {e["pid"] for e in evs} == {0, 1}
+    # clock-aligned: both ranks' work spans start near t=0 (after __sync__)
+    for e in evs:
+        assert abs(e["ts"]) < 1e5  # within 100ms of the sync point

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome-trace profiles into ONE cross-rank timeline.
+
+Reference analog: `tools/CrossStackProfiler/` — merges per-rank profiler
+output (+ DCGM/net logs) into a single chrome trace for multi-machine
+debugging. Here each rank exports host spans with
+`paddle_tpu.profiler.export_chrome_tracing(path, rank=r)` (and optionally
+an XPlane device trace via TensorBoard); this tool merges the chrome
+JSONs, keeping each rank as its own trace pid and aligning clocks on an
+optional `__sync__` marker span (ranks record one right after a barrier —
+its start is declared t=0 for that rank).
+
+Usage:
+    python tools/merge_profiles.py out.json rank0.json rank1.json ...
+"""
+import json
+import sys
+
+
+def merge(paths):
+    merged = []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", trace)
+        # clock alignment: if the rank recorded a __sync__ span (taken
+        # right after a barrier), shift so those line up at t=0
+        sync_ts = None
+        for ev in events:
+            if ev.get("name") == "__sync__" and ev.get("ph") == "X":
+                sync_ts = ev["ts"]
+                break
+        for ev in events:
+            ev = dict(ev)
+            # default pid to the file index when ranks didn't set one
+            if "pid" not in ev and len(paths) > 1:
+                ev["pid"] = i
+            if sync_ts is not None and "ts" in ev:
+                ev["ts"] = ev["ts"] - sync_ts
+            merged.append(ev)
+    return {"traceEvents": merged}
+
+
+def main(argv):
+    if len(argv) < 3:
+        print(__doc__)
+        return 1
+    out, inputs = argv[1], argv[2:]
+    trace = merge(inputs)
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"merged {len(inputs)} rank profiles "
+          f"({len(trace['traceEvents'])} events) -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
