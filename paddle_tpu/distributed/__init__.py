@@ -51,5 +51,10 @@ from . import graph  # noqa: F401
 from .graph import GraphTable, ShardedGraph  # noqa: F401
 from . import heter  # noqa: F401
 from .heter import HeterClient, HeterServer  # noqa: F401
+from . import dist_utils as utils  # noqa: F401
+import sys as _sys
+# reference parity: `import paddle.distributed.utils` is a module path
+_sys.modules[__name__ + ".utils"] = utils
+from .dist_utils import global_scatter, global_gather  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy

@@ -1,0 +1,79 @@
+"""`paddle.distributed.utils` — MoE expert-parallel exchange API.
+
+Reference surface: `python/paddle/distributed/utils.py:56` (global_scatter)
+and `:123` (global_gather) over the `global_scatter/global_gather` ops
+(`operators/collective/global_scatter_op.cc`): tokens grouped by
+destination expert are exchanged all-to-all across the EP group.
+
+TPU-native shape: variable-count all-to-all does not exist in XLA (shapes
+must be static), so the REAL expert-parallel path is `MoELayer`
+(`distributed/moe.py`): fixed-capacity dense dispatch with
+`lax.all_to_all` over the `ep` mesh axis inside the compiled step.  These
+functions keep the reference's count-based API for the host-side /
+global-array regime: `x` holds every token (global array), `local_count`
+says how many consecutive rows go to each (expert, rank) bucket, and the
+exchange is the corresponding row permutation — numerics-identical to
+the reference's wire exchange, with XLA inserting real collectives when
+the arrays are sharded.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+
+def _counts(c):
+    c = np.asarray(c.numpy() if isinstance(c, Tensor) else c,
+                   np.int64).ravel()
+    return c
+
+
+def _exchange_perm(lc, gc, n_rows, world):
+    """Validated row permutation for the (expert, rank) grid transpose
+    shared by scatter and gather."""
+    if lc.sum() != n_rows:
+        raise ValueError(
+            f"local_count sums to {lc.sum()} but x has {n_rows} rows")
+    if lc.size != gc.size:
+        raise ValueError("local_count/global_count length mismatch")
+    if lc.size % world != 0:
+        raise ValueError(
+            f"count length {lc.size} not divisible by world {world}")
+    ne = lc.size // world
+    starts = np.concatenate([[0], np.cumsum(lc)[:-1]])
+    order = []
+    for e in range(ne):
+        for r in range(world):
+            b = r * ne + e           # sender-major bucket index
+            order.extend(range(starts[b], starts[b] + lc[b]))
+    return np.asarray(order, np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Rows of `x` are bucketed by (expert, rank) in local_count order
+    (expert-major); returns them regrouped in global_count order — the
+    receiving side's layout. Reference `distributed/utils.py:56`."""
+    x = ensure_tensor(x)
+    lc, gc = _counts(local_count), _counts(global_count)
+    # the exchange delivers bucket (e, r) contiguously per receiving
+    # expert; with the global array holding every bucket it is a stable
+    # permutation — the transpose of the (expert, rank) grid
+    idx = _exchange_perm(lc, gc, x.shape[0], _group_size(group))
+    return x[idx] if idx.size else x[:0]
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse exchange (reference `distributed/utils.py:123`):
+    global_gather(global_scatter(x, lc, gc), lc, gc) == x."""
+    x = ensure_tensor(x)
+    lc, gc = _counts(local_count), _counts(global_count)
+    idx = _exchange_perm(lc, gc, x.shape[0], _group_size(group))
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(idx.size)
+    return x[inv] if idx.size else x[:0]
+
+
+def _group_size(group):
+    if group is None:
+        return 1
+    return getattr(group, "nranks", 1)

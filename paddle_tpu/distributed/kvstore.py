@@ -20,20 +20,8 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        import subprocess
-        src = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), "csrc",
-            "kvstore.cc")
-        out_dir = os.path.join(os.path.dirname(src), "build")
-        os.makedirs(out_dir, exist_ok=True)
-        so = os.path.join(out_dir, "libkvstore.so")
-        if (not os.path.exists(so) or
-                os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                            "-pthread", src, "-o", so + ".tmp"],
-                           check=True, capture_output=True)
-            os.replace(so + ".tmp", so)
-        lib = ctypes.CDLL(so)
+        from ..utils.native_build import native_lib_path
+        lib = ctypes.CDLL(native_lib_path("kvstore"))
         lib.kvs_server_start.restype = ctypes.c_void_p
         lib.kvs_server_start.argtypes = [ctypes.c_int]
         lib.kvs_server_port.restype = ctypes.c_int

@@ -17,7 +17,6 @@ import threading
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..io.native import _build_lib  # shares the build machinery pattern
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -36,19 +35,8 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        import subprocess
-        src = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), "csrc", "pskv.cc")
-        out_dir = os.path.join(os.path.dirname(src), "build")
-        os.makedirs(out_dir, exist_ok=True)
-        so = os.path.join(out_dir, "libpskv.so")
-        if (not os.path.exists(so) or
-                os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                            "-pthread", src, "-o", so + ".tmp"],
-                           check=True, capture_output=True)
-            os.replace(so + ".tmp", so)
-        lib = ctypes.CDLL(so)
+        from ..utils.native_build import native_lib_path
+        lib = ctypes.CDLL(native_lib_path("pskv"))
         lib.pskv_table_create.restype = ctypes.c_void_p
         lib.pskv_table_create.argtypes = [ctypes.c_int32, ctypes.c_int32,
                                           ctypes.c_float, ctypes.c_float,

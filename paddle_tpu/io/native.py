@@ -24,23 +24,9 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _src_path():
-    return os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), "csrc", "ptio.cc")
-
-
 def _build_lib():
-    src = _src_path()
-    out_dir = os.path.join(os.path.dirname(src), "build")
-    os.makedirs(out_dir, exist_ok=True)
-    so = os.path.join(out_dir, "libptio.so")
-    if (not os.path.exists(so) or
-            os.path.getmtime(so) < os.path.getmtime(src)):
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               src, "-o", so + ".tmp"]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(so + ".tmp", so)
-    return so
+    from ..utils.native_build import native_lib_path
+    return native_lib_path("ptio")
 
 
 def _load():

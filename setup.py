@@ -1,0 +1,45 @@
+"""Build: compiles the native host-runtime libraries (csrc/*.cc) into
+`paddle_tpu/_native/` so installed wheels need no compiler at import
+time (dev checkouts still build on demand — see
+`paddle_tpu/utils/native_build.py` for the resolution order).
+
+Reference analog: the op-library build machinery (`cmake/operators.cmake`,
+`cmake/generic.cmake`) — three C-ABI shared libraries instead of several
+hundred op targets, because XLA owns the device kernels.
+"""
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+# single source of truth for the flags lives next to the loader; load the
+# module by path so the build env doesn't need jax (the package __init__
+# imports it)
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_native_build", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "paddle_tpu", "utils", "native_build.py"))
+_nb = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_nb)
+FLAGS = _nb._FLAGS
+
+NATIVE_LIBS = ["pskv", "kvstore", "ptio"]
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(self.build_lib, "paddle_tpu", "_native")
+        os.makedirs(out, exist_ok=True)
+        for name in NATIVE_LIBS:
+            src = os.path.join(here, "csrc", f"{name}.cc")
+            so = os.path.join(out, f"lib{name}.so")
+            subprocess.run(["g++", *FLAGS, src, "-o", so], check=True)
+            print(f"built native lib: {so}")
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

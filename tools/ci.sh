@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# CI gate (reference analog: paddle_build.sh + tools/test_ci_op_benchmark.sh
+# + check_api_compatible.py rolled into the TPU build's three checks):
+#   1. native libs compile (cmake if available, else direct g++)
+#   2. full pytest suite on the 8-virtual-device CPU mesh
+#   3. op-level perf regression gate vs the recorded baseline (TPU only;
+#      skipped automatically elsewhere — see tools/op_bench.py)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/3] native build =="
+if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+  cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
+  cmake --build csrc/build/cmake >/dev/null
+else
+  mkdir -p csrc/build
+  for lib in pskv kvstore ptio; do
+    g++ -O3 -std=c++17 -shared -fPIC -pthread "csrc/${lib}.cc" \
+        -o "csrc/build/lib${lib}.so"
+  done
+fi
+echo "native libs OK"
+
+echo "== [2/3] test suite =="
+python -m pytest tests/ -x -q
+
+echo "== [3/3] op benchmark gate =="
+python - <<'EOF'
+import jax
+import subprocess
+import sys
+if jax.default_backend() != "tpu":
+    print("not on TPU: op-bench regression gate skipped")
+    sys.exit(0)
+r = subprocess.run([sys.executable, "tools/op_bench.py",
+                    "--out", "/tmp/op_bench_current.json"])
+if r.returncode:
+    sys.exit(r.returncode)
+r = subprocess.run([sys.executable, "tools/check_op_benchmark_result.py",
+                    "tools/op_bench_baseline_v5e.json",
+                    "/tmp/op_bench_current.json"])
+sys.exit(r.returncode)
+EOF
+echo "CI OK"
