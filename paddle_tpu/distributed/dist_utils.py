@@ -40,6 +40,14 @@ def _exchange_perm(lc, gc, n_rows, world):
         raise ValueError(
             f"count length {lc.size} not divisible by world {world}")
     ne = lc.size // world
+    # in the global-array regime global_count must be the (expert, rank)
+    # transpose of local_count (what the reference's count-alltoall would
+    # deliver); a mismatch means the caller's bookkeeping is wrong
+    expect_gc = lc.reshape(world, ne).T.reshape(-1)
+    if not np.array_equal(gc, expect_gc):
+        raise ValueError(
+            "global_count does not match the transpose of local_count; "
+            f"expected {expect_gc.tolist()}, got {gc.tolist()}")
     starts = np.concatenate([[0], np.cumsum(lc)[:-1]])
     order = []
     for e in range(ne):

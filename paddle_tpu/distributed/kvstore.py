@@ -7,7 +7,6 @@ client. Atomic `add` gives barriers and rank assignment; `list(prefix)`
 gives membership views for the elastic manager.
 """
 import ctypes
-import os
 import threading
 import time
 

@@ -317,7 +317,7 @@ class GPTForPretraining(Layer):
     def generate(self, input_ids, max_new_tokens=32, decode_strategy="greedy",
                  top_k=0, top_p=1.0, temperature=1.0, num_beams=1,
                  length_penalty=0.0, eos_token_id=None, pad_token_id=0,
-                 seed=None):
+                 seed=None, dtype="bfloat16"):
         """Autoregressive decoding with a static KV cache, compiled to a
         single XLA program (prefill + `lax.while_loop` decode). Analog of
         the reference's dynamic_decode/BeamSearchDecoder
@@ -325,6 +325,8 @@ class GPTForPretraining(Layer):
 
         decode_strategy: "greedy" | "sampling" (top_k/top_p/temperature) |
         "beam_search" (num_beams, length_penalty).
+        dtype: decode compute dtype ("bfloat16" default — ~2x tokens/sec,
+        weight-bandwidth bound; dtype=None decodes in the params' dtype).
         Returns (ids Tensor [b, prompt+max_new], scores Tensor [b]).
         """
         from ..generation import run_generate
@@ -333,7 +335,7 @@ class GPTForPretraining(Layer):
             decode_strategy=decode_strategy, top_k=top_k, top_p=top_p,
             temperature=temperature, num_beams=num_beams,
             length_penalty=length_penalty, eos_token_id=eos_token_id,
-            pad_token_id=pad_token_id, seed=seed)
+            pad_token_id=pad_token_id, seed=seed, dtype=dtype)
 
     def loss(self, input_ids, labels, loss_mask=None):
         from ..flags import get_flag

@@ -32,8 +32,6 @@ def native_lib_path(name):
             os.path.getmtime(pkg_native) >= os.path.getmtime(src)):
         return pkg_native
     if not os.path.exists(src):
-        if os.path.exists(pkg_native):
-            return pkg_native
         raise FileNotFoundError(
             f"native library {name!r}: neither a prebuilt "
             f"{pkg_native} nor source {src} exists")

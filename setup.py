@@ -12,6 +12,15 @@ import subprocess
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BinaryDistribution(Distribution):
+    """The wheel ships compiled .so files: force a platform tag so a
+    linux-x86_64 wheel is never installed on a foreign platform."""
+
+    def has_ext_modules(self):
+        return True
 
 
 # single source of truth for the flags lives next to the loader; load the
@@ -42,4 +51,5 @@ class BuildPyWithNative(build_py):
             print(f"built native lib: {so}")
 
 
-setup(cmdclass={"build_py": BuildPyWithNative})
+setup(cmdclass={"build_py": BuildPyWithNative},
+      distclass=BinaryDistribution)

@@ -245,7 +245,8 @@ def test_global_scatter_gather_roundtrip():
     # sender-major (rank), expert-major-within-rank order
     lc = np.array([2, 0, 1, 3, 2, 1])
     x = Tensor(rng.randn(int(lc.sum()), 4).astype(np.float32))
-    gc = lc  # symmetric for the test
+    # receive layout = (expert, rank) transpose of the send layout
+    gc = lc.reshape(2, 3).T.reshape(-1)     # [2, 3, 0, 2, 1, 1]
     g = FakeGroup()
     y = global_scatter(x, lc, gc, group=g)
     assert y.shape == x.shape
