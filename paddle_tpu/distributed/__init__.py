@@ -31,7 +31,7 @@ from .moe import MoELayer  # noqa: F401
 from .pipeline import (  # noqa: F401
     LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
     PipelineParallel, pipeline_apply, pipeline_apply_tensors,
-    pipeline_train_step_1f1b,
+    pipeline_train_step_1f1b, pipeline_train_step_interleaved,
 )
 from .planner import gpt_memory_plan, MemoryPlan, HBM_BYTES  # noqa: F401
 from .recompute import recompute  # noqa: F401

@@ -252,6 +252,222 @@ def pipeline_train_step_1f1b(stage_fn, head_loss_fn, stacked_params,
     return shard(stacked_params, head_params, x, y)
 
 
+def pipeline_train_step_interleaved(stage_fn, head_loss_fn, stacked_params,
+                                    head_params, x, y, num_microbatches,
+                                    vpp, mesh=None):
+    """Interleaved (virtual-stage) 1F1B — BEYOND the reference, which
+    documents interleaving as not implemented
+    (`meta_parallel/pipeline_parallel.py`: Megatron-style interleaving
+    absent). Each physical stage hosts `vpp` model CHUNKS assigned
+    round-robin (chunk k lives on stage k % pp), shrinking the pipeline
+    bubble from (pp-1)/(m+pp-1) toward (pp-1)/(vpp*m) at the cost of
+    more in-flight activations — the standard Megatron trade.
+
+    Mechanically it is the 1F1B ring generalized to V = pp*vpp virtual
+    stages: activations still hop +1 over ICI each tick, but the payload
+    is a [vpp, ...] per-chunk buffer and the WRAP of the ring (stage
+    pp-1 -> 0 forward, 0 -> pp-1 backward) rolls the chunk index by one,
+    which is exactly what "the next virtual stage" means after a full
+    trip around the physical ring.
+
+    stage_fn(chunk_params, h_mb) -> h_mb, where chunk_params leaves have
+    leading dim total_blocks // (pp*vpp).
+    stacked_params leaves: leading dim = total_blocks, GLOBAL layer
+    order; this wrapper re-rows them into stage-major chunk order before
+    sharding over 'pp'.
+    Returns (loss, stacked_param_grads in GLOBAL order, head_grads, dx).
+    """
+    mesh = mesh or env.current_mesh()
+    pp = mesh.shape["pp"]
+    if vpp == 1:
+        return pipeline_train_step_1f1b(
+            stage_fn, head_loss_fn, stacked_params, head_params, x, y,
+            num_microbatches, mesh=mesh)
+    if pp == 1:
+        # no ring: run the vpp chunks back-to-back in one vjp
+        n_rows = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        if n_rows % vpp:
+            raise ValueError(
+                f"{n_rows} stacked blocks not divisible by vpp={vpp}")
+        rows_per_chunk = n_rows // vpp
+
+        def full_fn(params, h):
+            for l in range(vpp):
+                chunk = jax.tree_util.tree_map(
+                    lambda p, li=l: p[li * rows_per_chunk:
+                                      (li + 1) * rows_per_chunk], params)
+                h = stage_fn(chunk, h)
+            return h
+        return pipeline_train_step_1f1b(
+            full_fn, head_loss_fn, stacked_params, head_params, x, y,
+            num_microbatches, mesh=mesh)
+    V = pp * vpp
+    n_micro = num_microbatches
+    T = n_micro + 2 * (V - 1)
+    ring = min(2 * V, n_micro)
+
+    # global layer order -> stage-major chunk rows: stage s holds rows
+    # [s*vpp*bpc, (s+1)*vpp*bpc) = chunks (0*pp+s, 1*pp+s, ...)
+    total = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if total % V:
+        raise ValueError(
+            f"{total} stacked blocks not divisible by pp*vpp={V}; pad or "
+            "change the chunking — silently dropping layers is not an option")
+    bpc = total // V
+    row_perm = np.concatenate([
+        np.arange(bpc) + (l * pp + s) * bpc
+        for s in range(pp) for l in range(vpp)])
+    inv_perm = np.argsort(row_perm)
+    params_rows = jax.tree_util.tree_map(
+        lambda p: p[row_perm], stacked_params)
+
+    def inner(params, hp, xv, yv):
+        stage = jax.lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == pp - 1
+        B = xv.shape[0]
+        mb = B // n_micro
+        xm = xv.reshape((n_micro, mb) + xv.shape[1:])
+        ym = yv.reshape((n_micro, mb) + yv.shape[1:])
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+        def vary(a):
+            try:
+                return jax.lax.pcast(a, ("pp",), to="varying")
+            except ValueError:
+                return a
+
+        hp = jax.tree_util.tree_map(vary, hp)
+        # local chunk view: [vpp, bpc, ...]
+        lp = jax.tree_util.tree_map(
+            lambda p: p.reshape((vpp, bpc) + p.shape[1:]), params)
+        act_shape = (mb,) + xv.shape[1:]
+
+        carry0 = dict(
+            fwd=vary(jnp.zeros((vpp,) + act_shape, xv.dtype)),
+            bwd=vary(jnp.zeros((vpp,) + act_shape, xv.dtype)),
+            inbuf=vary(jnp.zeros((vpp, ring) + act_shape, xv.dtype)),
+            gacc=jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), lp),
+            hacc=jax.tree_util.tree_map(
+                lambda p: vary(jnp.zeros_like(p)), hp),
+            dxbuf=vary(jnp.zeros((n_micro,) + act_shape, xv.dtype)),
+            loss=vary(jnp.zeros((), jnp.float32)),
+        )
+
+        def tick(c, t):
+            fwd_send = []
+            bwd_send = []
+            inbuf, gacc, hacc = c["inbuf"], c["gacc"], c["hacc"]
+            dxbuf, loss = c["dxbuf"], c["loss"]
+            for l in range(vpp):
+                k = l * pp + stage                      # virtual stage id
+                chunk_p = jax.tree_util.tree_map(lambda p: p[l], lp)
+                m_f = t - k
+                m_b = t - 2 * (V - 1) + k
+                fwd_on = jnp.logical_and(m_f >= 0, m_f < n_micro)
+                bwd_on = jnp.logical_and(m_b >= 0, m_b < n_micro)
+                mf_c = jnp.clip(m_f, 0, n_micro - 1)
+                mb_c = jnp.clip(m_b, 0, n_micro - 1)
+                # only the statically-last local chunk can ever be the
+                # pipeline head — guard at trace time so the head-loss
+                # graph is emitted once per tick, not vpp times
+                is_head_candidate = (l == vpp - 1)
+                head_chunk = jnp.logical_and(is_last, is_head_candidate)
+
+                # ---- forward ----
+                x_in = c["fwd"][l]
+                if l == 0:
+                    x_in = jnp.where(
+                        is_first,
+                        jax.lax.dynamic_index_in_dim(xm, mf_c, 0,
+                                                     keepdims=False),
+                        x_in)
+                slot_f = jnp.mod(mf_c, ring)
+                old = jax.lax.dynamic_index_in_dim(
+                    inbuf[l], slot_f, 0, keepdims=False)
+                inbuf = inbuf.at[l].set(
+                    jax.lax.dynamic_update_index_in_dim(
+                        inbuf[l], jnp.where(fwd_on, x_in, old), slot_f, 0))
+                out = stage_fn(chunk_p, x_in)
+
+                # ---- head loss (only the LAST virtual chunk) ----
+                if is_head_candidate:
+                    y_mb = jax.lax.dynamic_index_in_dim(ym, mf_c, 0,
+                                                        keepdims=False)
+                    loss_m, loss_vjp = jax.vjp(
+                        lambda hp_, o: head_loss_fn(hp_, o, y_mb), hp, out)
+                    dhp, dout = loss_vjp(vary(jnp.ones((), loss_m.dtype)))
+                    loss = loss + jnp.where(
+                        jnp.logical_and(fwd_on, head_chunk),
+                        loss_m.astype(jnp.float32), 0.0)
+                    hacc = jax.tree_util.tree_map(
+                        lambda a, g: a + jnp.where(
+                            jnp.logical_and(bwd_on, head_chunk), g,
+                            jnp.zeros_like(g)),
+                        hacc, dhp)
+                    cot = jnp.where(head_chunk, dout.astype(xv.dtype),
+                                    c["bwd"][l])
+                else:
+                    cot = c["bwd"][l]
+
+                # ---- backward (recompute from saved chunk input) ----
+                saved = jax.lax.dynamic_index_in_dim(
+                    inbuf[l], jnp.mod(mb_c, ring), 0, keepdims=False)
+                _, svjp = jax.vjp(stage_fn, chunk_p, saved)
+                dp, dx = svjp(cot)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, g, li=l: a.at[li].add(
+                        jnp.where(bwd_on, g, jnp.zeros_like(g))),
+                    gacc, dp)
+                if l == 0:
+                    dxbuf = jax.lax.dynamic_update_index_in_dim(
+                        dxbuf,
+                        jnp.where(jnp.logical_and(bwd_on, is_first), dx,
+                                  jax.lax.dynamic_index_in_dim(
+                                      dxbuf, mb_c, 0, keepdims=False)),
+                        mb_c, 0)
+                fwd_send.append(out)
+                bwd_send.append(dx)
+
+            fwd_msg = jax.lax.ppermute(jnp.stack(fwd_send), "pp", fwd_perm)
+            bwd_msg = jax.lax.ppermute(jnp.stack(bwd_send), "pp", bwd_perm)
+            # ring wrap advances the chunk index: stage 0 receives stage
+            # pp-1's chunk l output as ITS chunk l+1 input (and vice versa
+            # for cotangents arriving back at stage pp-1)
+            fwd_in = jnp.where(is_first,
+                               jnp.roll(fwd_msg, 1, axis=0), fwd_msg)
+            bwd_in = jnp.where(is_last,
+                               jnp.roll(bwd_msg, -1, axis=0), bwd_msg)
+            return dict(fwd=fwd_in, bwd=bwd_in, inbuf=inbuf, gacc=gacc,
+                        hacc=hacc, dxbuf=dxbuf, loss=loss), None
+
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        loss = jax.lax.psum(final["loss"], "pp") / n_micro
+        hg = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g / n_micro, "pp"), final["hacc"])
+        pg = jax.tree_util.tree_map(
+            lambda g: (g / n_micro).reshape((vpp * bpc,) + g.shape[2:]),
+            final["gacc"])
+        dx = jax.lax.psum(final["dxbuf"], "pp") / n_micro
+        return loss, pg, hg, dx.reshape((B,) + dx.shape[2:])
+
+    shard = jax.shard_map(
+        inner, mesh=mesh, axis_names={"pp"},
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params_rows),
+                  jax.tree_util.tree_map(lambda _: P(), head_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), params_rows),
+                   jax.tree_util.tree_map(lambda _: P(), head_params),
+                   P()))
+    loss, pg_rows, hg, dx = shard(params_rows, head_params, x, y)
+    # back to GLOBAL layer order for the caller's optimizer
+    pg = jax.tree_util.tree_map(lambda g: g[inv_perm], pg_rows)
+    return loss, pg, hg, dx
+
+
 # ---------------------------------------------------------------------------
 # PipelineLayer API parity (reference pp_layers.py)
 # ---------------------------------------------------------------------------
