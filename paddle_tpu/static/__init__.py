@@ -271,3 +271,78 @@ def name_scope(prefix=None):
 class WeightNormParamAttr:
     def __init__(self, *a, **k):
         pass
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference `details/build_strategy.h:75`). Every
+    toggle the reference exposes — fusion passes, reduce strategy,
+    sync_batch_norm, hierarchical allreduce — is owned by XLA/GSPMD here,
+    so the attributes are accepted, recorded, and honestly inert; unknown
+    names raise (a silently-absorbed typo would masquerade as tuning)."""
+
+    _KNOWN = {
+        "fuse_elewise_add_act_ops", "fuse_bn_act_ops", "fuse_bn_add_act_ops",
+        "fuse_relu_depthwise_conv", "fuse_broadcast_ops",
+        "fuse_all_optimizer_ops", "fuse_all_reduce_ops",
+        "enable_auto_fusion", "enable_addto", "enable_inplace",
+        "enable_sequential_execution", "cache_runtime_context",
+        "memory_optimize", "sync_batch_norm", "reduce_strategy",
+        "gradient_scale_strategy", "num_trainers",
+        "trainer_id", "trainers_endpoints", "use_hierarchical_allreduce",
+        "hierarchical_allreduce_inter_nranks", "fuse_grad_merge",
+        "fuse_gemm_epilogue", "debug_graphviz_path", "nccl_comm_num",
+        "mkldnn_enabled_op_types", "fix_op_run_order",
+        "allow_cuda_graph_capture", "async_mode",
+    }
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        object.__setattr__(self, "_values", {})
+
+    def __setattr__(self, name, value):
+        if name not in self._KNOWN:
+            raise AttributeError(
+                f"BuildStrategy has no knob {name!r} (XLA owns "
+                "fusion/placement; accepted-for-compat knobs: "
+                f"{sorted(self._KNOWN)})")
+        self._values[name] = value
+
+    def __getattr__(self, name):
+        if name in type(self)._KNOWN:
+            return self.__dict__["_values"].get(name)
+        raise AttributeError(name)
+
+
+class ExecutionStrategy:
+    """Executor knobs (reference `execution_strategy.h`): thread counts and
+    cleanup cadence have no analog under one fused XLA program; accepted
+    and inert, same contract as BuildStrategy (typos rejected)."""
+
+    _KNOWN = {"num_threads", "num_iteration_per_drop_scope",
+              "num_iteration_per_run", "use_thread_barrier",
+              "allow_op_delay", "use_device"}
+
+    def __init__(self):
+        object.__setattr__(self, "_values", {
+            "num_threads": 1, "num_iteration_per_drop_scope": 1,
+            "num_iteration_per_run": 1, "use_thread_barrier": False})
+
+    def __setattr__(self, name, value):
+        if name not in self._KNOWN:
+            raise AttributeError(
+                f"ExecutionStrategy has no knob {name!r}; known: "
+                f"{sorted(self._KNOWN)}")
+        self._values[name] = value
+
+    def __getattr__(self, name):
+        if name in type(self)._KNOWN:
+            return self.__dict__["_values"].get(name)
+        raise AttributeError(name)

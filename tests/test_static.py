@@ -157,3 +157,21 @@ def test_compiled_program_rejects_training_and_partial_feed():
     comp = static.CompiledProgram(infer)
     with pytest.raises(KeyError, match="missing placeholders"):
         comp.run({"x": np.zeros((2, 2), np.float32)}, [out])
+
+
+def test_build_and_execution_strategy_compat():
+    """BuildStrategy/ExecutionStrategy (reference build_strategy.h:75,
+    execution_strategy.h): accepted-for-compat knobs with typo
+    rejection."""
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    bs.reduce_strategy = static.BuildStrategy.ReduceStrategy.Reduce
+    assert bs.fuse_elewise_add_act_ops is True
+    assert bs.memory_optimize is None  # unset known knob reads as None
+    with pytest.raises(AttributeError):
+        bs.fuse_everything_harder = True
+    es = static.ExecutionStrategy()
+    es.num_threads = 8
+    assert es.num_threads == 8
+    with pytest.raises(AttributeError):
+        es.num_thread = 8  # typo rejected, same contract
