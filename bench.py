@@ -53,7 +53,33 @@ def _time_train_steps(step, inputs, steps, warmup):
     return dt / steps, loss
 
 
+def _probe_backend(timeout_s=180):
+    """Run a tiny computation in a SUBPROCESS with a hard timeout: a
+    wedged TPU tunnel hangs at the first dispatch (observed in the wild),
+    and a hang here would eat the whole driver budget. Returns True when
+    the backend answers."""
+    import subprocess
+    code = ("import jax, jax.numpy as jnp;"
+            "print(float((jnp.ones((8,8))@jnp.ones((8,8))).sum()))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if not _probe_backend():
+        print(json.dumps({
+            "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
+            "error": "accelerator backend unreachable (probe computation "
+                     "timed out); see stderr"}))
+        print("# backend probe failed: the device tunnel is not answering "
+              "dispatches; bench aborted instead of hanging", file=sys.stderr)
+        sys.exit(1)
+
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import amp, optimizer

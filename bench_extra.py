@@ -182,6 +182,12 @@ def bench_ocr():
 
 
 def main():
+    from bench import _probe_backend
+    if not _probe_backend():
+        print(json.dumps({"metric": "bench_extra",
+                          "error": "accelerator backend unreachable "
+                                   "(probe timed out)"}))
+        sys.exit(1)
     wrapped = None
     for fn in (bench_decode, bench_bert, bench_long_context, bench_ocr):
         try:
