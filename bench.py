@@ -56,28 +56,42 @@ def _time_train_steps(step, inputs, steps, warmup):
 def _probe_backend(timeout_s=180):
     """Run a tiny computation in a SUBPROCESS with a hard timeout: a
     wedged TPU tunnel hangs at the first dispatch (observed in the wild),
-    and a hang here would eat the whole driver budget. Returns True when
-    the backend answers."""
+    and a hang here would eat the whole driver budget. Returns (ok,
+    reason). Uses Popen.wait (not run) so a child stuck UNINTERRUPTIBLE
+    in the device driver cannot block us past the grace period, and
+    surfaces the child's stderr when it dies for a non-timeout reason."""
     import subprocess
+    import tempfile
     code = ("import jax, jax.numpy as jnp;"
             "print(float((jnp.ones((8,8))@jnp.ones((8,8))).sum()))")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, timeout=timeout_s)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.DEVNULL, stderr=errf)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)  # D-state child: don't block on reap
+            except subprocess.TimeoutExpired:
+                pass
+            return False, "probe computation timed out (device tunnel not "                           "answering dispatches)"
+        if rc != 0:
+            errf.seek(0)
+            tail = errf.read()[-2000:].decode(errors="replace")
+            return False, f"probe process exited rc={rc}: {tail}"
+    return True, ""
 
 
 def main():
-    if not _probe_backend():
+    ok, reason = _probe_backend()
+    if not ok:
         print(json.dumps({
             "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
-            "error": "accelerator backend unreachable (probe computation "
-                     "timed out); see stderr"}))
-        print("# backend probe failed: the device tunnel is not answering "
-              "dispatches; bench aborted instead of hanging", file=sys.stderr)
+            "error": f"accelerator backend unusable: {reason[:300]}"}))
+        print(f"# backend probe failed: {reason}\n# bench aborted instead "
+              "of hanging", file=sys.stderr)
         sys.exit(1)
 
     import jax

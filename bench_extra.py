@@ -183,10 +183,11 @@ def bench_ocr():
 
 def main():
     from bench import _probe_backend
-    if not _probe_backend():
+    ok, reason = _probe_backend()
+    if not ok:
         print(json.dumps({"metric": "bench_extra",
-                          "error": "accelerator backend unreachable "
-                                   "(probe timed out)"}))
+                          "error": f"accelerator backend unusable: "
+                                   f"{reason[:300]}"}))
         sys.exit(1)
     wrapped = None
     for fn in (bench_decode, bench_bert, bench_long_context, bench_ocr):
