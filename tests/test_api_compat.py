@@ -192,3 +192,44 @@ def test_nn_functional_gap_closers():
     t2 = paddle.to_tensor(np.array([0.0, 0.0], np.float32))
     F.softmax_(t2)
     np.testing.assert_allclose(t2.numpy(), [0.5, 0.5])
+
+
+def test_distributed_namespace_parity():
+    """Reference `python/paddle/distributed/__init__.py` + fleet surface —
+    every name the round-2 build claims must resolve."""
+    import paddle_tpu.distributed as dist
+    for name in [
+        "init_parallel_env", "get_rank", "get_world_size", "spawn",
+        "all_reduce", "all_gather", "alltoall", "broadcast", "scatter",
+        "send", "recv", "barrier", "new_group", "split", "ReduceOp",
+        "ProcessMesh", "shard_tensor", "shard_op",
+        "global_scatter", "global_gather",
+        "GraphTable", "ShardedGraph", "HeterClient", "HeterServer",
+        "LocalFS", "HDFSClient", "TrainEpochRange", "train_epoch_range",
+        "pipeline_train_step_1f1b", "pipeline_train_step_interleaved",
+        "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+        "VocabParallelEmbedding", "ColumnParallelLinear",
+        "RowParallelLinear", "ParallelCrossEntropy", "MoELayer",
+        "ShardedTrainStep", "recompute", "KVServer", "KVClient",
+    ]:
+        assert getattr(dist, name) is not None, name
+    # module-path imports must work too
+    from paddle_tpu.distributed.utils import global_scatter  # noqa: F401
+    from paddle_tpu.distributed import metrics
+    assert callable(metrics.auc)
+    from paddle_tpu.distributed.fleet import util, utils, UtilBase
+    assert isinstance(util, UtilBase) and utils.fs is not None
+
+
+def test_new_toplevel_surfaces():
+    assert paddle.cost_model.CostModel is not None
+    assert paddle.jit.TracedLayer is not None
+    assert paddle.utils.unique_name.generate("x").startswith("x_")
+    assert callable(paddle.utils.deprecated)
+    from paddle_tpu.static import (
+        BuildStrategy, ExecutionStrategy, while_loop, cond)
+    assert BuildStrategy and ExecutionStrategy
+    assert callable(while_loop) and callable(cond)
+    from paddle_tpu.io.dataset import BoxPSDataset  # noqa: F401
+    import paddle_tpu.profiler as prof
+    assert callable(prof.export_chrome_tracing)
