@@ -199,8 +199,10 @@ struct Table {
 };
 
 // ---------------- TCP service ----------------
-// frame: u32 op (1=pull, 2=push, 3=stop) | u32 n | n*i64 keys |
-//        [push: n*dim f32 grads]; reply to pull: n*dim f32.
+// frame: u32 op (1=pull, 2=push, 3=stop, 4=dim-handshake) | u32 n |
+//        n*i64 keys | [push: n*dim f32 grads]; reply to pull: n*dim f32;
+//        reply to op 4: u32 dim (n ignored) — lets clients validate the
+//        row width instead of deadlocking on a mismatched read size.
 
 constexpr uint32_t kMaxFrameKeys = 1u << 24;  // 16M keys per frame
 
@@ -252,6 +254,11 @@ struct Server {
       if (!read_all(fd, hdr, sizeof(hdr))) break;
       uint32_t op = hdr[0], n = hdr[1];
       if (op == 3) break;
+      if (op == 4) {  // dim handshake
+        uint32_t d = (uint32_t)table->dim;
+        if (!write_all(fd, &d, sizeof(d))) break;
+        continue;
+      }
       if (n > kMaxFrameKeys) break;  // malformed/hostile frame
       keys.resize(n);
       if (!read_all(fd, keys.data(), n * sizeof(int64_t))) break;
@@ -447,6 +454,15 @@ void* pskv_serve(void* tp, int32_t port) {
 }
 
 int32_t pskv_server_port(void* sp) { return static_cast<Server*>(sp)->port; }
+
+int32_t pskv_client_remote_dim(void* cp) {
+  auto* c = static_cast<Client*>(cp);
+  uint32_t hdr[2] = {4, 0};
+  if (!write_all(c->fd, hdr, sizeof(hdr))) return -1;
+  uint32_t d = 0;
+  if (!read_all(c->fd, &d, sizeof(d))) return -1;
+  return (int32_t)d;
+}
 
 void pskv_server_stop(void* sp) {
   auto* s = static_cast<Server*>(sp);

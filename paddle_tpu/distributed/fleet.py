@@ -128,26 +128,129 @@ def barrier_worker():
 
 
 def stop_worker():
-    pass
+    if _ps.client is not None:
+        cs = (_ps.client.values() if isinstance(_ps.client, dict)
+              else [_ps.client])
+        for c in cs:
+            c.close()
+        _ps.client = None
 
 
-# PS-mode API surface (capability parity; the PS runtime itself is the
-# host-sharded embedding path, round 2+)
+# PS-mode API surface over the real runtime (`distributed/ps.py` /
+# `csrc/pskv.cc`). Reference env contract (`fleet/base/role_maker.py`):
+# TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PORT, PADDLE_PSERVERS_IP_PORT_LIST.
+class _PSState:
+    tables = None      # name -> SparseTable (server side)
+    servers = []       # PSServer handles
+    client = None      # PSClient (worker side)
+
+
+_ps = _PSState()
+
+
+def _role():
+    import os
+    return os.environ.get("TRAINING_ROLE",
+                          os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER"))
+
+
 def is_server():
-    return False
+    return _role().upper() == "PSERVER"
+
 
 def is_worker():
-    return True
+    return not is_server()
 
-def init_worker():
-    pass
 
-def init_server(*args, **kwargs):
-    pass
+def init_server(model_dir=None, dim=None, optimizer="sgd", lr=0.01,
+                init_range=0.05, tables=None, **kwargs):
+    """Create the server-side sparse tables (one default table, or a
+    {name: SparseTable} dict via `tables`) and optionally restore from
+    `model_dir` (reference `fleet.init_server(dirname)`)."""
+    import os
+    from .ps import SparseTable
+    if tables is None:
+        d = dim or int(os.environ.get("PADDLE_PS_TABLE_DIM", "8"))
+        tables = {"embedding": SparseTable(d, optimizer=optimizer, lr=lr,
+                                           init_range=init_range)}
+    _ps.tables = tables
+    if model_dir:
+        for name, t in tables.items():
+            path = os.path.join(model_dir, f"{name}.pskv")
+            if os.path.exists(path):
+                t.load(path)
+    return tables
 
-def run_server():
-    raise NotImplementedError(
-        "parameter-server mode: use paddle_tpu.distributed.ps (round 2)")
+
+def run_server(block=True):
+    """Serve every table on PADDLE_PORT (+i per table, in sorted-name
+    order — the SAME order init_worker uses); blocks like the reference
+    unless block=False (tests)."""
+    import os
+    import time as _time
+    from .ps import PSServer
+    if _ps.tables is None:
+        init_server()
+    stop_server()        # idempotent restart: never leak live listeners
+    base_port = int(os.environ.get("PADDLE_PORT", "0"))
+    for i, (name, t) in enumerate(sorted(_ps.tables.items())):
+        port = base_port + i if base_port else 0
+        _ps.servers.append(PSServer(t, port=port))
+    if block:
+        try:
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        stop_server()
+    return _ps.servers
+
+
+def stop_server():
+    for s in _ps.servers:
+        s.stop()
+    _ps.servers = []
+
+
+def init_worker(dim=None, table_names=None):
+    """Connect worker-side clients. Endpoint semantics (matching
+    run_server's layout): PADDLE_PSERVERS_IP_PORT_LIST lists each HOST's
+    base endpoint; every host serves every table, table i (sorted by
+    name) on base_port + i — so the client for table i hash-shards keys
+    across {host:port+i}. Reference: each pserver holds a shard of every
+    table (`the_one_ps.py`). One table -> returns the PSClient; several
+    -> {name: PSClient}. The dim handshake makes any width mismatch fail
+    at connect time."""
+    import os
+    from .ps import PSClient
+    eps = [e for e in os.environ.get(
+        "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+    if not eps:
+        raise RuntimeError(
+            "init_worker: PADDLE_PSERVERS_IP_PORT_LIST is empty — the "
+            "trainer has no parameter servers configured")
+    if dim is None:
+        env_dim = os.environ.get("PADDLE_PS_TABLE_DIM")
+        if env_dim is None:
+            raise RuntimeError(
+                "init_worker: pass dim= or set PADDLE_PS_TABLE_DIM (the "
+                "wire protocol validates it against the server)")
+        dim = int(env_dim)
+    names = table_names or [n.strip() for n in os.environ.get(
+        "PADDLE_PS_TABLE_NAMES", "embedding").split(",") if n.strip()]
+    clients = {}
+    for i, name in enumerate(sorted(names)):
+        table_eps = []
+        for ep in eps:
+            host, port = ep.rsplit(":", 1)
+            table_eps.append(f"{host}:{int(port) + i}")
+        clients[name] = PSClient(table_eps, dim=dim)
+    _ps.client = clients[sorted(names)[0]] if len(names) == 1 else clients
+    return _ps.client
+
+
+def get_ps_client():
+    return _ps.client
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,8 @@ def _load():
         lib.pskv_client_push.argtypes = [ctypes.c_void_p, _i64p,
                                          ctypes.c_int64, _f32p]
         lib.pskv_client_close.argtypes = [ctypes.c_void_p]
+        lib.pskv_client_remote_dim.restype = ctypes.c_int32
+        lib.pskv_client_remote_dim.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -192,6 +194,15 @@ class PSClient:
             if not h:
                 raise OSError(f"cannot connect to ps server {ep}")
             self._conns.append(h)
+            # dim handshake: a silent mismatch would DEADLOCK the first
+            # pull (client blocks on n*dim_client floats, server sends
+            # n*dim_server) — fail loudly at connect time instead
+            remote = int(self._lib.pskv_client_remote_dim(h))
+            if remote > 0 and remote != dim:
+                self.close()
+                raise ValueError(
+                    f"ps server {ep} serves dim={remote}, client asked "
+                    f"dim={dim}")
 
     def _route(self, keys):
         k = np.asarray(keys, np.int64).ravel()

@@ -117,3 +117,87 @@ def test_geo_requires_sum_mode():
     t = SparseTable(dim=4, optimizer="sgd")
     with pytest.raises(ValueError):
         GeoCommunicator(t, [Tensor(np.zeros(4, np.float32))])
+
+
+def test_fleet_ps_mode_end_to_end(monkeypatch, tmp_path):
+    """fleet.init_server/run_server/init_worker over the real pskv runtime
+    (reference role-maker env contract)."""
+    from paddle_tpu.distributed import fleet as fl
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    assert fl.is_server() and not fl.is_worker()
+    fl.init_server(dim=4, optimizer="sum", init_range=0.0)
+    servers = fl.run_server(block=False)
+    try:
+        port = servers[0].port
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                           f"127.0.0.1:{port}")
+        monkeypatch.setenv("PADDLE_PS_TABLE_DIM", "4")
+        assert fl.is_worker()
+        cli = fl.init_worker()
+        cli.push([3, 9], np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(cli.pull([3, 9]), 1.0)
+        # save/restore through init_server(model_dir)
+        model_dir = str(tmp_path)
+        fl._ps.tables["embedding"].save(
+            str(tmp_path / "embedding.pskv"))
+        fl.stop_worker()
+    finally:
+        fl.stop_server()
+    # fresh server restores the table
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    fl.init_server(dim=4, optimizer="sum", init_range=0.0, model_dir=str(tmp_path))
+    assert len(fl._ps.tables["embedding"]) == 2
+    np.testing.assert_allclose(fl._ps.tables["embedding"].pull([3]), 1.0)
+
+
+def test_ps_client_dim_mismatch_fails_fast():
+    """A width mismatch used to deadlock the first pull; the dim
+    handshake turns it into a connect-time error."""
+    from paddle_tpu.distributed.ps import PSServer, PSClient
+    t = SparseTable(dim=4, optimizer="sum", init_range=0.0)
+    srv = PSServer(t, port=0)
+    try:
+        with pytest.raises(ValueError, match="dim"):
+            PSClient([f"127.0.0.1:{srv.port}"], dim=8)
+        cli = PSClient([f"127.0.0.1:{srv.port}"], dim=4)  # match is fine
+        np.testing.assert_allclose(cli.pull([1]), 0.0)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_fleet_multi_table_routing(monkeypatch):
+    """Every host serves every table (port base+i); per-table clients
+    route to the right table."""
+    from paddle_tpu.distributed import fleet as fl
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    fl.init_server(tables={
+        "ad": SparseTable(4, optimizer="sum", init_range=0.0),
+        "user": SparseTable(4, optimizer="sum", init_range=0.0)})
+    servers = fl.run_server(block=False)
+    try:
+        base = servers[0].port
+        # ports must be consecutive in sorted-name order for the layout
+        # contract; with ephemeral ports that's not guaranteed, so pin
+        # the mapping via the actual ports
+        ports = {name: s.port for name, s in
+                 zip(sorted(["ad", "user"]), servers)}
+        from paddle_tpu.distributed.ps import PSClient
+        ad = PSClient([f"127.0.0.1:{ports['ad']}"], dim=4)
+        user = PSClient([f"127.0.0.1:{ports['user']}"], dim=4)
+        ad.push([7], np.full((1, 4), 2.0, np.float32))
+        user.push([7], np.full((1, 4), 5.0, np.float32))
+        np.testing.assert_allclose(ad.pull([7]), 2.0)
+        np.testing.assert_allclose(user.pull([7]), 5.0)
+        ad.close(); user.close()
+    finally:
+        fl.stop_server()
+
+
+def test_init_worker_misconfig_raises(monkeypatch):
+    from paddle_tpu.distributed import fleet as fl
+    monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
+    with pytest.raises(RuntimeError, match="no parameter servers"):
+        fl.init_worker()
