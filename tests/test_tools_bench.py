@@ -24,7 +24,7 @@ def test_op_bench_runs_and_gate_passes(tmp_path):
     base = str(tmp_path / "base.json")
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
-         "--out", base, "--iters", "2", "--small"],
+         "--out", base, "--iters", "2", "--small", "--cpu"],
         env=env, capture_output=True, text=True, timeout=420)
     assert out.returncode == 0, out.stderr[-1500:]
     data = json.load(open(base))

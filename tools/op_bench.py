@@ -132,9 +132,16 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--small", action="store_true",
                     help="tiny shapes (CI smoke / CPU)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend in-process (the axon "
+                         "sitecustomize pins the platform, so an env var "
+                         "cannot; needed when the device tunnel is down "
+                         "or for hermetic CI)")
     args = ap.parse_args(argv)
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     results = {"_device": jax.devices()[0].device_kind}
     for name, case in _cases(args.small).items():
         ms = bench_case(_op_fn(case["op"]), case["args"], args.iters)
