@@ -178,3 +178,27 @@ def test_contrast_saturation_preserve_alpha():
         o = tr._apply_image(img)
         assert o.shape == (8, 8, 4)
         assert (o[..., 3] == 255).all(), type(tr).__name__
+
+
+def test_lstm_under_autocast_carry_dtype():
+    """Regression: LSTM/GRU scan carries must keep their dtype under
+    amp.auto_cast (bf16 x against f32 weights used to promote the carry
+    to f32 and fail scan type-checking; found by the OCR bench)."""
+    import numpy as np
+    from paddle_tpu import amp, nn
+
+    paddle.seed(0)
+    for cls, kwargs in ((nn.LSTM, {}), (nn.GRU, {}),
+                        (nn.SimpleRNN, {})):
+        net = cls(8, 12, num_layers=1, **kwargs)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 5, 8).astype(np.float32))
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            out = net(x)
+        out0 = out[0] if isinstance(out, (tuple, list)) else out
+        assert np.isfinite(out0.numpy().astype(np.float32)).all()
+        # numerics close to the f32 path (bf16 tolerance)
+        ref = net(x)
+        ref0 = ref[0] if isinstance(ref, (tuple, list)) else ref
+        np.testing.assert_allclose(out0.numpy().astype(np.float32),
+                                   ref0.numpy(), atol=0.1, rtol=0.15)
