@@ -53,13 +53,26 @@ def _time_train_steps(step, inputs, steps, warmup):
     return dt / steps, loss
 
 
-def _probe_backend(timeout_s=180):
+def _probe_backend(timeout_s=150, attempts=3):
     """Run a tiny computation in a SUBPROCESS with a hard timeout: a
     wedged TPU tunnel hangs at the first dispatch (observed in the wild),
-    and a hang here would eat the whole driver budget. Returns (ok,
-    reason). Uses Popen.wait (not run) so a child stuck UNINTERRUPTIBLE
-    in the device driver cannot block us past the grace period, and
-    surfaces the child's stderr when it dies for a non-timeout reason."""
+    and a hang here would eat the whole driver budget. The tunnel also
+    FLAPS on a minutes timescale, so the probe retries a few times
+    before declaring the backend down. Returns (ok, reason). Uses
+    Popen.wait (not run) so a child stuck UNINTERRUPTIBLE in the device
+    driver cannot block us past the grace period, and surfaces the
+    child's stderr when it dies for a non-timeout reason."""
+    reason = ""
+    for _ in range(attempts):
+        ok, reason = _probe_once(timeout_s)
+        if ok:
+            return True, ""
+        print(f"# probe attempt failed ({reason[:120]}); retrying",
+              file=sys.stderr)
+    return False, reason
+
+
+def _probe_once(timeout_s):
     import subprocess
     import tempfile
     code = ("import jax, jax.numpy as jnp;"
