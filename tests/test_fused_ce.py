@@ -104,3 +104,26 @@ def test_fused_ce_ignore_index_zero_loss_and_grad():
     ref = np.asarray(_ref(h, w, jnp.where(lbl < 0, 0, lbl)))
     np.testing.assert_allclose(np.asarray(loss)[keep], ref[keep],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_ce_inside_trainstep():
+    """Flag-on training through the fused op: the compiled TrainStep must
+    produce finite, decreasing loss and update the tied embedding."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.gpt import gpt_tiny_config, GPTForPretraining
+    rng = np.random.RandomState(5)
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny_config())
+    opt = optimizer.AdamW(learning_rate=5e-3, parameters=m.parameters())
+    ids = paddle.to_tensor(rng.randint(0, 256, (2, 32)), "int32")
+    lab = paddle.to_tensor(rng.randint(0, 256, (2, 32)), "int32")
+    try:
+        paddle.set_flags({"use_fused_ce": True})
+        step = paddle.jit.TrainStep(m, lambda i, y: m.loss(i, y), opt)
+        w0 = m.gpt.wte.weight.numpy().copy()
+        losses = [float(step(ids, lab).numpy()) for _ in range(8)]
+    finally:
+        paddle.set_flags({"use_fused_ce": False})
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]          # memorizes the fixed batch
+    assert np.abs(m.gpt.wte.weight.numpy() - w0).max() > 0
