@@ -1,0 +1,89 @@
+"""Partitioner-output assertions — the GSPMD analog of the reference's
+meta-optimizer tests that assert on the REWRITTEN PROGRAM's op list
+(`test_fleet_sharding_meta_optimizer.py`, `fleet_meta_optimizer_base.py`:
+cheap, deterministic, no numerics): here the 'rewritten program' is the
+placement the sharding annotations produce, so the assertions read the
+actual shardings of live arrays on an 8-virtual-device CPU mesh."""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import env
+
+
+def _spec(arr):
+    sh = arr.sharding
+    return tuple(sh.spec) if hasattr(sh, "spec") else None
+
+
+@pytest.fixture
+def mp_mesh():
+    mesh = env.build_mesh(dp=2, pp=1, mp=4, sp=1, ep=1)
+    yield mesh
+    env.clear_mesh()
+
+
+def test_tp_layer_placement(mp_mesh):
+    """Megatron placement: column-parallel splits the OUTPUT dim over mp,
+    row-parallel the INPUT dim, vocab-parallel embedding the vocab dim."""
+    paddle.seed(0)
+    col = dist.ColumnParallelLinear(16, 32)
+    row = dist.RowParallelLinear(32, 16)
+    emb = dist.VocabParallelEmbedding(64, 16)
+    model = paddle.nn.LayerList([col, row, emb])
+    dist.shard_model(model, mp_mesh)
+    assert _spec(col.weight._value) == (None, "mp")
+    assert _spec(row.weight._value) == ("mp", None)
+    assert _spec(emb.weight._value) == ("mp", None)
+    # shard shapes actually divide over the 4-way mp axis
+    assert col.weight._value.sharding.shard_shape(
+        col.weight._value.shape) == (16, 8)
+
+
+def test_gpt_tagged_placement(mp_mesh):
+    from paddle_tpu.models.gpt import gpt_tiny_config, GPTModel
+    paddle.seed(0)
+    m = GPTModel(gpt_tiny_config())
+    dist.shard_model(m, mp_mesh)
+    blk = m.blocks[0]
+    assert _spec(blk.attn.qkv_proj.weight._value) == (None, "mp")
+    assert _spec(blk.attn.out_proj.weight._value) == ("mp", None)
+    assert _spec(blk.mlp.fc1.weight._value) == (None, "mp")
+    assert _spec(blk.mlp.fc2.weight._value) == ("mp", None)
+    assert _spec(m.wte.weight._value) == ("mp", None)
+    # layernorm params replicated (no mp annotation)
+    ln_spec = _spec(blk.ln1.weight._value)
+    assert ln_spec is None or all(a is None for a in ln_spec)
+
+
+def test_zero_optimizer_state_dp_sharded(mp_mesh):
+    """ZeRO-1: optimizer moments shard over dp while params replicate
+    over dp (the sharding meta-optimizer's program assertion analog)."""
+    from paddle_tpu import optimizer
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 32)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=net.parameters())
+    step = dist.ShardedTrainStep(
+        net, lambda x, y: ((net(x) - y) ** 2).mean(), opt, zero_stage=1,
+        mesh=mp_mesh)
+    x = paddle.to_tensor(np.ones((8, 16), np.float32))
+    y = paddle.to_tensor(np.ones((8, 32), np.float32))
+    step(x, y)
+    st = opt._states[id(net.weight)]
+    m_spec = _spec(st["m"]) if isinstance(st, dict) and "m" in st else None
+    if m_spec is not None:
+        assert "dp" in [a for a in m_spec if a is not None] or \
+            st["m"].sharding.shard_shape(st["m"].shape) != tuple(
+                st["m"].shape), "opt state not dp-sharded under zero-1"
+    # params stay whole per dp rank
+    assert net.weight._value.shape == (16, 32)
+
+
+def test_batch_input_sharding(mp_mesh):
+    sh = env.batch_sharding(mp_mesh)
+    assert tuple(sh.spec) == ("dp",)
+    v = jax.device_put(np.zeros((8, 4), np.float32), sh)
+    assert v.sharding.shard_shape(v.shape) == (4, 4)
