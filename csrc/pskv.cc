@@ -378,7 +378,12 @@ void pskv_set_lr(void* tp, float lr) { static_cast<Table*>(tp)->lr = lr; }
 
 int64_t pskv_save(void* tp, const char* path) {
   auto* t = static_cast<Table*>(tp);
-  FILE* f = std::fopen(path, "wb");
+  // write-to-tmp + rename: a failed spill pread must never leave a
+  // truncated-but-valid-looking checkpoint at `path` for a later
+  // pskv_load to silently restore (same atomic-commit pattern as the
+  // Python-side status file)
+  std::string tmp_path = std::string(path) + ".tmp";
+  FILE* f = std::fopen(tmp_path.c_str(), "wb");
   if (!f) return -1;
   int64_t count = 0;
   size_t rf = t->row_floats();
@@ -398,6 +403,7 @@ int64_t pskv_save(void* tp, const char* path) {
                           (off_t)kv.second * rf * sizeof(float));
       if (r != (ssize_t)(rf * sizeof(float))) {
         std::fclose(f);
+        ::unlink(tmp_path.c_str());
         return -1;  // refuse to write a corrupt checkpoint
       }
       std::fwrite(&kv.first, sizeof(int64_t), 1, f);
@@ -405,7 +411,21 @@ int64_t pskv_save(void* tp, const char* path) {
       ++count;
     }
   }
-  std::fclose(f);
+  // ferror catches any fwrite that dropped bytes above; fsync makes the
+  // data durable before rename commits the name (else power loss can
+  // persist the rename but not the bytes)
+  int err = std::ferror(f);
+  int flush_rc = std::fflush(f);
+  int sync_rc = err || flush_rc ? -1 : ::fsync(::fileno(f));
+  int close_rc = std::fclose(f);
+  if (err || flush_rc != 0 || sync_rc != 0 || close_rc != 0) {
+    ::unlink(tmp_path.c_str());
+    return -1;
+  }
+  if (::rename(tmp_path.c_str(), path) != 0) {
+    ::unlink(tmp_path.c_str());
+    return -1;
+  }
   return count;
 }
 

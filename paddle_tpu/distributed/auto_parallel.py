@@ -135,9 +135,11 @@ def shard_tensor(x, process_mesh=None, shard_spec=None):
     if isinstance(x._value, jax.core.Tracer):
         x._value = jax.lax.with_sharding_constraint(x._value, sharding)
     else:
-        n_needed = int(np.prod([s for a, s in
-                                zip(pspec, process_mesh.mesh.devices.shape)
-                                if a is not None] or [1]))
+        n_needed = int(np.prod(
+            [process_mesh.mesh.shape[a] for entry in pspec
+             if entry is not None
+             for a in (entry if isinstance(entry, tuple) else (entry,))]
+            or [1]))
         if len(set(process_mesh.mesh.devices.reshape(-1).tolist())) >= \
                 n_needed:
             x._value = jax.device_put(x._value, sharding)

@@ -193,6 +193,15 @@ def run_server(block=True):
         init_server()
     stop_server()        # idempotent restart: never leak live listeners
     base_port = int(os.environ.get("PADDLE_PORT", "0"))
+    if not base_port and len(_ps.tables) > 1:
+        # ephemeral ports break the base_port+i layout contract that
+        # init_worker routes per-table clients by: consecutive kernel-
+        # assigned ports are NOT guaranteed, so a multi-table worker
+        # would connect to wrong or nonexistent ports
+        raise RuntimeError(
+            "run_server: PADDLE_PORT must be set when serving multiple "
+            f"tables ({sorted(_ps.tables)}); table i is served on "
+            "PADDLE_PORT+i and workers route by that layout")
     for i, (name, t) in enumerate(sorted(_ps.tables.items())):
         port = base_port + i if base_port else 0
         _ps.servers.append(PSServer(t, port=port))

@@ -62,45 +62,62 @@ class GraphTable:
     def build(self):
         """Finalize CSR. Called automatically by queries."""
         with self._lock:
-            if self._csr is not None:
-                return
-            if not self._edges:
-                self._csr = (np.zeros(1, np.int64),
-                             np.zeros(0, np.int64),
-                             np.zeros(0, np.int64))
-                self._id2row = {}
-                return
-            src = np.concatenate([s for s, _ in self._edges])
-            dst = np.concatenate([d for _, d in self._edges])
-            if not self.directed:
-                src, dst = (np.concatenate([src, dst]),
-                            np.concatenate([dst, src]))
-            node_ids = np.unique(np.concatenate([src, dst]))
-            id2row = {int(n): i for i, n in enumerate(node_ids)}
-            # node_ids is sorted (np.unique) -> vectorized row mapping
-            rows = np.searchsorted(node_ids, src)
-            order = np.argsort(rows, kind="stable")
-            rows, cols = rows[order], dst[order]
-            indptr = np.zeros(node_ids.size + 1, np.int64)
-            np.add.at(indptr, rows + 1, 1)
-            indptr = np.cumsum(indptr)
-            self._csr = (indptr, cols, node_ids)
-            self._id2row = id2row
+            self._build_locked()
+
+    def _snapshot(self):
+        """CSR + id map captured under the lock, so a concurrent
+        add_edges (which sets `_csr = None`) can't yank the arrays out
+        from under a running query — queries see the consistent
+        pre-update graph instead."""
+        with self._lock:
+            self._build_locked()
+            indptr, indices, node_ids = self._csr
+            return indptr, indices, node_ids, self._id2row
+
+    def _spawn_rng(self):
+        """Per-call RandomState forked (under the lock) from the shared
+        seed stream: RandomState is not thread-safe, and queries must be
+        callable concurrently — see _snapshot."""
+        with self._lock:
+            return np.random.RandomState(self._rng.randint(0, 2 ** 31))
+
+    def _build_locked(self):
+        if self._csr is not None:
+            return
+        if not self._edges:
+            self._csr = (np.zeros(1, np.int64),
+                         np.zeros(0, np.int64),
+                         np.zeros(0, np.int64))
+            self._id2row = {}
+            return
+        src = np.concatenate([s for s, _ in self._edges])
+        dst = np.concatenate([d for _, d in self._edges])
+        if not self.directed:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        node_ids = np.unique(np.concatenate([src, dst]))
+        id2row = {int(n): i for i, n in enumerate(node_ids)}
+        # node_ids is sorted (np.unique) -> vectorized row mapping
+        rows = np.searchsorted(node_ids, src)
+        order = np.argsort(rows, kind="stable")
+        rows, cols = rows[order], dst[order]
+        indptr = np.zeros(node_ids.size + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        self._csr = (indptr, cols, node_ids)
+        self._id2row = id2row
 
     # --------------------------------------------------------------- queries
     @property
     def n_nodes(self):
-        self.build()
-        return self._csr[2].size
+        return self._snapshot()[2].size
 
     @property
     def n_edges(self):
-        self.build()
-        return self._csr[1].size
+        return self._snapshot()[1].size
 
     def degree(self, nodes):
-        self.build()
-        indptr, _, node_ids = self._csr
+        indptr, _, node_ids, _ = self._snapshot()
         nodes = np.asarray(nodes, np.int64).ravel()
         if node_ids.size == 0:
             return np.zeros(nodes.size, np.int64)
@@ -113,12 +130,12 @@ class GraphTable:
         nodes with no (or too few, when replace=False) neighbors.
         Reference `random_sample_neighbors` returns variable-length
         buffers; fixed-shape + pad is the XLA-friendly equivalent."""
-        self.build()
-        indptr, indices, _ = self._csr
+        indptr, indices, _, id2row = self._snapshot()
+        rng = self._spawn_rng()
         nodes = np.asarray(nodes, np.int64).ravel()
         out = np.full((nodes.size, sample_size), -1, np.int64)
         for i, n in enumerate(nodes):
-            r = self._id2row.get(int(n))
+            r = id2row.get(int(n))
             if r is None:
                 continue
             lo, hi = indptr[r], indptr[r + 1]
@@ -126,20 +143,19 @@ class GraphTable:
             if deg == 0:
                 continue
             if replace:
-                sel = self._rng.randint(0, deg, size=sample_size)
+                sel = rng.randint(0, deg, size=sample_size)
                 out[i] = indices[lo + sel]
             else:
                 k = min(sample_size, deg)
-                sel = self._rng.choice(deg, size=k, replace=False)
+                sel = rng.choice(deg, size=k, replace=False)
                 out[i, :k] = indices[lo + sel]
         return out
 
     def random_sample_nodes(self, sample_size):
-        self.build()
-        ids = self._csr[2]
+        ids = self._snapshot()[2]
         if ids.size == 0:
             return np.zeros(0, np.int64)
-        idx = self._rng.randint(0, ids.size, size=sample_size)
+        idx = self._spawn_rng().randint(0, ids.size, size=sample_size)
         return ids[idx]
 
     def random_walk(self, start_nodes, walk_len):

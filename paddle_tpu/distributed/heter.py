@@ -28,7 +28,7 @@ class HeterServer:
     """Worker pool endpoint: registers named stage functions and serves
     them (reference `heter_server.cc` RegisterServiceHandler)."""
 
-    def __init__(self, host="127.0.0.1", port=0, kv=None):
+    def __init__(self, host="127.0.0.1", port=0, kv=None, lease_s=10.0):
         self._own = kv is None
         if kv is None:
             self._server = KVServer(port)
@@ -41,6 +41,13 @@ class HeterServer:
         self._handlers = {}
         self._stop = threading.Event()
         self._thread = None
+        self._lease_s = float(lease_s)
+        # local scan state: tids at or below _scanned[name] have been
+        # claim-attempted once; _pending holds tasks another worker owns,
+        # so steady-state polling costs O(outstanding), not O(history)
+        self._scanned = {}
+        self._pending = {}
+        self._hb_kv = None      # lazy dedicated heartbeat connection
 
     def register(self, name, fn):
         """fn: dict[str, np.ndarray] -> dict[str, np.ndarray]"""
@@ -62,43 +69,167 @@ class HeterServer:
                 # single shared claim counter has: the loser's increment
                 # pre-claims the next, not-yet-submitted task)
                 head = self._kv.add(f"__heter__/{name}/head", 0)
-                floor = self._kv.add(f"__heter__/{name}/done", 0)
-                for tid in range(floor + 1, head + 1):
+                if head < self._scanned.get(name, 0):
+                    # head went backwards: the store was purged/reset
+                    # between jobs — drop stale local scan state or new
+                    # small-tid tasks would never be claimed
+                    del self._scanned[name]
+                    for k in [k for k in self._pending if k[0] == name]:
+                        del self._pending[k]
+                if name not in self._scanned:
+                    served |= self._bootstrap_scan(name, head)
+                lo = self._scanned[name]
+                for tid in range(lo + 1, head + 1):
                     if self._kv.add(f"__heter__/{name}/claim/{tid}", 1) == 1:
                         self._run_one(name, tid)
-                        self._kv.add(f"__heter__/{name}/done", 1)
                         served = True
+                    else:
+                        # another worker owns it: watch its heartbeat so a
+                        # dead claimer's task is re-executed, not lost
+                        self._pending[(name, tid)] = \
+                            [time.monotonic() + self._lease_s, False, None]
+                self._scanned[name] = head
+                served |= self._check_pending(name)
             if not served:
                 time.sleep(poll_s)
 
+    def _bootstrap_scan(self, name, head):
+        """First poll for a stage (fresh or restarted server): recover
+        scan state in O(1) list RPCs instead of re-claiming the whole
+        tid history — finished tids are skipped, claimed-but-unfinished
+        ones go on the pending watch, untouched ones are claimed."""
+        served = False
+        pfx = f"__heter__/{name}/"
+
+        def _tids(sub):
+            out = set()
+            for key in self._kv.list(pfx + sub):
+                try:
+                    out.add(int(key.rsplit("/", 1)[1]))
+                except ValueError:
+                    pass
+            return out
+        fin, claimed = _tids("fin/"), _tids("claim/")
+        now = time.monotonic()
+        for tid in range(1, head + 1):
+            if tid in fin:
+                continue
+            if tid in claimed:
+                self._pending[(name, tid)] = [now + self._lease_s, False,
+                                              None]
+            elif self._kv.add(pfx + f"claim/{tid}", 1) == 1:
+                self._run_one(name, tid)
+                served = True
+            else:
+                self._pending[(name, tid)] = [now + self._lease_s, False,
+                                              None]
+        self._scanned[name] = head
+        return served
+
+    def _check_pending(self, name):
+        """Re-execute (once) tasks whose claimer died mid-run; after the
+        retry also goes quiet, publish a failure result so the waiting
+        client raises instead of timing out. Liveness is judged by the
+        heartbeat VALUE changing between polls (local monotonic timing),
+        never by comparing remote wall clocks — cross-host clock skew
+        must not trigger duplicate execution. At-least-once semantics: a
+        claimer that stalls past the lease without heartbeating may see
+        its task run twice."""
+        served = False
+        now = time.monotonic()
+        for (pname, tid), state in list(self._pending.items()):
+            if pname != name:
+                continue
+            deadline, reclaim_seen, last_hb = state
+            if self._kv.get(f"__heter__/{name}/fin/{tid}") is not None:
+                del self._pending[(name, tid)]       # completed elsewhere
+                continue
+            hb = self._kv.get(f"__heter__/{name}/hb/{tid}")
+            if hb is not None and hb != last_hb:
+                # beat observed since last poll -> owner is alive
+                state[0], state[2] = now + self._lease_s, hb
+                continue
+            if now < deadline:
+                continue            # grace: wait a full lease for a beat
+            if self._kv.add(f"__heter__/{name}/reclaim/{tid}", 1) == 1:
+                self._run_one(name, tid)
+                served = True
+                del self._pending[(name, tid)]
+            elif not reclaim_seen:
+                # another worker reclaimed; give its heartbeat a full
+                # lease to show up before declaring the task dead
+                state[0], state[1] = now + self._lease_s, True
+            elif self._kv.get(f"__heter__/{name}/fin/{tid}") is not None:
+                del self._pending[(name, tid)]       # finished after all
+            elif self._kv.add(f"__heter__/{name}/lost/{tid}", 1) == 1:
+                # claimer AND reclaimer both went quiet: fail the task so
+                # the waiting client raises instead of timing out
+                self._kv.set(f"__heter__/{name}/result/{tid}", pickle.dumps(
+                    {"ok": False,
+                     "error": "task lost: claimer and reclaimer both died"},
+                    protocol=4))
+                self._kv.set(f"__heter__/{name}/fin/{tid}", b"1")
+                del self._pending[(name, tid)]
+            else:
+                del self._pending[(name, tid)]        # another server failed it
+        return served
+
     def _run_one(self, name, tid):
         key = f"__heter__/{name}/task/{tid}"
-        # submit bumps the head counter BEFORE the task blob is visible;
-        # a fast claimer must wait for the payload, not drop the task
-        deadline = time.monotonic() + 5.0
-        blob = self._kv.get(key)
-        while blob is None and time.monotonic() < deadline:
-            time.sleep(0.002)
-            blob = self._kv.get(key)
-        if blob is None:
-            # a payload landing after this point stays in the store until
-            # HeterClient.purge(); the failure result tells the client
-            self._kv.set(f"__heter__/{name}/result/{tid}", pickle.dumps(
-                {"ok": False, "error": "task payload never arrived"},
-                protocol=4))
-            self._kv.delete(key)
-            return
+        # heartbeat under the lease while we hold the task, so peers can
+        # tell a slow stage from a dead claimer
+        hb_key = f"__heter__/{name}/hb/{tid}"
+        hb_stop = threading.Event()
+        # the heartbeat rides its OWN connection: KVClient is a single
+        # socket and not thread-safe against the serve loop's traffic
+        if self._hb_kv is None:
+            self._hb_kv = KVClient(getattr(self._kv, "host", "127.0.0.1"),
+                                   self._kv.port)
+        hb_kv = self._hb_kv
+
+        def _beat():
+            while not hb_stop.is_set():
+                hb_kv.set(hb_key, repr(time.time()).encode())
+                hb_stop.wait(self._lease_s / 3.0)
+        self._kv.set(hb_key, repr(time.time()).encode())
+        beater = threading.Thread(target=_beat, daemon=True)
+        beater.start()
         try:
-            inputs = pickle.loads(blob)
-            outputs = self._handlers[name](inputs)
-            payload = pickle.dumps(
-                {"ok": True, "outputs": outputs}, protocol=4)
-        except Exception as e:  # ship the error back, don't kill the pool
-            payload = pickle.dumps(
-                {"ok": False, "error": f"{type(e).__name__}: {e}"},
-                protocol=4)
-        self._kv.set(f"__heter__/{name}/result/{tid}", payload)
-        self._kv.delete(key)
+            # submit bumps the head counter BEFORE the task blob is visible;
+            # a fast claimer must wait for the payload, not drop the task
+            deadline = time.monotonic() + 5.0
+            blob = self._kv.get(key)
+            while blob is None and time.monotonic() < deadline:
+                time.sleep(0.002)
+                blob = self._kv.get(key)
+            if blob is None:
+                # a payload landing after this point stays in the store
+                # until HeterClient.purge(); the failure result tells the
+                # client
+                payload = pickle.dumps(
+                    {"ok": False, "error": "task payload never arrived"},
+                    protocol=4)
+            else:
+                try:
+                    inputs = pickle.loads(blob)
+                    outputs = self._handlers[name](inputs)
+                    payload = pickle.dumps(
+                        {"ok": True, "outputs": outputs}, protocol=4)
+                except Exception as e:  # ship the error; don't kill the pool
+                    payload = pickle.dumps(
+                        {"ok": False, "error": f"{type(e).__name__}: {e}"},
+                        protocol=4)
+            self._kv.set(f"__heter__/{name}/result/{tid}", payload)
+            self._kv.set(f"__heter__/{name}/fin/{tid}", b"1")
+            self._kv.delete(key)
+        finally:
+            hb_stop.set()
+            beater.join(timeout=1)
+            if beater.is_alive():
+                # beater stuck inside a blocking hb_kv call: abandon the
+                # connection rather than let the NEXT task's beater share
+                # the socket with it (KVClient is not thread-safe)
+                self._hb_kv = None
 
     def stop(self):
         self._stop.set()

@@ -63,6 +63,46 @@ def test_heter_two_workers_share_queue():
         srv1.stop()
 
 
+def test_heter_dead_claimer_task_is_reexecuted():
+    """A task whose claimer died (claim key consumed, no heartbeat, no
+    result) must be re-executed by a live worker after the lease, not
+    lost (reference heter_server keeps the brpc queue durable)."""
+    from paddle_tpu.distributed.kvstore import KVClient
+    srv = HeterServer(port=0, lease_s=0.3)
+    srv.register("st", lambda t: {"y": t["x"] + 1})
+    kv = KVClient(port=srv.port)
+    # simulate a worker that claimed tid 1 and died before heartbeating
+    assert kv.add("__heter__/st/claim/1", 1) == 1
+    cli = HeterClient(port=srv.port)
+    h = cli.submit("st", {"x": np.zeros(2, np.float32)})
+    assert h[1] == 1
+    srv.start()
+    try:
+        out = cli.wait(h, timeout_s=10.0)
+        np.testing.assert_allclose(out["y"], 1.0)
+    finally:
+        srv.stop()
+
+
+def test_heter_lost_twice_surfaces_failure():
+    """claimer AND reclaimer dead -> client gets a raised failure, not a
+    silent timeout."""
+    from paddle_tpu.distributed.kvstore import KVClient
+    srv = HeterServer(port=0, lease_s=0.2)
+    srv.register("st", lambda t: {"y": t["x"]})
+    kv = KVClient(port=srv.port)
+    assert kv.add("__heter__/st/claim/1", 1) == 1    # dead claimer
+    assert kv.add("__heter__/st/reclaim/1", 1) == 1  # dead reclaimer
+    cli = HeterClient(port=srv.port)
+    h = cli.submit("st", {"x": np.zeros(1, np.float32)})
+    srv.start()
+    try:
+        with pytest.raises(RuntimeError, match="task lost"):
+            cli.wait(h, timeout_s=10.0)
+    finally:
+        srv.stop()
+
+
 def test_unique_name_guard():
     un = paddle.utils.unique_name
     a = un.generate("w")

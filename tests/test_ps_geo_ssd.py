@@ -171,17 +171,31 @@ def test_ps_client_dim_mismatch_fails_fast():
 def test_fleet_multi_table_routing(monkeypatch):
     """Every host serves every table (port base+i); per-table clients
     route to the right table."""
+    import socket
     from paddle_tpu.distributed import fleet as fl
     monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
     fl.init_server(tables={
         "ad": SparseTable(4, optimizer="sum", init_range=0.0),
         "user": SparseTable(4, optimizer="sum", init_range=0.0)})
+    # run_server requires an explicit PADDLE_PORT for multi-table
+    # layouts (base+i contract); find a consecutive free pair
+    base = None
+    for _ in range(20):
+        s0, s1 = socket.socket(), socket.socket()
+        try:
+            s0.bind(("127.0.0.1", 0))
+            cand = s0.getsockname()[1]
+            s1.bind(("127.0.0.1", cand + 1))
+            base = cand
+            break
+        except OSError:
+            continue
+        finally:
+            s0.close(); s1.close()
+    assert base is not None, "no consecutive free port pair found"
+    monkeypatch.setenv("PADDLE_PORT", str(base))
     servers = fl.run_server(block=False)
     try:
-        base = servers[0].port
-        # ports must be consecutive in sorted-name order for the layout
-        # contract; with ephemeral ports that's not guaranteed, so pin
-        # the mapping via the actual ports
         ports = {name: s.port for name, s in
                  zip(sorted(["ad", "user"]), servers)}
         from paddle_tpu.distributed.ps import PSClient
@@ -201,3 +215,19 @@ def test_init_worker_misconfig_raises(monkeypatch):
     monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
     with pytest.raises(RuntimeError, match="no parameter servers"):
         fl.init_worker()
+
+
+def test_run_server_multi_table_requires_port(monkeypatch):
+    """Ephemeral ports break the base_port+i routing contract, so
+    run_server must refuse them for multi-table layouts."""
+    from paddle_tpu.distributed import fleet as fl
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.delenv("PADDLE_PORT", raising=False)
+    fl.init_server(tables={
+        "a": SparseTable(2, optimizer="sum", init_range=0.0),
+        "b": SparseTable(2, optimizer="sum", init_range=0.0)})
+    try:
+        with pytest.raises(RuntimeError, match="PADDLE_PORT"):
+            fl.run_server(block=False)
+    finally:
+        fl.stop_server()
