@@ -41,7 +41,13 @@ class DistributedStrategy:
         self.dgc = False
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
-        self.fp16_allreduce = False
+        # fp16_allreduce is obviated on TPU: the gradient allreduce is
+        # emitted by GSPMD inside the compiled backward, and its dtype
+        # follows the gradient dtype — turn on `amp` (bf16) to get a
+        # reduced-precision gradient exchange. The attribute survives for
+        # API parity but refuses True (see the property below) instead of
+        # being silently accepted-and-ignored.
+        self._fp16_allreduce = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.find_unused_parameters = False
@@ -54,8 +60,24 @@ class DistributedStrategy:
         self.elastic = False
         self.auto = False
 
+    @property
+    def fp16_allreduce(self):
+        return self._fp16_allreduce
+
+    @fp16_allreduce.setter
+    def fp16_allreduce(self, value):
+        if value:
+            raise ValueError(
+                "fp16_allreduce is not a separate switch on TPU: the "
+                "gradient allreduce is fused into the compiled backward "
+                "by GSPMD and its precision follows the gradient dtype. "
+                "Set strategy.amp = True (bf16 policy) to reduce "
+                "gradient-exchange precision; reference analog "
+                "fp16_allreduce_optimizer.py is obviated by that design.")
+        self._fp16_allreduce = False
+
     def __repr__(self):
-        fields = {k: v for k, v in self.__dict__.items()
+        fields = {k.lstrip("_"): v for k, v in self.__dict__.items()
                   if not k.endswith("_configs")}
         return f"DistributedStrategy({fields})"
 

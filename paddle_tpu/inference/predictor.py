@@ -8,6 +8,8 @@ copy_to_cpu); the engine underneath is the XLA-compiled StableHLO module, so
 config knobs that select the reference's GPU/TensorRT/MKLDNN backends are
 accepted for compatibility and ignored.
 """
+import warnings
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -16,7 +18,12 @@ from .export import load_inference_model
 
 class Config:
     """AnalysisConfig analog. `Config(model_path)` points at the artifact
-    written by save_inference_model (without extension)."""
+    written by save_inference_model (without extension).
+
+    Engine-selection switches from the reference (TensorRT, MKLDNN, IR
+    pass toggles) have no effect here — the engine is always the
+    XLA-compiled StableHLO module — so each one emits a UserWarning
+    saying so instead of being silently swallowed."""
 
     def __init__(self, prog_file=None, params_file=None):
         self.model_path = prog_file
@@ -24,27 +31,43 @@ class Config:
         self._use_tpu = True
         self._memory_pool_mb = 0
 
+    @staticmethod
+    def _ignored(switch, why):
+        warnings.warn(
+            f"Config.{switch} has no effect in paddle_tpu: {why}",
+            UserWarning, stacklevel=3)
+
     # --- compatibility switches (engine selection is XLA's job) ---
     def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
         self._memory_pool_mb = memory_pool_init_size_mb
+        self._ignored("enable_use_gpu",
+                      "the predictor runs on the JAX default backend "
+                      "(TPU when available); there is no CUDA engine")
 
     def disable_gpu(self):
         self._use_tpu = False
 
     def enable_tensorrt_engine(self, **kwargs):
-        pass
+        self._ignored("enable_tensorrt_engine",
+                      "subgraph engines are replaced by whole-program "
+                      "XLA compilation")
 
     def enable_mkldnn(self):
-        pass
+        self._ignored("enable_mkldnn",
+                      "CPU kernels come from XLA:CPU, not oneDNN")
 
     def switch_ir_optim(self, flag=True):
-        pass
+        self._ignored("switch_ir_optim",
+                      "graph optimization is XLA's pass pipeline and is "
+                      "always on")
 
     def enable_memory_optim(self):
-        pass
+        self._ignored("enable_memory_optim",
+                      "buffer liveness/reuse is handled by XLA")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        self._ignored("set_cpu_math_library_num_threads",
+                      "thread pools are owned by the XLA runtime")
 
     def model_dir(self):
         return self.model_path

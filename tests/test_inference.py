@@ -106,3 +106,25 @@ def test_multi_input_shared_batch_dim(tmp_path):
     m2 = paddle.jit.load(p)
     a, b = paddle.randn([5, 8]), paddle.randn([5, 8])
     assert np.allclose(m2(a, b).numpy(), model(a, b).numpy(), atol=1e-5)
+
+
+def test_config_warns_on_ignored_engine_switches():
+    """Engine-selection switches must not be silently swallowed: each
+    inert reference switch emits a UserWarning naming itself."""
+    import warnings as _w
+    from paddle_tpu import inference
+    cfg = inference.Config("unused")
+    for call, args in [("enable_tensorrt_engine", {}),
+                       ("enable_mkldnn", {}),
+                       ("switch_ir_optim", {}),
+                       ("enable_memory_optim", {}),
+                       ("enable_use_gpu", {})]:
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            getattr(cfg, call)(**args)
+        assert any(call in str(r.message) for r in rec), call
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        cfg.set_cpu_math_library_num_threads(4)
+    assert any("set_cpu_math_library_num_threads" in str(r.message)
+               for r in rec)
