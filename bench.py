@@ -53,23 +53,31 @@ def _time_train_steps(step, inputs, steps, warmup):
     return dt / steps, loss
 
 
-def _probe_backend(timeout_s=150, attempts=3):
+def _probe_backend(budget_s=90):
     """Run a tiny computation in a SUBPROCESS with a hard timeout: a
     wedged TPU tunnel hangs at the first dispatch (observed in the wild),
     and a hang here would eat the whole driver budget. The tunnel also
-    FLAPS on a minutes timescale, so the probe retries a few times
-    before declaring the backend down. Returns (ok, reason). Uses
-    Popen.wait (not run) so a child stuck UNINTERRUPTIBLE in the device
-    driver cannot block us past the grace period, and surfaces the
-    child's stderr when it dies for a non-timeout reason."""
+    FLAPS on a minutes timescale, so the probe retries while the TOTAL
+    budget (~90s — a dead tunnel must not cost more than that) lasts.
+    Returns (ok, reason). Uses Popen.wait (not run) so a child stuck
+    UNINTERRUPTIBLE in the device driver cannot block us past the grace
+    period, and surfaces the child's stderr when it dies for a
+    non-timeout reason."""
+    deadline = time.monotonic() + budget_s
     reason = ""
-    for _ in range(attempts):
-        ok, reason = _probe_once(timeout_s)
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            return False, reason or "probe budget exhausted"
+        ok, reason = _probe_once(min(45, remaining))
         if ok:
             return True, ""
-        print(f"# probe attempt failed ({reason[:120]}); retrying",
+        print(f"# probe attempt failed ({reason[:120]}); "
+              f"{max(0, deadline - time.monotonic()):.0f}s budget left",
               file=sys.stderr)
-    return False, reason
+        # a fast deterministic failure (broken env) must not spin dozens
+        # of subprocesses; the tunnel flaps on a minutes timescale anyway
+        time.sleep(min(10, max(0, deadline - time.monotonic())))
 
 
 def _probe_once(timeout_s):
@@ -97,7 +105,16 @@ def _probe_once(timeout_s):
 
 
 def main():
-    ok, reason = _probe_backend()
+    force_cpu = "--cpu" in sys.argv[1:]
+    if force_cpu:
+        # hermetic smoke run (CI / no tunnel): tiny shapes, no probe.
+        # jax.config (not env) because the axon sitecustomize pins
+        # jax_platforms=axon.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ok, reason = True, ""
+    else:
+        ok, reason = _probe_backend()
     if not ok:
         print(json.dumps({
             "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
@@ -151,6 +168,7 @@ def main():
     mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
 
     resnet = bench_resnet50(on_tpu, peak)
+    layer13 = bench_gpt1_3b_layer(on_tpu, peak)
 
     print(json.dumps({
         "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
@@ -159,6 +177,8 @@ def main():
         "vs_baseline": round(mfu / 0.40, 4),
         "resnet50_images_per_sec_per_chip": resnet["images_per_sec"],
         "resnet50_mfu": resnet["mfu"],
+        "gpt1_3b_layer_tokens_per_sec": layer13["tokens_per_sec"],
+        "gpt1_3b_layer_mfu": layer13["mfu"],
     }))
     print(f"# device={dev.device_kind} loss={loss.item():.4f} "
           f"mfu={mfu:.3f} params={n_params/1e6:.1f}M "
@@ -201,6 +221,48 @@ def bench_resnet50(on_tpu, peak):
     ips = batch / sec_per_step
     mfu = (ips * 3 * 4.089e9 / peak) if peak else 0.0
     return {"images_per_sec": round(ips, 1), "mfu": round(mfu, 4)}
+
+
+def bench_gpt1_3b_layer(on_tpu, peak):
+    """One transformer block at TRUE gpt3_1_3b dims (hidden 2048, ffn
+    8192, 16 heads) fwd+bwd+SGD on one chip — the first on-hardware
+    evidence behind the >=40%-MFU-at-1.3B north star: per-layer MFU at
+    real dims upper-bounds what the full 24-layer model can reach once
+    sharded (BASELINE.md config 5; the full model needs the pod slice).
+    Same chained-on-donated-params timing discipline as the GPT phase."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.gpt import GPTConfig, GPTBlock
+
+    cfg = GPTConfig.gpt3_1_3b(max_seq_len=2048, dropout=0.0,
+                              attn_dropout=0.0)
+    if on_tpu:
+        batch, seq, steps, warmup = 8, 2048, 15, 3
+    else:
+        batch, seq, steps, warmup = 1, 128, 2, 1
+
+    paddle.seed(0)
+    model = GPTBlock(cfg)
+    opt = optimizer.SGD(learning_rate=1e-6,
+                        parameters=model.parameters())
+
+    def loss_fn(x):
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return model(x).mean()
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rs.randn(batch, seq, cfg.hidden_size).astype(np.float32) * 0.02)
+
+    sec_per_step, _ = _time_train_steps(step, (x,), steps, warmup)
+    tokens_per_sec = batch * seq / sec_per_step
+    h = cfg.hidden_size
+    layer_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * layer_params + 12 * h * seq
+    mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
+    return {"tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4)}
 
 
 if __name__ == "__main__":

@@ -117,6 +117,14 @@ class ShardedTrainStep:
         self._place_states()
         self._jitted = None
         self._donate = donate
+        if self.offload:
+            # static per instance: precompute both memory-kind variants
+            # so the per-step H2D/D2H hops don't rebuild NamedShardings
+            # on the dispatch hot path
+            self._host_state_sh = [self._state_sharding(p)
+                                   for p in self.params]
+            self._dev_state_sh = [self._state_sharding(p, device=True)
+                                  for p in self.params]
 
     def _param_sharding(self, p):
         extra = "dp" if self.zero_stage >= 3 else None
@@ -213,11 +221,11 @@ class ShardedTrainStep:
             # update (device_put returns immediately; the transfer
             # overlaps the batch sharding / dispatch work above)
             opt_states = [
-                {k: jax.device_put(v, self._state_sharding(p, device=True))
+                {k: jax.device_put(v, dsh)
                  if getattr(getattr(v, "sharding", None), "memory_kind",
                             None) == "pinned_host" else v
                  for k, v in st.items()}
-                for p, st in zip(self.params, opt_states)]
+                for dsh, st in zip(self._dev_state_sh, opt_states)]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
         loss, new_vals, new_states, new_buf, checks = self._jitted(
@@ -226,10 +234,11 @@ class ShardedTrainStep:
             # async D2H: evict the updated states back to pinned_host so
             # HBM is free of them between steps
             new_states = [
-                {k: jax.device_put(v, self._state_sharding(p))
+                {k: jax.device_put(v, hsh)
                  if np.shape(v) == tuple(nv.shape) else v
                  for k, v in st.items()}
-                for p, nv, st in zip(self.params, new_vals, new_states)]
+                for hsh, nv, st in zip(self._host_state_sh, new_vals,
+                                       new_states)]
         for p, v in zip(self.params, new_vals):
             p._value = v
             p.grad = None

@@ -39,13 +39,16 @@ class Config:
 
     # --- compatibility switches (engine selection is XLA's job) ---
     def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
-        self._memory_pool_mb = memory_pool_init_size_mb
         self._ignored("enable_use_gpu",
                       "the predictor runs on the JAX default backend "
                       "(TPU when available); there is no CUDA engine")
 
     def disable_gpu(self):
         self._use_tpu = False
+        self._ignored("disable_gpu",
+                      "backend selection is fixed at process start (JAX "
+                      "platform); run with jax_platforms=cpu to serve "
+                      "on CPU")
 
     def enable_tensorrt_engine(self, **kwargs):
         self._ignored("enable_tensorrt_engine",
