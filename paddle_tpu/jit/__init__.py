@@ -2,12 +2,16 @@
 
 TPU-native replacement for the reference's dynamic-to-static subsystem
 (`python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:768`,
-15+ AST transformers, `partial_program.py` run_program_op). No AST rewriting
-is needed: the eager Tensor ops *are* traceable jax computations, so
-`to_static` simply binds Layer parameters/buffers as traced inputs and runs
-the Python function under `jax.jit`. The autograd tape records at trace time,
-so a whole train step (forward+backward+optimizer) compiles into ONE fused
-XLA program — `TrainStep` packages that pattern.
+15+ AST transformers, `partial_program.py` run_program_op). The eager
+Tensor ops *are* traceable jax computations, so `to_static` binds Layer
+parameters/buffers as traced inputs and runs the Python function under
+`jax.jit`; the autograd tape records at trace time, so a whole train step
+(forward+backward+optimizer) compiles into ONE fused XLA program —
+`TrainStep` packages that pattern. One AST pass remains
+(`dy2static.convert_dynamic`): tensor-dependent Python `if`/`while`/`for`
+and bool-ops are rewritten to dispatch into `static.control_flow`, which
+lowers to native XLA control flow instead of the reference's sub-block
+programs.
 """
 import contextlib
 import functools
@@ -101,7 +105,10 @@ class StaticFunction:
     """Compiled wrapper of a python function / Layer forward."""
 
     def __init__(self, function, layer=None, input_spec=None):
-        self._fn = function
+        self._orig_fn = function
+        self._fn = None     # AST-converted lazily at first call: by then
+        # late-defined module globals and closure cells (e.g. super()'s
+        # __class__, filled only after the class body completes) exist
         self._layer = layer if layer is not None else getattr(
             function, "__self__", None)
         from ..nn.layer.layers import Layer
@@ -123,14 +130,23 @@ class StaticFunction:
         return params, buffers
 
     def __call__(self, *args, **kwargs):
+        if self._fn is None:
+            # reference ProgramTranslator order: AST transform, then
+            # trace — tensor-dependent if/while/for/bool-ops dispatch
+            # into static.control_flow; plain Python keeps its semantics
+            from .dy2static import convert_dynamic
+            self._fn = convert_dynamic(self._orig_fn)
         params, buffers = self._collect_state()
-        dyn_vals, rebuild, key = _split_args(args)
+        # args AND kwargs flatten together: kwarg tensor values become
+        # traced inputs and non-tensor kwarg values are part of the cache
+        # key (same keys with different values must not replay a stale
+        # trace)
+        dyn_vals, rebuild, key = _split_args((args, kwargs))
         # amp state is read at trace time; a toggled auto_cast context must
         # not silently reuse a trace made under the other policy
         from ..amp import amp_state
         st = amp_state()
-        cache_key = (key, tuple(sorted(kwargs)) if kwargs else (),
-                     st.enabled, str(st.dtype) if st.enabled else "")
+        cache_key = (key, st.enabled, str(st.dtype) if st.enabled else "")
 
         jitted = self._jit_cache.get(cache_key)
         if jitted is None:
@@ -140,8 +156,8 @@ class StaticFunction:
                 with autograd.fresh_tape(), autograd.no_grad(), \
                         bind_tensors(params, param_vals), \
                         bind_tensors(buffers, buffer_vals), rng_guard(rng):
-                    rebuilt = rebuild(arg_vals)
-                    out = fn(*rebuilt, **kwargs)
+                    rb_args, rb_kwargs = rebuild(arg_vals)
+                    out = fn(*rb_args, **rb_kwargs)
                     new_buf = [b._value for b in buffers]
                     return _unwrap_out(out), new_buf
 
@@ -149,8 +165,17 @@ class StaticFunction:
             self._jit_cache[cache_key] = jitted
 
         rng = default_generator().split()
-        out_vals, new_buf = jitted([p._value for p in params],
-                                   [b._value for b in buffers], rng, dyn_vals)
+        try:
+            out_vals, new_buf = jitted([p._value for p in params],
+                                       [b._value for b in buffers], rng,
+                                       dyn_vals)
+        except Exception as e:
+            from .dy2static import friendly_trace_error
+            friendly = friendly_trace_error(
+                e, getattr(self._fn, "__name__", "function"))
+            if friendly is not None:
+                raise friendly from e
+            raise
         for b, v in zip(buffers, new_buf):
             b._value = v
         return _wrap_out(out_vals)
@@ -370,3 +395,7 @@ def save(layer, path, input_spec=None, **configs):
 def load(path, **configs):
     from ..inference.export import load_inference_model
     return load_inference_model(path)
+
+
+from .dy2static import (  # noqa: E402,F401  (public dy2static surface)
+    Dy2StaticError, convert_dynamic, max_loop_iterations)

@@ -1,0 +1,704 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference surface: the dygraph_to_static transpiler —
+`python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:768`
+(ProgramTranslator), `ifelse_transformer.py:1`, `loop_transformer.py:1`,
+`logical_transformer.py:1`. The reference rewrites user `if`/`while`/`for`
+over tensors into `cond`/`while_loop` layers; trace-based `to_static`
+cannot see Python control flow at all, so without this pass a tensor
+condition surfaces as a raw TracerBoolConversionError.
+
+TPU-native shape: same AST rewriting idea, but the targets are the
+`paddle_tpu.static.control_flow` primitives, which lower to `lax.cond` /
+`lax.while_loop` / bounded differentiable scans — so one converted
+function traces into ONE XLA program with native control flow, instead
+of the reference's sub-block programs.
+
+The rewrite is CONSERVATIVE and semantics-preserving:
+- every rewritten construct dispatches at runtime (`convert_ifelse`,
+  `convert_while`): Python-bool conditions run exactly the branch Python
+  would, tensor conditions route into control_flow;
+- constructs the functional form cannot express faithfully (return /
+  break / continue inside the branch or loop body, global/nonlocal
+  declarations) are left as plain Python — correct for Python-valued
+  conditions, and producing a *diagnostic* (naming file:line) when a
+  tensor condition reaches them under trace.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+
+
+class Dy2StaticError(RuntimeError):
+    """Conversion/diagnostic error carrying the original source line."""
+
+
+class _Undefined:
+    """Sentinel for variables not yet bound before a converted branch.
+    Any real USE of it (arithmetic, truth test, attribute access, call,
+    iteration, str) raises like Python's UnboundLocalError would — it
+    must never silently flow through a computation."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "dy2static: a variable left unassigned by the untaken branch "
+            "of a converted `if` (or by a zero-iteration loop) was used; "
+            "assign it on every path before use")
+
+    __bool__ = __call__ = __iter__ = __len__ = __getattr__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __matmul__ = __rmatmul__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __getitem__ = __str__ = _raise
+    __neg__ = __abs__ = __float__ = __int__ = __index__ = _raise
+
+
+UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    from ..core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _is_tensorish(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, (Tensor, jax.Array)) or _is_traced(x)
+
+
+def _loc(fn_name, lineno, filename):
+    return f"{filename}:{lineno} (in {fn_name})"
+
+
+# --------------------------------------------------------------- runtime
+# These are the functions the rewritten AST calls. They must preserve
+# plain-Python semantics exactly when no tensor is involved.
+
+def convert_ifelse(pred, true_fn, false_fn, vals, names, loc):
+    from ..core.tensor import Tensor
+    if isinstance(pred, Tensor) or isinstance(pred, jax.Array) \
+            or _is_traced(pred):
+        from ..static import control_flow
+
+        def _checked(fn, which):
+            # UNDEF may flow IN (var defined inside both branches is the
+            # canonical pattern); it must not flow OUT of either branch,
+            # because both branches' outputs join under lax.cond
+            def run():
+                out = tuple(fn(*vals))
+                bad = [n for n, v in zip(names, out) if v is UNDEF]
+                if bad:
+                    raise Dy2StaticError(
+                        f"{loc}: variable(s) {bad} are not assigned by "
+                        f"the {which} branch of this tensor-valued `if`; "
+                        "under compiled control flow both branches must "
+                        "produce every joined variable — assign it in "
+                        "both branches or before the `if`")
+                return out
+            return run
+        out = control_flow.cond(pred, _checked(true_fn, "true"),
+                                _checked(false_fn, "false"))
+        return tuple(out)
+    return true_fn(*vals) if pred else false_fn(*vals)
+
+
+def convert_while(cond_fn, body_fn, vals, names, loc, max_iter=None):
+    first = cond_fn(*vals)
+    if _is_tensorish(first):
+        from ..static import control_flow
+        for n, v in zip(names, vals):
+            if v is UNDEF:
+                raise Dy2StaticError(
+                    f"{loc}: variable {n!r} is used by a tensor-valued "
+                    "`while` but not defined before the loop")
+        try:
+            out = control_flow.while_loop(
+                cond_fn, lambda *vs: list(body_fn(*vs)), list(vals),
+                maximum_iterations=max_iter)
+        except ValueError as e:
+            if "maximum_iterations" in str(e):
+                raise Dy2StaticError(
+                    f"{loc}: this tensor-valued `while` needs gradients, "
+                    "which requires a static bound; call the function "
+                    "under paddle_tpu.jit.max_loop_iterations(N) or "
+                    "rewrite with static.control_flow.while_loop("
+                    "maximum_iterations=N)") from e
+            raise
+        except TypeError as e:
+            if "carry" in str(e):
+                raise Dy2StaticError(
+                    f"{loc}: a loop variable of this tensor-valued "
+                    "`while` changes shape/dtype across iterations "
+                    "(e.g. broadcast growth on the first pass); compiled "
+                    "loops need stable carries — initialize it at its "
+                    f"final shape. XLA detail: {str(e)[:300]}") from e
+            raise
+        return tuple(out)
+    vals = tuple(vals)
+    while cond_fn(*vals):
+        vals = tuple(body_fn(*vals))
+    return vals
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tensorish(lhs):
+        from ..tensor import logical_and
+        return logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()            # preserves short-circuit + value
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tensorish(lhs):
+        from ..tensor import logical_or
+        return logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensorish(x):
+        from ..tensor import logical_not
+        return logical_not(x)
+    return not x
+
+
+def range_cond(i, stop, step):
+    """Direction-aware `for ... in range(...)` continuation test."""
+    if _is_tensorish(i) or _is_tensorish(stop) or _is_tensorish(step):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+
+        def raw(x):
+            return x._value if isinstance(x, Tensor) else x
+        return Tensor(jnp.where(raw(step) > 0, raw(i) < raw(stop),
+                                raw(i) > raw(stop)))
+    return i < stop if step > 0 else i > stop
+
+
+class _MaxIter:
+    value = None
+
+
+def max_loop_iterations(n):
+    """Context manager: bound for differentiable tensor `while` loops
+    converted by dy2static (lowered to a masked scan of length n)."""
+    class _Ctx:
+        def __enter__(self):
+            self._old = _MaxIter.value
+            _MaxIter.value = int(n)
+            return self
+
+        def __exit__(self, *exc):
+            _MaxIter.value = self._old
+            return False
+    return _Ctx()
+
+
+def _current_max_iter():
+    return _MaxIter.value
+
+
+# --------------------------------------------------------------- analysis
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names (re)bound by a list of statements, at THIS function scope —
+    does not descend into nested function/class scopes for their
+    internals, but records the nested def's own name."""
+
+    def __init__(self):
+        self.names = set()
+        self.blockers = []              # constructs we refuse to convert
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        # Attribute/Subscript targets mutate objects, not names
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+
+    def visit_NamedExpr(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._target(item.optional_vars)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)       # the name binds; skip the body
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass                            # inner scope
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.names.add((a.asname or a.name).split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            self.names.add(a.asname or a.name)
+
+    def visit_Return(self, node):
+        self.blockers.append(("return", node.lineno))
+
+    def visit_Break(self, node):
+        self.blockers.append(("break", node.lineno))
+
+    def visit_Continue(self, node):
+        self.blockers.append(("continue", node.lineno))
+
+    def visit_Global(self, node):
+        self.blockers.append(("global", node.lineno))
+
+    def visit_Nonlocal(self, node):
+        self.blockers.append(("nonlocal", node.lineno))
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+        self.generic_visit(node)
+
+
+def _loaded(nodes):
+    v = _LoadedNames()
+    for n in nodes:
+        v.visit(n)
+    return v.names
+
+
+def _is_generated_fn_name(n):
+    """Generated BRANCH-FUNCTION names must never become loop/branch
+    carries (they are function objects); generated counters/bounds
+    (__dy2st_cnt_*, ...) are legitimate data and must be carried."""
+    return n.startswith(("__dy2st_true_", "__dy2st_false_",
+                         "__dy2st_cond_", "__dy2st_body_"))
+
+
+# ------------------------------------------------------------ transformer
+
+# runtime-helper namespace symbol; injected into the defining module's
+# REAL globals (setdefault) so the rewritten code sees late-defined
+# module names exactly like the original would — a snapshot copy would
+# freeze the namespace at decoration time
+_H = "__dy2st_helpers__"
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _helper(attr):
+    return ast.Attribute(value=_name(_H), attr=attr, ctx=ast.Load())
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _undef_guard(name):
+    """try: name \n except NameError/UnboundLocalError: name = _jst.UNDEF"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(name))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_name(name, ast.Store())],
+                             value=_helper("UNDEF"))])],
+        orelse=[], finalbody=[])
+
+
+def _arguments(argnames):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+
+
+def _funcdef(fname, args, body):
+    fd = ast.FunctionDef(name=fname, args=args, body=body,
+                         decorator_list=[], returns=None)
+    fd.type_params = []                 # required by py3.12 compile
+    return fd
+
+
+def _branch_fn(fname, argnames, stmts, retnames):
+    """def fname(a1, a2): stmts; return (r1, r2)"""
+    body = list(stmts) or [ast.Pass()]
+    body.append(ast.Return(value=_tuple_of(retnames)))
+    return _funcdef(fname, _arguments(argnames), body)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, fn_name, filename, base_lineno=1):
+        self.fn_name = fn_name
+        self.filename = filename
+        self.base = base_lineno         # maps dedented-src lines to file
+        self._uid = 0
+
+    def _loc(self, lineno):
+        return _loc(self.fn_name, self.base + lineno - 1, self.filename)
+
+    def _next(self, kind, lineno):
+        self._uid += 1
+        return f"__dy2st_{kind}_{lineno}_{self._uid}"
+
+    def _mod_names(self, *stmt_lists):
+        names = set()
+        for stmts in stmt_lists:
+            a = _assigned(stmts)
+            if a.blockers:
+                return None, a.blockers
+            names |= a.names
+        return sorted(n for n in names
+                      if not _is_generated_fn_name(n)), []
+
+    # ---- logical operators (needed so `a and b` over tensors works) ----
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[-1]
+        for lhs in reversed(node.values[:-1]):
+            out = ast.Call(
+                func=_helper(op),
+                args=[ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], vararg=None,
+                          kwonlyargs=[], kw_defaults=[], kwarg=None,
+                          defaults=[]), body=lhs),
+                      ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], vararg=None,
+                          kwonlyargs=[], kw_defaults=[], kwarg=None,
+                          defaults=[]), body=out)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_helper("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    # ----------------------------------------------------------- if/else
+    def visit_If(self, node):
+        self.generic_visit(node)
+        names, blockers = self._mod_names(node.body, node.orelse)
+        if names is None:
+            return node                 # faithful Python; tensor cond will
+                                        # produce the wrapped diagnostic
+        lineno = node.lineno
+        tname = self._next("true", lineno)
+        fname = self._next("false", lineno)
+        loc = self._loc(lineno)
+        out = []
+        for n in names:
+            out.append(_undef_guard(n))
+        out.append(_branch_fn(tname, names, node.body, names))
+        out.append(_branch_fn(fname, names, node.orelse, names))
+        call = ast.Call(
+            func=_helper("convert_ifelse"),
+            args=[node.test, _name(tname), _name(fname),
+                  _tuple_of(names),
+                  ast.Tuple(elts=[_const(n) for n in names],
+                            ctx=ast.Load()),
+                  _const(loc)],
+            keywords=[])
+        if names:
+            out.append(ast.Assign(
+                targets=[_tuple_of(names, ast.Store())], value=call))
+        else:
+            out.append(ast.Expr(value=call))
+        return out
+
+    # ------------------------------------------------------------- while
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node                 # while/else: leave as Python
+        a = _assigned(node.body)
+        if a.blockers:
+            return node
+        # carries = names (re)bound by the body; the test reads either a
+        # carried name (shadowed by the cond-fn arg) or a loop-invariant
+        # one (plain closure read) — pulling test-loaded names into the
+        # carry set would drag module/function references (paddle, _jst)
+        # through lax.while_loop as loop vars
+        names = sorted(a.names - {"True", "False", "None"})
+        names = [n for n in names if not _is_generated_fn_name(n)]
+        if not names:
+            return node                 # degenerate: nothing to carry
+        lineno = node.lineno
+        cname = self._next("cond", lineno)
+        bname = self._next("body", lineno)
+        loc = self._loc(lineno)
+        out = [_undef_guard(n) for n in names]
+        cond_fn = _branch_fn(cname, names, [], names)
+        cond_fn.body = [ast.Return(value=node.test)]
+        out.append(cond_fn)
+        out.append(_branch_fn(bname, names, node.body, names))
+        call = ast.Call(
+            func=_helper("convert_while"),
+            args=[_name(cname), _name(bname), _tuple_of(names),
+                  ast.Tuple(elts=[_const(n) for n in names],
+                            ctx=ast.Load()),
+                  _const(loc)],
+            keywords=[ast.keyword(
+                arg="max_iter",
+                value=ast.Call(func=_helper("_current_max_iter"),
+                               args=[], keywords=[]))])
+        out.append(ast.Assign(
+            targets=[_tuple_of(names, ast.Store())], value=call))
+        return out
+
+    # --------------------------------------------------------------- for
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # only `for <name> in range(...)` is rewritten (to a while); any
+        # other iterable keeps Python semantics (static-length iteration
+        # unrolls fine under trace)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return node
+        a = _assigned(node.body)
+        if a.blockers:
+            return node
+        lineno = node.lineno
+        i = node.target.id
+        if len(it.args) == 1:
+            start, stop, step = _const(0), it.args[0], _const(1)
+        elif len(it.args) == 2:
+            start, stop, step = it.args[0], it.args[1], _const(1)
+        else:
+            start, stop, step = it.args
+        # Rewrite (direction-aware, range args evaluated ONCE):
+        #   __stop = stop; __step = step; __cnt = start; i = __cnt
+        #   while _jst.range_cond(__cnt, __stop, __step):
+        #       i = __cnt; <body>; __cnt = __cnt + __step
+        # Post-loop `i` is the last yielded value, matching Python for
+        # non-empty ranges; an empty range leaves i == start (Python
+        # leaves it unbound — the one documented divergence).
+        uid = self._next("cnt", lineno).rsplit("_", 1)[-1]
+        cnt, vstop, vstep = (f"__dy2st_cnt_{uid}", f"__dy2st_stop_{uid}",
+                             f"__dy2st_step_{uid}")
+        pre = [
+            ast.Assign(targets=[_name(vstop, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(vstep, ast.Store())], value=step),
+            ast.Assign(targets=[_name(cnt, ast.Store())], value=start),
+            ast.Assign(targets=[_name(i, ast.Store())], value=_name(cnt)),
+        ]
+        test = ast.Call(func=_helper("range_cond"),
+                        args=[_name(cnt), _name(vstop), _name(vstep)],
+                        keywords=[])
+        body = [ast.Assign(targets=[_name(i, ast.Store())],
+                           value=_name(cnt))] + list(node.body)
+        body.append(ast.Assign(
+            targets=[_name(cnt, ast.Store())],
+            value=ast.BinOp(left=_name(cnt), op=ast.Add(),
+                            right=_name(vstep))))
+        new_while = ast.While(test=test, body=body, orelse=[])
+        new_while.lineno = lineno
+        new_while.col_offset = node.col_offset
+        converted = self.visit_While(new_while)
+        if not isinstance(converted, list):
+            converted = [converted]
+        return pre + converted
+
+
+# ------------------------------------------------------------- conversion
+
+def convert_dynamic(fn):
+    """Return `fn` rewritten so data-dependent `if`/`while`/`for`/bool-ops
+    dispatch through the convert_* runtime (tensor -> control_flow,
+    plain Python -> unchanged semantics). Falls back to `fn` unchanged
+    (with a warning) when the source is unavailable."""
+    raw_fn = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    bound_self = fn.__self__ if isinstance(fn, types.MethodType) else None
+    if getattr(raw_fn, "_not_to_static", False):
+        return fn
+    try:
+        src = inspect.getsource(raw_fn)
+        filename = inspect.getsourcefile(raw_fn) or "<unknown>"
+    except (OSError, TypeError):
+        warnings.warn(
+            f"dy2static: source for {getattr(raw_fn, '__name__', fn)!r} "
+            "is unavailable; tensor-dependent Python control flow will "
+            "not be converted", UserWarning)
+        return fn
+    if hasattr(raw_fn, "__wrapped__"):
+        # inspect.getsource unwraps to the INNER function; re-execing it
+        # would silently drop the wrapping decorator's behavior
+        warnings.warn(
+            f"dy2static: {raw_fn.__name__!r} is decorator-wrapped; "
+            "tensor-dependent Python control flow will not be converted "
+            "(apply @to_static directly to the inner function)",
+            UserWarning)
+        return fn
+    src = textwrap.dedent(src)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            or fdef.name != raw_fn.__name__:
+        return fn
+    other_decorators = [
+        d for d in fdef.decorator_list
+        if not (isinstance(d, ast.Name)
+                and d.id in ("to_static", "not_to_static"))
+        and not (isinstance(d, ast.Attribute)
+                 and d.attr in ("to_static", "not_to_static"))
+        and not (isinstance(d, ast.Call)
+                 and ((isinstance(d.func, ast.Name)
+                       and d.func.id == "to_static")
+                      or (isinstance(d.func, ast.Attribute)
+                          and d.func.attr == "to_static")))]
+    if other_decorators:
+        # re-executing unknown decorators could duplicate side effects;
+        # refusing to convert is the only faithful option
+        warnings.warn(
+            f"dy2static: {raw_fn.__name__!r} carries additional "
+            "decorators; tensor-dependent Python control flow will not "
+            "be converted", UserWarning)
+        return fn
+    fdef.decorator_list = []            # strip @to_static itself
+    base = raw_fn.__code__.co_firstlineno
+    _ControlFlowTransformer(raw_fn.__name__, filename, base).visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    freevars = raw_fn.__code__.co_freevars
+    if freevars:
+        # rebuild the closure: wrap the converted def in a factory whose
+        # parameters recreate the free variables
+        factory = ast.FunctionDef(
+            name="__dy2st_factory", args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None)
+        tree = ast.Module(body=[factory], type_ignores=[])
+        ast.fix_missing_locations(tree)
+
+    glb = raw_fn.__globals__            # LIVE module namespace
+    glb.setdefault(_H, _HelperNS)
+    code = compile(tree, filename=f"<dy2static {filename}>", mode="exec")
+    ns = {}
+    exec(code, glb, ns)
+    if freevars:
+        try:
+            cells = [c.cell_contents for c in (raw_fn.__closure__ or ())]
+        except ValueError:              # empty cell (e.g. __class__)
+            warnings.warn(
+                f"dy2static: {raw_fn.__name__!r} closes over a "
+                "not-yet-filled cell; control flow not converted",
+                UserWarning)
+            return fn
+        converted = ns["__dy2st_factory"](*cells)
+    else:
+        converted = ns[fdef.name]
+    converted.__defaults__ = raw_fn.__defaults__
+    converted.__kwdefaults__ = raw_fn.__kwdefaults__
+    functools.update_wrapper(converted, raw_fn,
+                             assigned=("__name__", "__qualname__",
+                                       "__doc__", "__module__"))
+    converted._dy2static_original = raw_fn
+    if bound_self is not None:
+        return types.MethodType(converted, bound_self)
+    return converted
+
+
+class _HelperNS:
+    """Namespace object the rewritten code references via `_H`."""
+    UNDEF = UNDEF
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_while = staticmethod(convert_while)
+    convert_logical_and = staticmethod(convert_logical_and)
+    convert_logical_or = staticmethod(convert_logical_or)
+    convert_logical_not = staticmethod(convert_logical_not)
+    range_cond = staticmethod(range_cond)
+    _current_max_iter = staticmethod(_current_max_iter)
+
+
+def friendly_trace_error(exc, fn_name):
+    """Augment a raw JAX tracer-bool error with actionable guidance
+    (the reference converts these constructs outright; we convert most,
+    and must at least *explain* the rest)."""
+    msg = str(exc)
+    if "TracerBoolConversionError" in type(exc).__name__ \
+            or "truth value" in msg or "concrete value" in msg.lower():
+        return Dy2StaticError(
+            f"A tensor-dependent Python construct inside {fn_name!r} "
+            "could not be converted (early return/break/continue under a "
+            "tensor condition, or iteration over a tensor-sized "
+            "container). Rewrite that spot with "
+            "paddle_tpu.static.control_flow.cond / while_loop, or hoist "
+            "the early exit out of the tensor branch. Original error: "
+            f"{msg[:500]}")
+    return None
