@@ -125,7 +125,46 @@ def save_inference_model(path, layer, input_spec=None, example_inputs=None,
     }
     with open(path + ".json", "w") as f:
         json.dump(meta, f, indent=1)
+    # Native-serving sidecars (csrc/predictor.cc): the PORTABLE StableHLO
+    # bytecode (jax.export's serialize() wraps it in a JAX-only envelope,
+    # so the raw module is written separately) plus a text signature the
+    # C runner parses without a JSON/protobuf dependency.
+    with open(path + ".mlir", "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    with open(path + ".sig", "w") as f:
+        f.write("version 1\n")
+        for i, s in enumerate(specs):
+            f.write(f"input x{i} {_sig_dtype(s.dtype)} "
+                    f"{_sig_dims(s.shape)}\n")
+        for i, aval in enumerate(exported.out_avals):
+            f.write(f"output out{i} {_sig_dtype(aval.dtype)} "
+                    f"{_sig_dims(aval.shape)}\n")
     return path
+
+
+_SIG_DTYPES = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int8": "s8", "int16": "s16", "int32": "s32",
+    "int64": "s64", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "uint64": "u64", "bool": "pred",
+}
+
+
+def _sig_dtype(dt):
+    code = _SIG_DTYPES.get(np.dtype(dt).name)
+    if code is None:
+        # a wrong byte-size in the .sig would corrupt native serving;
+        # fail loudly at export time instead
+        raise ValueError(
+            f"dtype {np.dtype(dt).name!r} has no native-serving mapping; "
+            "supported: " + ", ".join(sorted(_SIG_DTYPES)))
+    return code
+
+
+def _sig_dims(shape):
+    if len(shape) == 0:
+        return "scalar"
+    return ",".join(str(d) if isinstance(d, int) else "-1" for d in shape)
 
 
 def load_inference_model(path, **configs):

@@ -21,12 +21,14 @@ def repo_csrc():
         os.path.abspath(__file__)))), "csrc")
 
 
-def native_lib_path(name):
-    """Absolute path to lib<name>.so, building from csrc on demand."""
+def native_lib_path(name, source=None, extra_flags=()):
+    """Absolute path to lib<name>.so, building from csrc on demand.
+    `source` overrides the default `<name>.cc`; `extra_flags` appends
+    compile/link flags (e.g. -ldl, -I... for the PJRT-based runner)."""
     pkg_native = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "_native", f"lib{name}.so")
-    src = os.path.join(repo_csrc(), f"{name}.cc")
+    src = os.path.join(repo_csrc(), source or f"{name}.cc")
     if os.path.exists(pkg_native) and (
             not os.path.exists(src) or
             os.path.getmtime(pkg_native) >= os.path.getmtime(src)):
@@ -41,7 +43,9 @@ def native_lib_path(name):
         if (not os.path.exists(so) or
                 os.path.getmtime(so) < os.path.getmtime(src)):
             os.makedirs(out_dir, exist_ok=True)
-            subprocess.run(["g++", *_FLAGS, src, "-o", so + ".tmp"],
+            inc = os.path.join(repo_csrc(), "third_party")
+            subprocess.run(["g++", *_FLAGS, f"-I{inc}", src,
+                            "-o", so + ".tmp", *extra_flags],
                            check=True, capture_output=True)
             os.replace(so + ".tmp", so)
     return so

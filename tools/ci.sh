@@ -18,8 +18,24 @@ else
     g++ -O3 -std=c++17 -shared -fPIC -pthread "csrc/${lib}.cc" \
         -o "csrc/build/lib${lib}.so"
   done
+  g++ -O3 -std=c++17 -shared -fPIC -Icsrc/third_party \
+      csrc/predictor.cc -ldl -o csrc/build/libptpredictor.so
+  g++ -O3 -std=c++17 -shared -fPIC -Icsrc/third_party \
+      csrc/pjrt_mock_plugin.cc -o csrc/build/libpjrt_mock.so
+  g++ -O3 -std=c++17 -Icsrc/third_party csrc/predictor_main.cc \
+      csrc/build/libptpredictor.so -ldl -o csrc/build/predictor_smoke
 fi
 echo "native libs OK"
+
+# pure-C++ serving smoke: the standalone binary (no Python linked)
+# serves a ZeroCopy run through the PJRT C ABI against the mock plugin
+SMOKE_DIR=$(mktemp -d)
+printf 'MOCK-IDENTITY' > "$SMOKE_DIR/m.mlir"
+printf 'version 1\ninput x0 f32 2,2\noutput out0 f32 2,2\n' \
+    > "$SMOKE_DIR/m.sig"
+csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
+    | grep -q "^OK" && echo "native serving smoke OK"
+rm -rf "$SMOKE_DIR"
 
 echo "== [2/3] test suite =="
 python -m pytest tests/ -x -q
