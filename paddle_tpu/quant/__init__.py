@@ -11,5 +11,7 @@ calibration over sample data, and converted inference layers run real int8
 matmuls on the MXU (int8 is 2x bf16 throughput on v5e+).
 """
 from .qat import (FakeQuantAbsMax, QuantizedLinear, QuantizedConv2D,  # noqa: F401
-                  QAT, quant_dequant)
-from .ptq import PTQ, AbsmaxQuantizer, HistQuantizer  # noqa: F401
+                  QuantizedConv2DBN, QAT, quant_dequant,
+                  quant_dequant_channelwise)
+from .ptq import (PTQ, AbsmaxQuantizer, HistQuantizer, KLQuantizer,  # noqa: F401
+                  Int8Linear, Int8Conv2D, fold_conv_bn)

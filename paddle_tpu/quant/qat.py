@@ -21,6 +21,20 @@ def quant_dequant(x, scale, bits=8):
     return apply(fn, x, scale)
 
 
+def quant_dequant_channelwise(w, bits=8, axis=-1):
+    """Per-channel fake-quant over `axis` (reference
+    `channel_wise_abs_max` fake-quant op), straight-through grads."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def fn(v):
+        red = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        s = jnp.maximum(jnp.max(jnp.abs(v), axis=red, keepdims=True),
+                        1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+        return v + jax.lax.stop_gradient(q - v)
+    return apply(fn, w)
+
+
 class FakeQuantAbsMax(nn.Layer):
     """Running abs-max observer + fake quant (the moving-average absmax
     quantizer of `quantization_pass.py`)."""
@@ -48,33 +62,41 @@ class FakeQuantAbsMax(nn.Layer):
 
 
 class QuantizedLinear(nn.Layer):
-    def __init__(self, layer, bits=8):
+    def __init__(self, layer, bits=8, per_channel=True):
         super().__init__()
         self.inner = layer
         self.act_quant = FakeQuantAbsMax(bits)
         self.w_quant_bits = bits
+        self.per_channel = per_channel
 
     def forward(self, x):
         x = self.act_quant(x)
         w = self.inner.weight
-        w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
-        wq = quant_dequant(w, w_scale, self.w_quant_bits)
+        if self.per_channel:
+            wq = quant_dequant_channelwise(w, self.w_quant_bits, axis=1)
+        else:
+            w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
+            wq = quant_dequant(w, w_scale, self.w_quant_bits)
         out = F.linear(x, wq, self.inner.bias)
         return out
 
 
 class QuantizedConv2D(nn.Layer):
-    def __init__(self, layer, bits=8):
+    def __init__(self, layer, bits=8, per_channel=True):
         super().__init__()
         self.inner = layer
         self.act_quant = FakeQuantAbsMax(bits)
         self.w_quant_bits = bits
+        self.per_channel = per_channel
 
     def forward(self, x):
         x = self.act_quant(x)
         w = self.inner.weight
-        w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
-        wq = quant_dequant(w, w_scale, self.w_quant_bits)
+        if self.per_channel:
+            wq = quant_dequant_channelwise(w, self.w_quant_bits, axis=0)
+        else:
+            w_scale = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
+            wq = quant_dequant(w, w_scale, self.w_quant_bits)
         return F.conv2d(x, wq, self.inner.bias,
                         stride=self.inner._stride,
                         padding=self.inner._padding,
@@ -82,25 +104,115 @@ class QuantizedConv2D(nn.Layer):
                         groups=self.inner._groups)
 
 
+class QuantizedConv2DBN(nn.Layer):
+    """BN-fold QAT (reference `quantization_pass.py` _fold / Jacob et
+    al. frozen-stats fold): the conv weight is folded with the BN's
+    RUNNING stats, fake-quantized per-channel, and applied in one conv —
+    so training sees exactly the arithmetic int8 deployment will use.
+    The wrapped BN still updates its running stats from the pre-fold
+    conv output while training."""
+
+    def __init__(self, conv, bn, bits=8, per_channel=True):
+        super().__init__()
+        self.conv = conv
+        self.bn = bn
+        self.act_quant = FakeQuantAbsMax(bits)
+        self.w_quant_bits = bits
+        self.per_channel = per_channel
+        # affine-less BN (weight_attr/bias_attr=False): fold with
+        # constant gamma=1 / beta=0, same guard as ptq.fold_conv_bn
+        nf = bn._mean.shape[0]
+        self._gamma = bn.weight if bn.weight is not None else Tensor(
+            jnp.ones([nf], jnp.float32), stop_gradient=True)
+        self._beta = bn.bias if bn.bias is not None else Tensor(
+            jnp.zeros([nf], jnp.float32), stop_gradient=True)
+
+    def _folded_wb(self):
+        g = self._gamma
+        beta = self._beta
+        mean, var = self.bn._mean, self.bn._variance
+        eps = self.bn._epsilon
+
+        def fold_w(w, gv, vv):
+            f = gv / jnp.sqrt(vv + eps)
+            return w * f[:, None, None, None]
+
+        def fold_b(b, gv, bv, mv, vv):
+            f = gv / jnp.sqrt(vv + eps)
+            return bv + (b - mv) * f
+        w = apply(fold_w, self.conv.weight, g, var)
+        bias = self.conv.bias
+        if bias is None:
+            zero = Tensor(jnp.zeros(mean.shape, jnp.float32),
+                          stop_gradient=True)
+            bias = zero
+        b = apply(fold_b, bias, g, beta, mean, var)
+        return w, b
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w, b = self._folded_wb()
+        if self.per_channel:
+            wq = quant_dequant_channelwise(w, self.w_quant_bits, axis=0)
+        else:
+            ws = apply(lambda v: jnp.max(jnp.abs(v)).reshape(1), w)
+            wq = quant_dequant(w, ws, self.w_quant_bits)
+        out = F.conv2d(x, wq, b, stride=self.conv._stride,
+                       padding=self.conv._padding,
+                       dilation=self.conv._dilation,
+                       groups=self.conv._groups)
+        if self.training:
+            # keep the running stats live: a shadow unfolded conv output
+            # feeds the BN update, its normalized result is discarded
+            from ..core import autograd
+            with autograd.no_grad():
+                raw = F.conv2d(x, self.conv.weight, self.conv.bias,
+                               stride=self.conv._stride,
+                               padding=self.conv._padding,
+                               dilation=self.conv._dilation,
+                               groups=self.conv._groups)
+                self.bn(raw)
+        return out
+
+
 class QAT:
     """`QAT().quantize(model)` swaps Linear/Conv2D sublayers in place for
-    fake-quant wrappers (imperative QAT `qat.py` ImperativeQuantAware)."""
+    fake-quant wrappers (imperative QAT `qat.py` ImperativeQuantAware).
+    With fold_bn=True, (Conv2D, BatchNorm) pairs inside Sequential
+    containers become one BN-fold QAT layer (QuantizedConv2DBN)."""
 
-    def __init__(self, bits=8, quantizable_layer_type=("Linear", "Conv2D")):
+    def __init__(self, bits=8, quantizable_layer_type=("Linear", "Conv2D"),
+                 per_channel=True, fold_bn=False):
         self.bits = bits
         self.types = set(quantizable_layer_type)
+        self.per_channel = per_channel
+        self.fold_bn = fold_bn
 
     def quantize(self, model):
+        if self.fold_bn:
+            self._fold_pairs(model)
         self._swap(model)
         return model
+
+    def _fold_pairs(self, model):
+        from ..nn import Identity
+        from .ptq import iter_conv_bn_pairs
+        for layer, n1, c1, n2, c2 in iter_conv_bn_pairs(model):
+            layer._sub_layers[n1] = QuantizedConv2DBN(
+                c1, c2, self.bits, self.per_channel)
+            layer._sub_layers[n2] = Identity()
 
     def _swap(self, layer):
         for name, child in list(layer._sub_layers.items()):
             cls = type(child).__name__
+            if cls.startswith("Quantized") or cls.startswith("Int8"):
+                continue            # already wrapped (e.g. BN-fold pair)
             if cls == "Linear" and "Linear" in self.types:
-                layer._sub_layers[name] = QuantizedLinear(child, self.bits)
+                layer._sub_layers[name] = QuantizedLinear(
+                    child, self.bits, self.per_channel)
             elif cls == "Conv2D" and "Conv2D" in self.types:
-                layer._sub_layers[name] = QuantizedConv2D(child, self.bits)
+                layer._sub_layers[name] = QuantizedConv2D(
+                    child, self.bits, self.per_channel)
             else:
                 self._swap(child)
 

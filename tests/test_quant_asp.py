@@ -94,3 +94,175 @@ def test_asp_masks_are_per_model():
     opt_b.step()
     assert np.array_equal(a.weight.numpy(), wa)  # A untouched
     assert not sparsity.check_sparsity(b.weight)  # B not pruned
+
+
+# ---- round-3 depth: KL calibration, per-channel, BN fold, int8 deploy ----
+
+def test_kl_quantizer_clips_outliers():
+    """KL threshold search must clip rare outliers (scale well below the
+    abs max) but keep ~the full range for a dense uniform signal."""
+    from paddle_tpu.quant import KLQuantizer
+    rs = np.random.RandomState(0)
+    q = KLQuantizer()
+    body = rs.randn(20000).astype(np.float32)
+    outliers = np.array([80.0, -95.0], np.float32)
+    q.observe(np.concatenate([body, outliers]))
+    s = q.scale()
+    assert s < 40.0, s                   # outliers clipped
+    q2 = KLQuantizer()
+    q2.observe(rs.uniform(-3, 3, 20000).astype(np.float32))
+    assert q2.scale() > 2.0              # dense range kept
+
+
+def test_per_channel_beats_per_tensor_linear():
+    """Wildly different per-channel weight magnitudes: per-channel int8
+    keeps the small channels accurate."""
+    from paddle_tpu.quant import Int8Linear
+    paddle.seed(0)
+    lin = nn.Linear(16, 4)
+    w = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    w[:, 0] *= 100.0                     # one huge channel
+    w[:, 1] *= 0.01                      # one tiny channel
+    lin.weight._value = __import__("jax.numpy", fromlist=["asarray"]
+                                   ).asarray(w)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 16).astype(np.float32))
+    ref = np.asarray(F.linear(x, lin.weight, lin.bias).numpy())
+    act_scale = float(np.abs(x.numpy()).max())
+    pc = np.asarray(Int8Linear(lin, act_scale, per_channel=True)(x).numpy())
+    pt = np.asarray(Int8Linear(lin, act_scale, per_channel=False)(x).numpy())
+    err_pc = np.abs(pc - ref)[:, 1].mean()   # tiny channel error
+    err_pt = np.abs(pt - ref)[:, 1].mean()
+    assert err_pc < err_pt / 10, (err_pc, err_pt)
+
+
+def test_int8_conv_close_to_float():
+    from paddle_tpu.quant import Int8Conv2D
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    ref = np.asarray(conv(x).numpy())
+    q = Int8Conv2D(conv, float(np.abs(x.numpy()).max()))
+    out = np.asarray(q(x).numpy())
+    rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-8)
+    assert rel < 0.05, rel
+
+
+def test_fold_conv_bn_preserves_eval_output():
+    from paddle_tpu.quant import fold_conv_bn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1),
+                        nn.BatchNorm2D(8), nn.ReLU())
+    # make BN stats non-trivial
+    net.train()
+    for _ in range(3):
+        net(paddle.to_tensor(np.random.RandomState(7).randn(
+            4, 3, 8, 8).astype(np.float32) * 2 + 1))
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32))
+    ref = np.asarray(net(x).numpy())
+    n = fold_conv_bn(net)
+    assert n == 1
+    out = np.asarray(net(x).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_fold_qat_trains():
+    from paddle_tpu.quant import QAT, QuantizedConv2DBN
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1),
+                        nn.BatchNorm2D(4), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 8 * 8, 10))
+    QAT(fold_bn=True).quantize(net)
+    assert any(isinstance(m, QuantizedConv2DBN)
+               for _, m in net.named_sublayers())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 1, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randint(0, 10, (8,)).astype(np.int64))
+    net.train()
+    losses = []
+    for _ in range(6):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def _synth_digits(n, rs):
+    """Synthetic 10-class 28x28 'digits': fixed random template per
+    class + noise (keeps the accuracy gate hermetic — no dataset
+    download)."""
+    templates = np.random.RandomState(42).rand(10, 28, 28) > 0.6
+    ys = rs.randint(0, 10, n)
+    xs = templates[ys].astype(np.float32)
+    xs += rs.randn(n, 28, 28).astype(np.float32) * 0.35
+    return xs[:, None], ys.astype(np.int64)
+
+
+def test_lenet_int8_accuracy_within_1pct():
+    """The reference slim acceptance bar: post-training int8 within 1%
+    of fp32 accuracy (LeNet, per-channel weights, KL activations)."""
+    from paddle_tpu.quant import PTQ
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    net = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda a, b: F.cross_entropy(net(a), b), opt)
+    for _ in range(30):
+        xs, ys = _synth_digits(64, rs)
+        step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+
+    net.eval()
+    xt, yt = _synth_digits(512, np.random.RandomState(123))
+
+    def accuracy(m):
+        logits = np.asarray(m(paddle.to_tensor(xt)).numpy())
+        return float((logits.argmax(1) == yt).mean())
+
+    fp32_acc = accuracy(net)
+    assert fp32_acc > 0.9, f"fp32 LeNet failed to train ({fp32_acc})"
+    calib = [paddle.to_tensor(_synth_digits(64, rs)[0])
+             for _ in range(4)]
+    PTQ(quantizer="KL").quantize(net, calib_data=calib)
+    int8_acc = accuracy(net)
+    assert int8_acc >= fp32_acc - 0.01, (fp32_acc, int8_acc)
+
+
+def test_int8_artifact_serves_through_predictor(tmp_path):
+    """PTQ-converted model exports to a servable artifact: the Python
+    predictor runs it, and the native-runner sidecars (.mlir/.sig) are
+    written. Reference: int8 program through AnalysisPredictor."""
+    from paddle_tpu.quant import PTQ
+    from paddle_tpu import inference
+    from paddle_tpu.jit import InputSpec
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 8 * 8, 10))
+    rs = np.random.RandomState(0)
+    calib = [paddle.to_tensor(rs.randn(4, 1, 8, 8).astype(np.float32))
+             for _ in range(3)]
+    PTQ().quantize(net, calib_data=calib)
+    net.eval()
+    x = rs.randn(4, 1, 8, 8).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+
+    base = str(tmp_path / "int8net")
+    from paddle_tpu.inference.export import save_inference_model
+    save_inference_model(base, net,
+                         input_spec=[InputSpec([4, 1, 8, 8], "float32")])
+    assert open(base + ".mlir", "rb").read()[:4] == b"ML\xefR"
+    pred = inference.create_predictor(inference.Config(base))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
