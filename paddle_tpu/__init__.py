@@ -229,16 +229,4 @@ def monkey_patch_variable():
 from .core import dtype  # noqa: F401,E402
 
 
-class _HubStub:
-    """paddle.hub placeholder: model hub downloads need egress; load
-    local checkpoints with paddle_tpu.load instead."""
-
-    def __getattr__(self, item):
-        # AttributeError so hasattr()/getattr(default) degrade gracefully
-        raise AttributeError(
-            f"paddle_tpu.hub.{item}: the model hub needs network access; "
-            "load local checkpoints with paddle_tpu.load / "
-            "hapi.Model.load")
-
-
-hub = _HubStub()
+from . import hub  # noqa: F401  (local-source hub + md5 weight loading)

@@ -75,3 +75,9 @@ class Stream:
 
 def current_stream(device=None):
     return Stream()
+
+
+def get_cudnn_version():
+    """Reference `device/__init__.py get_cudnn_version`: None when no
+    CUDA build — which is always, here (TPU/XLA)."""
+    return None

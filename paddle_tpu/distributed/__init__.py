@@ -33,7 +33,8 @@ from .pipeline import (  # noqa: F401
     PipelineParallel, pipeline_apply, pipeline_apply_tensors,
     pipeline_train_step_1f1b, pipeline_train_step_interleaved,
 )
-from .planner import gpt_memory_plan, MemoryPlan, HBM_BYTES  # noqa: F401
+from .planner import (gpt_memory_plan, MemoryPlan, HBM_BYTES,  # noqa: F401
+                      search_plan)
 from .recompute import recompute  # noqa: F401
 from . import kvstore  # noqa: F401
 from .localsgd import LocalSGDStep, local_sgd_average  # noqa: F401
@@ -58,3 +59,42 @@ _sys.modules[__name__ + ".utils"] = utils
 from .dist_utils import global_scatter, global_gather  # noqa: F401
 
 fleet.DistributedStrategy = DistributedStrategy
+
+# ---- round-3 audit closures (reference `distributed/__init__.py`) ----
+from ..io.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from . import launch  # noqa: F401,E402  (python -m ... entry module)
+from .collective import barrier as _barrier  # noqa: E402
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference `parallel_with_gloo.py`: CPU-process rendezvous. The
+    gloo transport dissolves into the TCP KV store (csrc/kvstore.cc);
+    this bootstraps the same store the collective barrier uses."""
+    from .kvstore import KVServer, KVClient
+    global _GLOO_CTX
+    host, _, port = server_endpoint.partition(":")
+    srv = None
+    if rank_id == 0:
+        srv = KVServer(int(port))
+    cli = KVClient(host or "127.0.0.1", int(port))
+    _GLOO_CTX = {"rank": rank_id, "size": rank_num, "client": cli,
+                 "server": srv}
+    cli.barrier("gloo_init", rank_num)
+
+
+def gloo_barrier():
+    if _GLOO_CTX is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    c = _GLOO_CTX
+    c["n"] = c.get("n", 0) + 1
+    c["client"].barrier(f"gloo_b{c['n']}", c["size"])
+
+
+def gloo_release():
+    global _GLOO_CTX
+    if _GLOO_CTX and _GLOO_CTX.get("server") is not None:
+        _GLOO_CTX["server"].stop()
+    _GLOO_CTX = None
+
+
+_GLOO_CTX = None

@@ -410,3 +410,135 @@ class HybridParallelGradScaler:
         if item == "_scaler":
             raise AttributeError(item)
         return getattr(self._scaler, item)
+
+
+# ---- audit closures: role makers + Fleet object + data generators ----
+# (reference `fleet/base/role_maker.py`, `fleet/base/fleet_base.py:101`,
+#  `fleet/data_generator/data_generator.py`)
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Env-var role parsing (reference PaddleCloudRoleMaker): reads the
+    PADDLE_* contract this module's is_server()/worker helpers use."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+    def _generate_role(self):
+        pass
+
+    def is_worker(self):
+        return is_worker()
+
+    def is_server(self):
+        return is_server()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def role(self):
+        return Role.SERVER if is_server() else Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, current_id=0,
+                 role=Role.WORKER, worker_num=1, server_endpoints=None,
+                 **kwargs):
+        super().__init__(is_collective)
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def role(self):
+        return self._role
+
+
+class Fleet:
+    """Object face over this module's functional fleet API (reference
+    `fleet_base.py:101` Fleet — the module-level `fleet` singleton there
+    is an instance of this)."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._role_maker = role_maker
+        return init(role_maker, is_collective, strategy)
+
+    def __getattr__(self, name):
+        import sys
+        mod = sys.modules[__name__]
+        try:
+            return getattr(mod, name)
+        except AttributeError:
+            raise AttributeError(f"Fleet has no attribute {name!r}")
+
+
+class MultiSlotDataGenerator:
+    """Slot-format data generator (reference
+    `fleet/data_generator/data_generator.py` MultiSlotDataGenerator):
+    subclass, implement generate_sample(line) yielding
+    [(slot_name, [ints-or-floats]), ...]; run_from_stdin/_from_memory
+    emit the MultiSlot text protocol the dataset feeders parse."""
+
+    def _format(self, sample):
+        parts = []
+        for _name, feas in sample:
+            parts.append(str(len(feas)))
+            parts.extend(str(f) for f in feas)
+        return " ".join(parts)
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                out.append(self._format(sample))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    def _format(self, sample):
+        parts = []
+        for _name, feas in sample:
+            parts.append(str(len(feas)))
+            parts.extend(str(f) for f in feas)
+        return " ".join(parts)
+
+
+from .topology import CommunicateTopology  # noqa: E402,F401

@@ -10,7 +10,7 @@ and asserted by the driver dryrun for the 1.3B north-star plan.
 """
 from dataclasses import dataclass, field
 
-__all__ = ["gpt_memory_plan", "MemoryPlan", "HBM_BYTES"]
+__all__ = ["gpt_memory_plan", "MemoryPlan", "HBM_BYTES", "search_plan"]
 
 # per-chip HBM capacities (bytes) for plan checks
 HBM_BYTES = {
@@ -128,3 +128,36 @@ def gpt_memory_plan(cfg, dp=1, mp=1, pp=1, sp=1, micro_batch=1,
         detail=dict(dp=dp, mp=mp, pp=pp, sp=sp, micro_batch=micro_batch,
                     zero_stage=zero_stage, remat=remat, logits_bytes=logits),
     )
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def search_plan(cfg, n_chips, chip="v5p", micro_batch=1, zero_stage=1,
+                remat=True, max_mp=8):
+    """Enumerate dp x mp x pp factorizations of `n_chips` and return the
+    feasible MemoryPlans sorted by per-chip bytes (reference analog: the
+    human deciding sharding_configs + device_guard cuts; here the HBM
+    arithmetic does it). mp must divide num_heads AND the ffn dim; pp
+    must divide num_layers. mp is capped (default 8) because TP
+    allreduces must stay on ICI-adjacent chips. Returns [] when nothing
+    fits — the caller decides whether that means more chips or offload.
+    """
+    plans = []
+    for mp in _divisors(n_chips):
+        if mp > max_mp or cfg.num_heads % mp or cfg.ffn_hidden_size % mp \
+                or cfg.vocab_size % mp:
+            continue
+        rest = n_chips // mp
+        for pp in _divisors(rest):
+            if cfg.num_layers % pp:
+                continue
+            dp = rest // pp
+            plan = gpt_memory_plan(
+                cfg, dp=dp, mp=mp, pp=pp, micro_batch=micro_batch,
+                zero_stage=zero_stage, remat=remat)
+            if plan.fits(chip):
+                plans.append(plan)
+    plans.sort(key=lambda p: p.total_bytes)
+    return plans

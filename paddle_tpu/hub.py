@@ -1,0 +1,92 @@
+"""paddle.hub parity: discover and load models from hubconf repos.
+
+Reference surface: `python/paddle/hub.py` (list/help/load over github/
+gitee/local sources). This environment has no egress, so remote sources
+raise with guidance and `source="local"` is fully supported: a hub repo
+is a directory with `hubconf.py` declaring entrypoint callables (and an
+optional `dependencies` list), exactly the reference protocol.
+
+Weight files load through `load_state_dict_from_path` (the
+zero-egress analog of torch/paddle's load_state_dict_from_url) with an
+optional md5 integrity check — the same check `pretrained=True` model
+factories use (see `paddle_tpu.pretrained`).
+"""
+import hashlib
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load", "load_state_dict_from_path"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir, source):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local'")
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"_paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    deps = getattr(mod, "dependencies", [])
+    for d in deps:
+        if importlib.util.find_spec(d) is None:
+            raise ImportError(
+                f"hub repo {repo_dir!r} requires {d!r} which is not "
+                "installed")
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hub repo has no entrypoint {model!r}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(
+            f"hub repo has no entrypoint {model!r}; available: "
+            f"{list(repo_dir, source)}")
+    return fn(**kwargs)
+
+
+def load_state_dict_from_path(path, md5=None):
+    """Load a .pdparams state dict from a local path, verifying md5 when
+    given (the integrity half of load_state_dict_from_url; the download
+    half requires egress)."""
+    if path.startswith(("http://", "https://")):
+        raise RuntimeError(
+            "no network access: download the weights out-of-band and "
+            "pass the local path")
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if md5 is not None:
+        h = hashlib.md5()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != md5:
+            raise RuntimeError(
+                f"md5 mismatch for {path}: {h.hexdigest()} != {md5} "
+                "(corrupt or wrong weights file)")
+    from .io.serialization import load as _load
+    return _load(path)

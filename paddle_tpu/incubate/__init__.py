@@ -10,8 +10,13 @@ from .. import sparsity as asp  # noqa: F401
 from . import nn  # noqa: F401
 from ..distributed.recompute import recompute  # noqa: F401
 # paddle.incubate.LookAhead / ModelAverage compat aliases
+from .ops import (  # noqa: F401
+    segment_sum, segment_mean, segment_min, segment_max,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
 from ..optimizer.extras import (  # noqa: F401
     Lookahead as LookAhead, ModelAverage,
 )
 
-__all__ = ["asp", "nn", "recompute", "LookAhead", "ModelAverage"]
+__all__ = ["asp", "nn", "recompute", "LookAhead", "ModelAverage",
+           "segment_sum", "segment_mean", "segment_min", "segment_max",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

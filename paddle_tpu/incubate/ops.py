@@ -1,0 +1,71 @@
+"""Incubate op family: segment reductions + fused softmax masks.
+
+Reference surface: `python/paddle/incubate/__init__.py` exports —
+`segment_sum/mean/min/max` (`incubate/tensor/math.py`, CUDA kernels
+`operators/segment_pool_op.cu`) and `softmax_mask_fuse(_upper_triangle)`
+(`incubate/operators/softmax_mask_fuse.py`, fused CUDA kernel). On TPU
+the segment family lowers to `jax.ops.segment_*` (one XLA scatter-reduce
+on the chip) and the "fused" softmax masks are plain expressions XLA
+fuses into the surrounding attention matmuls — the hand-written kernel
+dissolves.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+def _segment(data, segment_ids, reducer, fill=0.0):
+    def fn(d, s):
+        n = jnp.max(s) + 1 if s.size else 0
+        # num_segments must be static under jit: callers inside jit must
+        # pad; eager path computes it concretely
+        n = int(n) if not isinstance(n, jax.core.Tracer) else None
+        if n is None:
+            raise ValueError(
+                "segment_* under jit needs concrete segment count; call "
+                "eagerly or pad segment_ids to a static max")
+        return reducer(d, s, num_segments=n)
+    return apply(fn, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, jax.ops.segment_sum)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def fn(d, s):
+        n = int(jnp.max(s)) + 1
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1)
+    return apply(fn, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, jax.ops.segment_min)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, jax.ops.segment_max)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) over the last axis (reference fused kernel for
+    attention scores + additive mask)."""
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax over the last axis with the strict upper triangle masked
+    out (causal attention shape [b, h, s, s])."""
+    def fn(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+    return apply(fn, x)

@@ -13,4 +13,6 @@ input-output handles) so serving code ports unchanged.
 from .export import (save_inference_model, load_inference_model,  # noqa: F401
                      ExportedModel)
 from .predictor import (Config, Predictor, create_predictor,  # noqa: F401
-                        PredictorHandle)
+                        PredictorHandle, DataType, PlaceType,
+                        PrecisionType, PredictorPool, Tensor,
+                        get_num_bytes_of_data_type, get_version)

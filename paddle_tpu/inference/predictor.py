@@ -140,3 +140,68 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+# ---- C-API-parity type surface (reference `paddle_infer` bindings:
+# `paddle/fluid/inference/api/paddle_api.h` DataType/PlaceType/
+# PrecisionType, `paddle_inference_api.h` PredictorPool) ---------------
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    TPU = 4
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int64": 8,
+                "int32": 4, "uint8": 1, "int8": 1, "bool": 1,
+                "float64": 8}
+
+
+def get_num_bytes_of_data_type(dtype):
+    key = getattr(dtype, "lower", lambda: dtype)()
+    if key not in _DTYPE_BYTES:
+        raise ValueError(f"unknown data type {dtype!r}")
+    return _DTYPE_BYTES[key]
+
+
+def get_version():
+    from .. import __version__
+    return f"paddle_tpu inference {__version__} (XLA/PJRT engine)"
+
+
+Tensor = PredictorHandle  # reference `paddle.inference.Tensor` alias
+
+
+class PredictorPool:
+    """N independent predictors over one artifact (reference
+    `PredictorPool` in `paddle_inference_api.h`: per-thread predictors
+    sharing weights). XLA-compiled modules are thread-safe, so the pool
+    shares ONE compiled program and hands out lightweight handles."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(int(size))]
+
+    def retrive(self, idx):            # sic — reference API spelling
+        return self._preds[idx]
+
+    retrieve = retrive

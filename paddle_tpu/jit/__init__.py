@@ -130,6 +130,10 @@ class StaticFunction:
         return params, buffers
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.enable_to_static:
+            # the reference's global kill-switch: run the original
+            # eager Python, no conversion, no jit
+            return self._orig_fn(*args, **kwargs)
         if self._fn is None:
             # reference ProgramTranslator order: AST transform, then
             # trace — tensor-dependent if/while/for/bool-ops dispatch
@@ -399,3 +403,69 @@ def load(path, **configs):
 
 from .dy2static import (  # noqa: E402,F401  (public dy2static surface)
     Dy2StaticError, convert_dynamic, max_loop_iterations)
+
+
+# ---- dy2static management surface (reference `program_translator.py`,
+# `logging_utils.py`) --------------------------------------------------
+
+_dy2stat_verbosity = 0
+_dy2stat_code_level = -1
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Transcription logging verbosity (reference logging_utils.py:81).
+    Conversion here is a single AST pass, so levels just gate whether the
+    converted source is reported via warnings."""
+    global _dy2stat_verbosity
+    _dy2stat_verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Report converted code (reference logging_utils.py:51)."""
+    global _dy2stat_code_level
+    _dy2stat_code_level = int(level)
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static conversion (reference
+    `program_translator.py:768`). enable(False) makes to_static run the
+    original Python (tracing still compiles straight-line code)."""
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        type(self).enable_to_static = bool(enable_to_static)
+
+    def get_program_cache(self):
+        return {}
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+class TranslatedLayer:
+    """Loaded-inference-artifact Layer face (reference
+    `translated_layer.py`: the Layer returned by paddle.jit.load). Here
+    jit.load returns the ExportedModel; this subclass-compatible alias
+    exists so isinstance checks and type hints port."""
+
+    def __init__(self, exported):
+        self._exported = exported
+
+    def __call__(self, *args):
+        return self._exported(*args)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer wraps a serving artifact (params baked as "
+            "constants); re-train from the source Layer instead")

@@ -293,3 +293,17 @@ def db_loss(pred, gt_prob, prob_mask=None, alpha=1.0, beta=10.0):
     inter = (binary * gt).sum()
     dice = 1.0 - (2.0 * inter + 1.0) / (binary.sum() + gt.sum() + 1.0)
     return bce * alpha + dice * beta
+
+
+def crnn_synth(pretrained=True, num_classes=12):
+    """Fixture-config CRNN (1-channel, hidden 16, rnn 24) with packaged
+    self-trained weights on the synthetic glyph-strings task — the
+    in-suite real-accuracy fixture for the OCR rec path (reference
+    `pretrained=True` rec models load converted PP-OCR weights the same
+    way via PADDLE_TPU_PRETRAINED_ROOT)."""
+    model = CRNN(in_channels=1, num_classes=num_classes, hidden=16,
+                 rnn_hidden=24)
+    if pretrained:
+        from ..pretrained import load_pretrained
+        load_pretrained(model, "crnn_synth", pretrained)
+    return model

@@ -51,3 +51,5 @@ from .clip import (  # noqa: F401
 from .layer.vision import PixelShuffle, PixelUnshuffle, ChannelShuffle  # noqa: F401
 
 from ..generation import BeamSearchDecoder  # noqa: F401,E402
+
+from ..generation import dynamic_decode, BeamSearchDecoder  # noqa: F401,E402

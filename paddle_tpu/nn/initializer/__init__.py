@@ -185,3 +185,42 @@ def calculate_gain(nonlinearity, param=None):
         a = 0.01 if param is None else param
         return math.sqrt(2.0 / (1 + a ** 2))
     return gains.get(nonlinearity, 1.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    `nn/initializer/Bilinear` / `fluid/initializer.py BilinearInitializer`):
+    weight [C_out, C_in, kh, kw] filled with the bilinear interpolation
+    kernel on each spatial slice."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv "
+                             f"weight, got shape {shape}")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        y = (1 - np.abs(np.arange(kh) / fh - ch))[:, None]
+        x = (1 - np.abs(np.arange(kw) / fw - cw))[None, :]
+        kern = (y * x).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        w[:, :] = kern
+        return jnp.asarray(w, dtype)
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference `fluid/initializer.py set_global_initializer`: override
+    the default initializer Layers use when neither param attr nor call
+    site specifies one. Pass (None, None) to reset."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _global_default(is_bias):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT

@@ -81,7 +81,12 @@ class Layer:
         if attr is False:
             return None
         dtype = convert_dtype(dtype) or get_default_dtype()
-        init = attr.initializer or default_initializer or \
+        # precedence (reference set_global_initializer semantics): an
+        # explicit per-param attr wins; otherwise the global override
+        # replaces the framework/layer default
+        from ..initializer import _global_default
+        init = attr.initializer or _global_default(is_bias) or \
+            default_initializer or \
             (Constant(0.0) if is_bias else XavierUniform())
         value = init(shape, dtype)
         p = Parameter(value, name=attr.name or _unique_name("param"),

@@ -1,0 +1,55 @@
+"""Pretrained-weight resolution for `pretrained=True` model factories.
+
+Reference surface: `vision/models/resnet.py` pretrained path —
+`get_weights_path_from_url(model_urls[arch])` + `paddle.load` +
+`set_state_dict`. Zero-egress resolution order here:
+
+  1. `pretrained` given as a PATH string -> load that file;
+  2. `PADDLE_TPU_PRETRAINED_ROOT` env dir -> `<root>/<name>.pdparams`
+     (put converted reference weights there; see
+     tools/make_pretrained_fixtures.py for the fixture generator and
+     the conversion notes in its docstring);
+  3. the packaged fixtures dir (`paddle_tpu/pretrained_fixtures/`) —
+     small self-trained fixture weights for in-suite accuracy tests.
+
+Each .pdparams may have a `.md5` sidecar; when present the hash is
+verified before loading.
+"""
+import os
+
+__all__ = ["load_pretrained", "resolve_weights"]
+
+_FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "pretrained_fixtures")
+
+
+def resolve_weights(name, pretrained=True):
+    if isinstance(pretrained, str):
+        return pretrained
+    roots = []
+    env = os.environ.get("PADDLE_TPU_PRETRAINED_ROOT")
+    if env:
+        roots.append(env)
+    roots.append(_FIXTURE_DIR)
+    for root in roots:
+        cand = os.path.join(root, f"{name}.pdparams")
+        if os.path.exists(cand):
+            return cand
+    raise FileNotFoundError(
+        f"no pretrained weights for {name!r} (searched {roots}); this "
+        "environment has no downloader — convert reference weights "
+        "offline and point PADDLE_TPU_PRETRAINED_ROOT at them, or pass "
+        "pretrained='<path>'")
+
+
+def load_pretrained(model, name, pretrained=True):
+    """Resolve + md5-verify + set_state_dict. Returns the model."""
+    path = resolve_weights(name, pretrained)
+    md5 = None
+    sidecar = path + ".md5"
+    if os.path.exists(sidecar):
+        md5 = open(sidecar).read().strip()
+    from .hub import load_state_dict_from_path
+    state = load_state_dict_from_path(path, md5=md5)
+    model.set_state_dict(state)
+    return model

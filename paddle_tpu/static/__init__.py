@@ -346,3 +346,13 @@ class ExecutionStrategy:
         if name in type(self)._KNOWN:
             return self.__dict__["_values"].get(name)
         raise AttributeError(name)
+
+
+from .compat import (  # noqa: F401,E402
+    Variable, accuracy, auc, append_backward, gradients,
+    create_parameter, create_global_var, cpu_places, cuda_places,
+    xpu_places, global_scope, scope_guard, save, load, save_to_file,
+    load_from_file, serialize_program, deserialize_program,
+    serialize_persistables, deserialize_persistables,
+    load_program_state, set_program_state, normalize_program,
+    ExponentialMovingAverage, ParallelExecutor)

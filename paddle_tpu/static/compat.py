@@ -1,0 +1,300 @@
+"""paddle.static parity surface: program/state serialization, places,
+backward/metric helpers.
+
+Reference: `python/paddle/static/__init__.py` exports backed by
+`fluid/io.py` (save/load/serialize), `fluid/framework.py` (Variable,
+scopes), `fluid/backward.py` (append_backward/gradients) and
+`fluid/layers/metric_op.py` (accuracy/auc). The executable serialized
+form of a program in this framework is the StableHLO artifact
+(`inference.save_inference_model`); the serialize_* functions here cover
+the PARAMETER/state side plus a structural program record, which is what
+reference users round-trip through these APIs.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..core import autograd
+
+__all__ = [
+    "Variable", "accuracy", "auc", "append_backward", "gradients",
+    "create_parameter", "create_global_var", "cpu_places", "cuda_places",
+    "xpu_places", "global_scope", "scope_guard", "save", "load",
+    "save_to_file", "load_from_file", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "load_program_state",
+    "set_program_state", "normalize_program", "ExponentialMovingAverage",
+    "ParallelExecutor",
+]
+
+Variable = Tensor          # one tensor type in both "worlds" (L2 dissolves)
+
+
+# ---------------------------------------------------------------- places
+
+def cpu_places(device_count=None):
+    from ..framework import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """The accelerator-place list. On this framework the accelerator is
+    whatever PJRT exposes (TPU); returns one place per visible chip."""
+    from ..framework import TPUPlace
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+xpu_places = cuda_places
+
+
+# ---------------------------------------------------------------- scopes
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, Tensor(jnp.zeros((), jnp.float32)))
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _SCOPE_STACK.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _SCOPE_STACK.pop()
+        return False
+
+
+# ------------------------------------------------------------- backward
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference `backward.py:1390`: emit gradients for `loss` and
+    return [(param, grad)] pairs. Here the tape IS the program record —
+    running backward materializes `.grad` on every trainable tensor."""
+    autograd.backward(loss)
+    if parameter_list is None:
+        from . import default_main_program
+        params = default_main_program().all_parameters()
+    else:
+        params = list(parameter_list)
+    return [(p, p.grad) for p in params
+            if p is not None and p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return autograd.grad(targets, inputs,
+                         grad_outputs=target_gradients)
+
+
+# --------------------------------------------------------------- metrics
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference `metric_op.py accuracy`)."""
+    def fn(logits, y):
+        topk = jnp.argsort(logits, axis=-1)[..., -k:]
+        y = y.reshape(y.shape[0], 1)
+        hit = jnp.any(topk == y, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32)).reshape(1)
+    return apply(fn, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Batch AUC via threshold buckets (reference `metric_op.py auc`).
+    Returns (auc_value, batch_auc_value) like the reference tuple's
+    leading entries."""
+    def fn(probs, y):
+        pos_prob = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+            else probs.reshape(-1)
+        y = y.reshape(-1)
+        edges = jnp.linspace(0.0, 1.0, num_thresholds + 1)
+        idx = jnp.clip(jnp.searchsorted(edges, pos_prob) - 1, 0,
+                       num_thresholds - 1)
+        pos = jnp.zeros(num_thresholds).at[idx].add(y == 1)
+        neg = jnp.zeros(num_thresholds).at[idx].add(y == 0)
+        # integrate TPR over FPR (trapezoid over buckets, high->low thresh)
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tp_tot = jnp.maximum(tp[-1], 1)
+        fp_tot = jnp.maximum(fp[-1], 1)
+        tpr = tp / tp_tot
+        fpr = fp / fp_tot
+        a = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2)
+        a = a + fpr[0] * tpr[0] / 2
+        return a.reshape(1)
+    val = apply(fn, input, label)
+    return val, val
+
+
+# --------------------------------------------------- parameters / state
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    import paddle_tpu
+    return paddle_tpu.create_parameter(
+        shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+        default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+               stop_gradient=True)
+    t.name = name or "global_var"
+    global_scope()[t.name] = t
+    return t
+
+
+def _program_params(program):
+    if program is None or not hasattr(program, "all_parameters"):
+        from . import default_main_program
+        program = default_main_program()
+    return {getattr(p, "name", None) or f"param_{i}": p
+            for i, p in enumerate(program.all_parameters())}
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kw):
+    prog = program if program is not None else feed_vars  # 1-arg form
+    params = _program_params(prog if not isinstance(prog, (list, tuple))
+                             else None)
+    blob = {n: np.asarray(p.numpy()) for n, p in params.items()}
+    return pickle.dumps(blob, protocol=4)
+
+
+def deserialize_persistables(program, data, scope=None):
+    blob = pickle.loads(data)
+    params = _program_params(program)
+    for n, arr in blob.items():
+        if n in params:
+            params[n]._value = jnp.asarray(arr)
+    return blob
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kw):
+    """Structural program record. The EXECUTABLE serialized form is the
+    StableHLO artifact (save_inference_model); this captures the
+    recorder's var/op listing, which is what reference code inspects
+    after deserialize_program."""
+    prog = program if program is not None else feed_vars
+    if prog is None or isinstance(prog, (list, tuple)):
+        from . import default_main_program
+        prog = default_main_program()
+    record = {
+        "vars": [getattr(v, "name", str(i))
+                 for i, v in enumerate(prog.list_vars())],
+        "ops": [op.fn.__name__ if hasattr(op, "fn") else str(op)
+                for op in getattr(prog, "_ops", [])],
+    }
+    return pickle.dumps(record, protocol=4)
+
+
+class _DeserializedProgram:
+    def __init__(self, record):
+        self._record = record
+
+    def list_vars(self):
+        return list(self._record["vars"])
+
+    @property
+    def ops(self):
+        return list(self._record["ops"])
+
+
+def deserialize_program(data):
+    return _DeserializedProgram(pickle.loads(data))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Reference `static.save`: <path>.pdparams (+.pdmodel)."""
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables(program=program))
+    save_to_file(model_path + ".pdmodel", serialize_program(program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    data = load_from_file(model_path + ".pdparams")
+    deserialize_persistables(program, data)
+
+
+def load_program_state(model_path, var_list=None):
+    return {n: np.asarray(a) for n, a in
+            pickle.loads(load_from_file(model_path + ".pdparams")).items()}
+
+
+def set_program_state(program, state_dict):
+    params = _program_params(program)
+    for n, arr in state_dict.items():
+        if n in params:
+            params[n]._value = jnp.asarray(arr)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kw):
+    """Reference prunes the program to the inference subgraph; trace-
+    compile re-derives that from the traced function, so the program
+    passes through (clone-for-test semantics)."""
+    return program.clone(for_test=True) if hasattr(program, "clone") \
+        else program
+
+
+# --------------------------------------------------------------- shims
+
+from ..optimizer.extras import ExponentialMovingAverage  # noqa: E402,F401
+
+
+class ParallelExecutor:
+    """Compat face over Executor (reference `parallel_executor.cc`): the
+    multi-device SSA executor dissolves into GSPMD — one compiled program
+    spans the mesh — so this delegates to Executor and exposes the
+    legacy attrs code touches."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from . import Executor
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(program=self._program,
+                             feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+    @property
+    def device_count(self):
+        return len(jax.devices())

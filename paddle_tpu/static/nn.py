@@ -163,3 +163,9 @@ from ..tensor.sequence import (  # noqa: F401,E402
     sequence_concat, sequence_reverse, sequence_slice, sequence_erase,
     sequence_enumerate, sequence_conv, sequence_expand_as,
 )
+
+from .nn_extra import (  # noqa: F401,E402
+    bilinear_tensor_product, conv3d_transpose, crf_decoding, data_norm,
+    deform_conv2d, multi_box_head, nce, py_func, row_conv,
+    sequence_expand, sequence_first_step, sequence_last_step,
+    sequence_reshape, sequence_scatter, sparse_embedding, spectral_norm)

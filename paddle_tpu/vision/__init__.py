@@ -4,3 +4,32 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
 from . import detection  # noqa: F401
+
+# ---- image backend + loading (reference `vision/image.py`) -----------
+_IMAGE_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    global _IMAGE_BACKEND
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image file. pil backend returns a PIL.Image (reference
+    behavior); 'tensor'/'cv2' return HWC numpy (BGR for cv2 parity)."""
+    backend = backend or _IMAGE_BACKEND
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as _np
+    arr = _np.asarray(img)
+    if backend == "cv2" and arr.ndim == 3 and arr.shape[-1] >= 3:
+        arr = arr[..., [2, 1, 0]]       # RGB -> BGR, cv2 convention
+    return arr

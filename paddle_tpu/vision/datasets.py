@@ -127,3 +127,132 @@ class SyntheticMNIST(Dataset):
 
     def __len__(self):
         return len(self.labels)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (reference
+    `vision/datasets/folder.py` DatasetFolder): root/<class>/<img>."""
+
+    IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image collection without labels (reference
+    `vision/datasets/folder.py` ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = tuple(e.lower() for e in
+                     (extensions or DatasetFolder.IMG_EXTS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(DatasetFolder):
+    """Flowers102 from a local extracted copy (reference downloads;
+    zero-egress here: point `root`/FLOWERS_DATA_ROOT at a class-per-dir
+    layout)."""
+
+    def __init__(self, root=None, mode="train", transform=None,
+                 download=False, backend=None):
+        root = root or os.environ.get("FLOWERS_DATA_ROOT", "")
+        if not root or not os.path.isdir(root):
+            raise FileNotFoundError(
+                "Flowers data not found; this environment has no network "
+                "access — set FLOWERS_DATA_ROOT to an extracted copy or "
+                "use FakeData")
+        super().__init__(root, transform=transform)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from a local VOCdevkit (reference
+    downloads; zero-egress here)."""
+
+    def __init__(self, root=None, mode="train", transform=None,
+                 download=False, backend=None):
+        root = root or os.environ.get("VOC_DATA_ROOT", "")
+        base = os.path.join(root, "VOC2012")
+        lists = os.path.join(base, "ImageSets", "Segmentation",
+                             f"{'train' if mode == 'train' else 'val'}.txt")
+        if not os.path.exists(lists):
+            raise FileNotFoundError(
+                "VOC2012 not found; set VOC_DATA_ROOT to a VOCdevkit "
+                "directory (no network access in this environment)")
+        names = [l.strip() for l in open(lists) if l.strip()]
+        self.pairs = [
+            (os.path.join(base, "JPEGImages", f"{n}.jpg"),
+             os.path.join(base, "SegmentationClass", f"{n}.png"))
+            for n in names]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        ip, lp = self.pairs[idx]
+        img = np.asarray(Image.open(ip).convert("RGB"))
+        lbl = np.asarray(Image.open(lp))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.pairs)

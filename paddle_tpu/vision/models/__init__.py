@@ -1,5 +1,5 @@
 """Model zoo — parity with `python/paddle/vision/models/__init__.py`."""
-from .lenet import LeNet  # noqa: F401
+from .lenet import LeNet, lenet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
     resnet101, resnet152, resnext50_32x4d, wide_resnet50_2,

@@ -23,3 +23,14 @@ class LeNet(Layer):
             x = flatten(x, 1)
             x = self.fc(x)
         return x
+
+
+def lenet(pretrained=False, num_classes=10):
+    """LeNet factory with optional packaged fixture weights
+    (`lenet_synthdigits`: self-trained on the synthetic digit task the
+    suite's accuracy gates use)."""
+    model = LeNet(num_classes=num_classes)
+    if pretrained:
+        from ...pretrained import load_pretrained
+        load_pretrained(model, "lenet_synthdigits", pretrained)
+    return model

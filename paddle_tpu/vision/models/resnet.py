@@ -122,33 +122,39 @@ class ResNet(Layer):
         return x
 
 
-def _resnet(block, depth, **kwargs):
-    return ResNet(block, depth, **kwargs)
+def _resnet(block, depth, pretrained=False, arch=None, **kwargs):
+    model = ResNet(block, depth, **kwargs)
+    if pretrained:
+        from ...pretrained import load_pretrained
+        load_pretrained(model, arch, pretrained)
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, **kwargs)
+    return _resnet(BasicBlock, 18, pretrained, "resnet18", **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, **kwargs)
+    return _resnet(BasicBlock, 34, pretrained, "resnet34", **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, "resnet50", **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained, "resnet101", **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained, "resnet152", **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, groups=32, width=4, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, "resnext50_32x4d",
+                   groups=32, width=4, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=128, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained, "wide_resnet50_2",
+                   width=128, **kwargs)

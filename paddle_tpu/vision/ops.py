@@ -28,6 +28,7 @@ from ._boxes import iou_matrix, nms_mask, NEG_INF
 __all__ = [
     "roi_align", "RoIAlign", "roi_pool", "RoIPool", "psroi_pool",
     "PSRoIPool", "deform_conv2d", "DeformConv2D", "yolo_box", "yolo_loss",
+    "read_file", "decode_jpeg",
     "nms",
 ]
 
@@ -653,3 +654,40 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         return Tensor(out)
     idx = np.asarray(order)[np.asarray(kept_sorted)]
     return Tensor(jnp.asarray(idx, jnp.int32))
+
+
+# ---- image file ops (reference `python/paddle/vision/ops.py:819,864`
+# read_file / decode_jpeg — there backed by a CUDA nvjpeg kernel) ------
+
+def read_file(filename, name=None):
+    """Raw file bytes as a 1-D uint8 tensor (reference `read_file`).
+    Host-side: file IO feeds the input pipeline, not the chip."""
+    import numpy as _np
+    from ..core.tensor import Tensor as _T
+    with open(filename, "rb") as f:
+        data = f.read()
+    return _T(jnp.asarray(_np.frombuffer(data, _np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to a CHW uint8 tensor (reference
+    `decode_jpeg`, nvjpeg kernel; PIL does the host-side decode here —
+    decode is data-pipeline work, the chip sees dense batches).
+    mode: 'unchanged' | 'gray' | 'rgb'."""
+    import io as _io
+    import numpy as _np
+    from PIL import Image
+    from ..core.tensor import Tensor as _T
+    raw = bytes(_np.asarray(x._value if hasattr(x, "_value") else x,
+                            _np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                  # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)     # HWC -> CHW
+    return _T(jnp.asarray(arr))

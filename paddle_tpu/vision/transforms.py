@@ -266,36 +266,39 @@ class HueTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0 or img.ndim == 2 or img.shape[-1] == 1:
             return img
-        shift = np.random.uniform(-self.value, self.value)
-        scale = 255.0 if np.issubdtype(img.dtype, np.integer) else 1.0
-        rgb = img[..., :3].astype(np.float32) / scale
-        maxc = rgb.max(-1)
-        minc = rgb.min(-1)
-        v = maxc
-        d = maxc - minc
-        s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
-        dsafe = np.maximum(d, 1e-12)
-        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
-        h = np.where(maxc == r, (g - b) / dsafe % 6,
-                     np.where(maxc == g, (b - r) / dsafe + 2,
-                              (r - g) / dsafe + 4)) / 6.0
-        h = np.where(d == 0, 0.0, h)
-        h = (h + shift) % 1.0
-        i = np.floor(h * 6.0)
-        f = h * 6.0 - i
-        p = v * (1 - s)
-        q = v * (1 - s * f)
-        t = v * (1 - s * (1 - f))
-        i = i.astype(np.int32) % 6
-        out = np.stack([
-            np.choose(i, [v, q, p, p, t, v]),
-            np.choose(i, [t, v, v, q, p, p]),
-            np.choose(i, [p, p, t, v, v, q]),
-        ], axis=-1) * scale
-        if img.shape[-1] > 3:  # preserve alpha/extra channels
-            out = np.concatenate(
-                [out, img[..., 3:].astype(np.float32)], axis=-1)
-        return _clip_like(out, img)
+        return _hue_shift(img, np.random.uniform(-self.value, self.value))
+
+
+def _hue_shift(img, shift):
+    scale = 255.0 if np.issubdtype(img.dtype, np.integer) else 1.0
+    rgb = img[..., :3].astype(np.float32) / scale
+    maxc = rgb.max(-1)
+    minc = rgb.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dsafe = np.maximum(d, 1e-12)
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(maxc == r, (g - b) / dsafe % 6,
+                 np.where(maxc == g, (b - r) / dsafe + 2,
+                          (r - g) / dsafe + 4)) / 6.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + shift) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    out = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], axis=-1) * scale
+    if img.shape[-1] > 3:  # preserve alpha/extra channels
+        out = np.concatenate(
+            [out, img[..., 3:].astype(np.float32)], axis=-1)
+    return _clip_like(out, img)
 
 
 class ColorJitter(BaseTransform):
@@ -335,3 +338,136 @@ def hflip(img):
 
 def vflip(img):
     return np.asarray(img)[::-1].copy()
+
+
+# ---- round-3 parity: crop/pad/rotate/grayscale + functional forms ------
+# (reference `python/paddle/vision/transforms/functional.py`)
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    img = np.asarray(img)
+    th, tw = _size_pair(output_size)
+    h, w = img.shape[:2]
+    return crop(img, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """padding: int | [pad_lr, pad_tb] | [left, top, right, bottom]."""
+    img = np.asarray(img)
+    if isinstance(padding, int):
+        l = t = r = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    widths = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, widths, mode=mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees about `center` (image
+    center by default). Inverse-map + gather — no scipy dependency."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        nh = int(np.ceil(abs(h * cos) + abs(w * sin)))
+        nw = int(np.ceil(abs(w * cos) + abs(h * sin)))
+    else:
+        nh, nw = h, w
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    oy, ox = (nh - 1) / 2.0, (nw - 1) / 2.0
+    # rotate output coords BACK into source space
+    sy = cy + (yy - oy) * cos - (xx - ox) * sin
+    sx = cx + (yy - oy) * sin + (xx - ox) * cos
+    if interpolation == "bilinear":
+        y0 = np.floor(sy).astype(np.int64)
+        x0 = np.floor(sx).astype(np.int64)
+        wy, wx = sy - y0, sx - x0
+        out = 0.0
+        for dy, fy in ((0, 1 - wy), (1, wy)):
+            for dx, fx in ((0, 1 - wx), (1, wx)):
+                yi = np.clip(y0 + dy, 0, h - 1)
+                xi = np.clip(x0 + dx, 0, w - 1)
+                contrib = img[yi, xi].astype(np.float32)
+                f = (fy * fx)
+                out = out + contrib * (f[..., None] if img.ndim == 3
+                                       else f)
+        out = out
+    else:
+        yi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+        xi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+        out = img[yi, xi].astype(np.float32)
+    inside = (sy >= -0.5) & (sy <= h - 0.5) & (sx >= -0.5) & (sx <= w - 0.5)
+    if img.ndim == 3:
+        inside = inside[..., None]
+    out = np.where(inside, out, np.float32(fill))
+    return _clip_like(out, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    g = _gray(np.asarray(img))
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return _clip_like(g, np.asarray(img))
+
+
+def adjust_brightness(img, brightness_factor):
+    img = np.asarray(img)
+    return _clip_like(img.astype(np.float32) * brightness_factor, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = np.asarray(img)
+    mean = _gray(img).mean()
+    return _blend_rgb(img, lambda rgb: mean + (rgb - mean) * contrast_factor)
+
+
+def adjust_hue(img, hue_factor):
+    img = np.asarray(img)
+    if img.ndim == 2 or img.shape[-1] == 1:
+        return img
+    return _hue_shift(img, hue_factor)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)

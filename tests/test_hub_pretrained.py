@@ -1,0 +1,167 @@
+"""hub + pretrained weights + image decode ops (reference
+`python/paddle/hub.py`, `vision/models/resnet.py` pretrained path,
+`vision/ops.py:819,864` read_file/decode_jpeg)."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import hub
+
+
+def _synth_digits(n, rs):
+    templates = np.random.RandomState(42).rand(10, 28, 28) > 0.6
+    ys = rs.randint(0, 10, n)
+    xs = templates[ys].astype(np.float32)
+    xs += rs.randn(n, 28, 28).astype(np.float32) * 0.35
+    return xs[:, None], ys.astype(np.int64)
+
+
+def test_lenet_pretrained_fixture_real_accuracy():
+    """pretrained=True loads packaged weights and the model is actually
+    GOOD — accuracy, not just shapes (VERDICT item 8)."""
+    from paddle_tpu.vision.models import lenet
+    net = lenet(pretrained=True)
+    net.eval()
+    xt, yt = _synth_digits(512, np.random.RandomState(31337))
+    logits = np.asarray(net(paddle.to_tensor(xt)).numpy())
+    acc = float((logits.argmax(1) == yt).mean())
+    assert acc >= 0.95, acc
+
+
+def test_crnn_pretrained_fixture_decodes_text():
+    """OCR rec with real (fixture) weights: greedy CTC decode recovers
+    the glyph string on unseen samples."""
+    from paddle_tpu.models.ocr import crnn_synth, ctc_greedy_decode
+    net = crnn_synth(pretrained=True)
+    net.eval()
+    rs = np.random.RandomState(2024)
+    glyphs = np.random.RandomState(7).rand(11, 32, 12) > 0.55
+    labels = rs.randint(1, 12, (32, 5))
+    imgs = np.zeros((32, 32, 60), np.float32)
+    for i in range(32):
+        for j in range(5):
+            imgs[i, :, j * 12:(j + 1) * 12] = glyphs[labels[i, j] - 1]
+    imgs += rs.randn(32, 32, 60).astype(np.float32) * 0.15
+    logits = net(paddle.to_tensor(imgs[:, None]))
+    pred = ctc_greedy_decode(logits)
+    pred_np = np.asarray(pred.numpy() if hasattr(pred, "numpy") else pred)
+    exact = sum(
+        int([int(t) for t in pred_np[i] if t > 0] ==
+            [int(v) for v in labels[i]])
+        for i in range(32))
+    assert exact / 32 >= 0.85, exact / 32
+
+
+def test_md5_check_rejects_corruption(tmp_path):
+    from paddle_tpu.pretrained import resolve_weights
+    src = resolve_weights("lenet_synthdigits")
+    blob = bytearray(open(src, "rb").read())
+    blob[100] ^= 0xFF
+    bad = tmp_path / "lenet_synthdigits.pdparams"
+    bad.write_bytes(bytes(blob))
+    good_md5 = open(src + ".md5").read().strip()
+    (tmp_path / "lenet_synthdigits.pdparams.md5").write_text(good_md5)
+    from paddle_tpu.vision.models import lenet
+    with pytest.raises(RuntimeError, match="md5 mismatch"):
+        lenet(pretrained=str(bad))
+    # ...because the sidecar next to the corrupted file is consulted
+    assert os.path.exists(str(bad) + ".md5")
+
+
+def test_resnet_pretrained_roundtrip_accuracy(tmp_path):
+    """ResNet classification with real weights through the pretrained
+    path: train -> save as <arch>.pdparams -> load via
+    PADDLE_TPU_PRETRAINED_ROOT -> same accuracy."""
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    # 4-class 32x32 synthetic: class = dominant quadrant intensity
+    def batch(n, rs):
+        ys = rs.randint(0, 4, n)
+        xs = rs.randn(n, 3, 32, 32).astype(np.float32) * 0.3
+        for i, y in enumerate(ys):
+            r, c = divmod(int(y), 2)
+            xs[i, :, r * 16:(r + 1) * 16, c * 16:(c + 1) * 16] += 1.5
+        return xs, ys.astype(np.int64)
+
+    net = resnet18(num_classes=4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda a, b: F.cross_entropy(net(a), b), opt)
+    for _ in range(12):
+        xs, ys = batch(32, rs)
+        step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    net.eval()
+    xt, yt = batch(128, np.random.RandomState(5))
+
+    def acc(m):
+        return float((np.asarray(m(paddle.to_tensor(xt)).numpy())
+                      .argmax(1) == yt).mean())
+    trained_acc = acc(net)
+    assert trained_acc > 0.8, trained_acc
+    paddle.save(net.state_dict(), str(tmp_path / "resnet18.pdparams"))
+    os.environ["PADDLE_TPU_PRETRAINED_ROOT"] = str(tmp_path)
+    try:
+        net2 = resnet18(pretrained=True, num_classes=4)
+        net2.eval()
+        assert abs(acc(net2) - trained_acc) < 1e-6
+    finally:
+        del os.environ["PADDLE_TPU_PRETRAINED_ROOT"]
+
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_mlp(width=4):\n"
+        "    '''A tiny MLP entrypoint.'''\n"
+        "    from paddle_tpu import nn\n"
+        "    return nn.Linear(width, width)\n")
+    assert "tiny_mlp" in hub.list(str(tmp_path))
+    assert "tiny MLP" in hub.help(str(tmp_path), "tiny_mlp")
+    layer = hub.load(str(tmp_path), "tiny_mlp", width=6)
+    assert tuple(layer.weight.shape) == (6, 6)
+    with pytest.raises(RuntimeError, match="network"):
+        hub.load(str(tmp_path), "tiny_mlp", source="github")
+    with pytest.raises(ValueError, match="entrypoint"):
+        hub.load(str(tmp_path), "nope")
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+    from paddle_tpu.vision import ops
+    y, x = np.mgrid[0:16, 0:20]
+    img = np.stack([x * 12, y * 15, (x + y) * 7], -1).astype(np.uint8)
+    p = tmp_path / "t.jpg"
+    Image.fromarray(img).save(str(p), format="JPEG", quality=95)
+    raw = ops.read_file(str(p))
+    assert np.asarray(raw.numpy()).dtype == np.uint8 and len(raw.shape) == 1
+    dec = ops.decode_jpeg(raw)
+    assert tuple(dec.shape) == (3, 16, 20)
+    err = np.abs(np.asarray(dec.numpy()).transpose(1, 2, 0).astype(int) -
+                 img.astype(int)).mean()
+    assert err < 6, err
+    g = ops.decode_jpeg(raw, mode="gray")
+    assert tuple(g.shape) == (1, 16, 20)
+
+
+def test_folder_datasets(tmp_path):
+    from PIL import Image
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            arr = (np.random.RandomState(i).rand(8, 8, 3) * 255
+                   ).astype(np.uint8)
+            Image.fromarray(arr).save(str(d / f"{i}.png"))
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"] and len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6 and flat[0][0].shape == (8, 8, 3)

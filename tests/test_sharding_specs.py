@@ -87,3 +87,21 @@ def test_batch_input_sharding(mp_mesh):
     assert tuple(sh.spec) == ("dp",)
     v = jax.device_put(np.zeros((8, 4), np.float32), sh)
     assert v.sharding.shard_shape(v.shape) == (4, 4)
+
+
+def test_search_plan_13b_feasible_on_v5p_pods():
+    """BASELINE config 5: gpt3_13b must have feasible dp x mp x pp plans
+    on v5p-32 and v5p-64; the planner enumerates them."""
+    from paddle_tpu.distributed import search_plan
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig.gpt3_13b(max_seq_len=2048)
+    p32 = search_plan(cfg, 32, chip="v5p")
+    p64 = search_plan(cfg, 64, chip="v5p")
+    assert p32 and p64
+    best = p32[0].detail
+    assert best["mp"] * best["pp"] * best["dp"] == 32
+    # plans must honor divisibility: mp | heads(40) and pp | layers(40)
+    for p in p32:
+        assert 40 % p.detail["mp"] == 0 and 40 % p.detail["pp"] == 0
+    # 13B without remat at full seq should NOT fit a v5e (16 GiB) chip
+    assert search_plan(cfg, 4, chip="v5e", remat=False) == []

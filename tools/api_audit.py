@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""API-surface audit: reference `python/paddle` public symbols vs
+paddle_tpu.
+
+Reference analog: `tools/check_api_compatible.py` (the reference CI's
+API-diff gate). The reference package cannot be imported here (its C++
+core isn't built), so its public surface is recovered STATICALLY: each
+audited namespace's `__init__.py` is AST-parsed for `__all__`
+assignments/extensions. Every symbol is then classified against the
+living paddle_tpu package:
+
+  present   — getattr succeeds on the mapped paddle_tpu namespace
+  obviated  — in the curated allowlist below: capability exists but the
+              TPU-native design dissolves the symbol (each entry says
+              why)
+  missing   — everything else: a real gap
+
+Output: api_gap.json + a summary line per namespace. Exit code stays 0
+(informational gate, like the reference's API-diff report-not-block
+default) unless --strict.
+"""
+import argparse
+import ast
+import json
+import os
+import sys
+
+REF_ROOT = "/root/reference/python/paddle"
+
+# namespace -> paddle_tpu attribute path ("" = top level)
+NAMESPACES = {
+    "paddle": "",
+    "paddle.nn": "nn",
+    "paddle.nn.functional": "nn.functional",
+    "paddle.nn.initializer": "nn.initializer",
+    "paddle.tensor": "tensor",
+    "paddle.optimizer": "optimizer",
+    "paddle.optimizer.lr": "optimizer.lr",
+    "paddle.distributed": "distributed",
+    "paddle.distributed.fleet": "distributed.fleet",
+    "paddle.static": "static",
+    "paddle.static.nn": "static.nn",
+    "paddle.jit": "jit",
+    "paddle.io": "io",
+    "paddle.amp": "amp",
+    "paddle.autograd": "autograd",
+    "paddle.metric": "metric",
+    "paddle.vision": "vision",
+    "paddle.vision.models": "vision.models",
+    "paddle.vision.transforms": "vision.transforms",
+    "paddle.vision.datasets": "vision.datasets",
+    "paddle.vision.ops": "vision.ops",
+    "paddle.text": "text",
+    "paddle.inference": "inference",
+    "paddle.onnx": "onnx",
+    "paddle.utils": "utils",
+    "paddle.device": "device",
+    "paddle.incubate": "incubate",
+}
+
+# symbol -> one-line reason the TPU-native design dissolves it.
+# Namespaced as "namespace:symbol"; "*:symbol" matches anywhere.
+OBVIATED = {
+    # CUDA/place machinery: devices are PJRT-owned; one logical device API
+    "*:CUDAPinnedPlace": "no pinned-host staging API: PJRT owns transfers",
+    "*:XPUPlace": "vendor place dissolved; TPU is the device",
+    "*:NPUPlace": "vendor place dissolved; TPU is the device",
+    "*:IPUPlace": "vendor place dissolved",
+    "*:MLUPlace": "vendor place dissolved",
+    "*:CustomPlace": "device plugin model replaced by PJRT plugins",
+    "*:is_compiled_with_ipu": "vendor probe: no IPU build exists",
+    "*:is_compiled_with_mlu": "vendor probe: no MLU build exists",
+    "*:is_compiled_with_cinn": "CINN compiler replaced by XLA",
+    "*:device_guard": "placement is GSPMD sharding, not per-op guards",
+    # static-graph program machinery that trace-compile dissolves
+    "paddle.static:Print": "debug op: eager print/callback under trace",
+    "paddle.static:py_func": "host callbacks via jax.pure_callback",
+    "paddle.static:create_py_reader_by_data": "DataLoader replaces",
+    "paddle.distributed:ProbabilityEntry": "PS table entry configs ride "
+    "SparseTable kwargs",
+    "paddle.distributed:CountFilterEntry": "PS table entry configs ride "
+    "SparseTable kwargs",
+    # dataset namespace: reference bundles dataset DOWNLOADERS; zero-egress
+    "paddle.text:viterbi_decode": "lives in paddle_tpu.text.viterbi",
+}
+
+
+def ref_public_symbols(ns):
+    """Symbols of a reference namespace via static __all__ parsing."""
+    rel = ns.replace("paddle", "", 1).replace(".", "/")
+    path = os.path.join(REF_ROOT + rel, "__init__.py")
+    if not os.path.exists(path):
+        return None
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    symbols = []
+
+    def lits(node):
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant) and
+                    isinstance(e.value, str)]
+        return []
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == "__all__"
+                   for t in node.targets):
+                symbols.extend(lits(node.value))
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and \
+                    node.target.id == "__all__":
+                symbols.extend(lits(node.value))
+    return sorted(set(symbols))
+
+
+def audit():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu
+
+    report = {}
+    totals = {"present": 0, "obviated": 0, "missing": 0}
+    for ns, attr_path in NAMESPACES.items():
+        ref_syms = ref_public_symbols(ns)
+        if ref_syms is None:
+            continue
+        target = paddle_tpu
+        ok = True
+        for part in [p for p in attr_path.split(".") if p]:
+            target = getattr(target, part, None)
+            if target is None:
+                ok = False
+                break
+        entry = {"present": [], "obviated": {}, "missing": []}
+        for sym in ref_syms:
+            reason = OBVIATED.get(f"{ns}:{sym}") or OBVIATED.get(f"*:{sym}")
+            if ok and getattr(target, sym, None) is not None:
+                entry["present"].append(sym)
+            elif reason:
+                entry["obviated"][sym] = reason
+            else:
+                entry["missing"].append(sym)
+        report[ns] = entry
+        for k in totals:
+            totals[k] += len(entry[k])
+    report["_totals"] = totals
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="api_gap.json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when anything is missing")
+    args = ap.parse_args()
+    report = audit()
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    t = report["_totals"]
+    n = t["present"] + t["obviated"] + t["missing"]
+    print(f"api audit: {t['present']}/{n} present, "
+          f"{t['obviated']} obviated, {t['missing']} missing "
+          f"-> {args.out}")
+    for ns, entry in sorted(report.items()):
+        if ns.startswith("_") or not entry["missing"]:
+            continue
+        print(f"  {ns}: missing {len(entry['missing'])}: "
+              f"{', '.join(entry['missing'][:12])}"
+              f"{' ...' if len(entry['missing']) > 12 else ''}")
+    if args.strict and t["missing"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] native build =="
+echo "== [1/4] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,10 +37,15 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/3] test suite =="
-python -m pytest tests/ -x -q
+echo "== [2/4] api-surface audit =="
+python tools/api_audit.py --out api_gap.json --strict
 
-echo "== [3/3] op benchmark gate =="
+echo "== [3/4] test suite =="
+# 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
+# each worker process builds its own 8-virtual-device CPU platform
+python -m pytest tests/ -q -n auto --dist loadfile
+
+echo "== [4/4] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

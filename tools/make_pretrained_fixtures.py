@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Generate the packaged pretrained fixture weights.
+
+Two small self-trained checkpoints land in
+`paddle_tpu/pretrained_fixtures/` (with .md5 sidecars):
+
+  lenet_synthdigits — LeNet trained to >=97% on the synthetic-digit
+      task (10 fixed random 28x28 templates + noise; the same
+      generator the test suite uses, split by seed)
+  crnn_synth        — fixture-config CRNN trained with CTC on synthetic
+      5-glyph strings until greedy decode is exact on held-out data
+
+Reproducible: fixed seeds, CPU platform. Re-run after any layer-naming
+change that breaks state_dict compatibility.
+
+Conversion note (real reference weights): dump the reference model's
+state_dict to numpy (torch/paddle -> {name: ndarray}), map names
+1:1 onto paddle_tpu's state_dict keys (they follow the same layer
+naming), save via paddle_tpu.save, drop the file under
+PADDLE_TPU_PRETRAINED_ROOT as <arch>.pdparams (+ .md5 sidecar).
+"""
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "pretrained_fixtures")
+
+
+def synth_digits(n, rs):
+    templates = np.random.RandomState(42).rand(10, 28, 28) > 0.6
+    ys = rs.randint(0, 10, n)
+    xs = templates[ys].astype(np.float32)
+    xs += rs.randn(n, 28, 28).astype(np.float32) * 0.35
+    return xs[:, None], ys.astype(np.int64)
+
+
+def synth_strings(n, rs, n_glyphs=11, length=5, width=60):
+    """[n,1,32,width] images of `length` glyph tiles + labels (1-based;
+    0 is the CTC blank)."""
+    glyphs = np.random.RandomState(7).rand(n_glyphs, 32, 12) > 0.55
+    labels = rs.randint(1, n_glyphs + 1, (n, length))
+    imgs = np.zeros((n, 32, width), np.float32)
+    for i in range(n):
+        for j in range(length):
+            imgs[i, :, j * 12:(j + 1) * 12] = glyphs[labels[i, j] - 1]
+    imgs += rs.randn(n, 32, width).astype(np.float32) * 0.15
+    return imgs[:, None], labels.astype(np.int64)
+
+
+def save_fixture(model, name):
+    os.makedirs(OUT, exist_ok=True)
+    path = os.path.join(OUT, f"{name}.pdparams")
+    paddle.save(model.state_dict(), path)
+    md5 = hashlib.md5(open(path, "rb").read()).hexdigest()
+    open(path + ".md5", "w").write(md5 + "\n")
+    print(f"{name}: {os.path.getsize(path) // 1024} KB md5={md5}")
+
+
+def make_lenet():
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    net = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda a, b: F.cross_entropy(net(a), b), opt)
+    for _ in range(40):
+        xs, ys = synth_digits(64, rs)
+        step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    net.eval()
+    xt, yt = synth_digits(512, np.random.RandomState(999))
+    acc = float((np.asarray(net(paddle.to_tensor(xt)).numpy())
+                 .argmax(1) == yt).mean())
+    assert acc >= 0.97, f"fixture LeNet under-trained: {acc}"
+    save_fixture(net, "lenet_synthdigits")
+
+
+def make_crnn():
+    from paddle_tpu.models.ocr import CRNN, ctc_greedy_decode
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    net = CRNN(in_channels=1, num_classes=12, hidden=16, rnn_hidden=24)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=net.parameters())
+
+    def loss_fn(im, lb, ll):
+        return net.loss(im, lb, ll)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    lens = paddle.to_tensor(np.full((32,), 5, np.int64))
+    for i in range(120):
+        im, lb = synth_strings(32, rs)
+        step(paddle.to_tensor(im), paddle.to_tensor(lb), lens)
+    net.eval()
+    im, lb = synth_strings(64, np.random.RandomState(999))
+    logits = net(paddle.to_tensor(im))
+    pred = ctc_greedy_decode(logits)
+    pred_np = np.asarray(pred.numpy() if hasattr(pred, "numpy") else pred)
+    exact = 0
+    for i in range(64):
+        seq = [int(t) for t in pred_np[i] if t > 0]
+        exact += int(seq == [int(v) for v in lb[i]])
+    acc = exact / 64
+    assert acc >= 0.9, f"fixture CRNN under-trained: {acc}"
+    save_fixture(net, "crnn_synth")
+
+
+if __name__ == "__main__":
+    make_lenet()
+    make_crnn()
