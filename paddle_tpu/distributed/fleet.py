@@ -533,12 +533,8 @@ class MultiSlotDataGenerator:
 
 
 class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
-    def _format(self, sample):
-        parts = []
-        for _name, feas in sample:
-            parts.append(str(len(feas)))
-            parts.extend(str(f) for f in feas)
-        return " ".join(parts)
+    """String-slot variant: features are emitted verbatim (already
+    strings), no numeric conversion (reference data_generator.py)."""
 
 
 from .topology import CommunicateTopology  # noqa: E402,F401

@@ -199,7 +199,17 @@ class PredictorPool:
     shares ONE compiled program and hands out lightweight handles."""
 
     def __init__(self, config, size=1):
-        self._preds = [create_predictor(config) for _ in range(int(size))]
+        first = create_predictor(config)
+        self._preds = [first]
+        for _ in range(int(size) - 1):
+            p = Predictor.__new__(Predictor)
+            p._config = first._config
+            p._model = first._model          # shared compiled program
+            p._inputs = {n: PredictorHandle(n)
+                         for n in first._model.input_names}
+            p._outputs = {n: PredictorHandle(n)
+                          for n in first._model.output_names}
+            self._preds.append(p)
 
     def retrive(self, idx):            # sic — reference API spelling
         return self._preds[idx]

@@ -52,4 +52,4 @@ from .layer.vision import PixelShuffle, PixelUnshuffle, ChannelShuffle  # noqa: 
 
 from ..generation import BeamSearchDecoder  # noqa: F401,E402
 
-from ..generation import dynamic_decode, BeamSearchDecoder  # noqa: F401,E402
+from ..generation import dynamic_decode  # noqa: F401,E402

@@ -206,7 +206,7 @@ def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kw):
         "vars": [getattr(v, "name", str(i))
                  for i, v in enumerate(prog.list_vars())],
         "ops": [op.fn.__name__ if hasattr(op, "fn") else str(op)
-                for op in getattr(prog, "_ops", [])],
+                for op in getattr(prog, "ops", [])],
     }
     return pickle.dumps(record, protocol=4)
 

@@ -166,15 +166,19 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     return mbox_locs, mbox_confs, all_boxes, all_vars
 
 
+_NCE_RNG = np.random.RandomState(12345)
+
+
 def nce(input, label, num_total_classes, sample_weight=None,
-        num_neg_samples=10, name=None, weight=None, bias=None, seed=0,
+        num_neg_samples=10, name=None, weight=None, bias=None, seed=None,
         **kw):
     """Noise-contrastive estimation loss (reference `nce_op.cc`):
     logistic loss on the true class + `num_neg_samples` uniform negative
-    classes. weight [num_total_classes, dim] required."""
+    classes, RESAMPLED per forward (a fixed `seed` pins them — tests
+    only). weight [num_total_classes, dim] required."""
     if weight is None:
         raise ValueError("nce needs an explicit weight [classes, dim]")
-    rs = np.random.RandomState(seed)
+    rs = np.random.RandomState(seed) if seed is not None else _NCE_RNG
     neg = rs.randint(0, num_total_classes,
                      (int(num_neg_samples),)).astype(np.int64)
 

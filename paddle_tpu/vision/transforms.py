@@ -417,10 +417,13 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
 
 
 def to_grayscale(img, num_output_channels=1):
-    g = _gray(np.asarray(img))
+    img = np.asarray(img)
+    g = _gray(img)
+    if g.ndim == 2:
+        g = g[..., None]            # 2-D grayscale input: add channel axis
     if num_output_channels == 3:
         g = np.repeat(g, 3, axis=-1)
-    return _clip_like(g, np.asarray(img))
+    return _clip_like(g, img)
 
 
 def adjust_brightness(img, brightness_factor):
