@@ -36,6 +36,10 @@ _spec.loader.exec_module(_nb)
 FLAGS = _nb._FLAGS
 
 NATIVE_LIBS = ["pskv", "kvstore", "ptio"]
+# PJRT-based serving runner + its hermetic test plugin: need the
+# vendored C-API header and -ldl
+NATIVE_PJRT = [("ptpredictor", "predictor.cc"),
+               ("pjrt_mock", "pjrt_mock_plugin.cc")]
 
 
 class BuildPyWithNative(build_py):
@@ -48,6 +52,13 @@ class BuildPyWithNative(build_py):
             src = os.path.join(here, "csrc", f"{name}.cc")
             so = os.path.join(out, f"lib{name}.so")
             subprocess.run(["g++", *FLAGS, src, "-o", so], check=True)
+            print(f"built native lib: {so}")
+        inc = os.path.join(here, "csrc", "third_party")
+        for name, srcname in NATIVE_PJRT:
+            src = os.path.join(here, "csrc", srcname)
+            so = os.path.join(out, f"lib{name}.so")
+            subprocess.run(["g++", *FLAGS, f"-I{inc}", src, "-o", so,
+                            "-ldl"], check=True)
             print(f"built native lib: {so}")
 
 
