@@ -229,3 +229,137 @@ def _walk(sample_fn, start_nodes, walk_len):
         walks[:, step + 1] = nxt
         cur = nxt
     return walks
+
+
+class GraphServer:
+    """Remote graph-sampling service: one process serves its GraphTable
+    shard's queries over the heter worker-pool transport (reference
+    `graph_brpc_server.cc` — the brpc service front end over
+    `common_graph_table.h`; here the RPC rides the C++ TCP KV store).
+
+    Server-side SAMPLING is the point (reference design): the client
+    ships node ids, the server walks its CSR and returns fixed-shape
+    [n, k] neighborhoods — the adjacency never crosses the wire."""
+
+    def __init__(self, table=None, port=0, directed=True, seed=0):
+        from .heter import HeterServer
+        self.table = table if table is not None else GraphTable(
+            directed=directed, seed=seed)
+        self._srv = HeterServer(port=port)
+        self.port = self._srv.port
+        t = self.table
+        self._srv.register("graph/sample_neighbors", lambda a: {
+            "out": t.sample_neighbors(a["nodes"], int(a["k"][0]),
+                                      bool(a["replace"][0]))})
+        self._srv.register("graph/degree", lambda a: {
+            "out": t.degree(a["nodes"])})
+        self._srv.register("graph/random_sample_nodes", lambda a: {
+            "out": t.random_sample_nodes(int(a["n"][0]))})
+        self._srv.register("graph/get_node_feat", lambda a: {
+            "out": t.get_node_feat(a["nodes"], int(a["dim"][0]))})
+        self._srv.register("graph/add_edges", lambda a: (
+            t.add_edges(a["src"], a["dst"]), {"ok": np.ones(1)})[1])
+        self._srv.register("graph/set_node_feature", lambda a: (
+            t.set_node_feature(a["nodes"], a["feat"]),
+            {"ok": np.ones(1)})[1])
+
+    def start(self):
+        self._srv.start()
+        return self
+
+    def stop(self):
+        self._srv.stop()
+
+
+class RemoteShardedGraph:
+    """Client over N GraphServer endpoints, node-hash routed — the
+    distributed form of ShardedGraph: same query API, but each shard's
+    sampling runs in ITS server process (scales past one host's memory,
+    unlike the in-process table the round-2 review called out).
+
+    endpoints: ["host:port", ...] — shard i owns nodes with
+    node % n_shards == i, matching ShardedGraph.add_edges routing."""
+
+    def __init__(self, endpoints, directed=True, seed=0):
+        from .heter import HeterClient
+        self.directed = directed
+        self._rng = np.random.RandomState(seed)
+        self._clients = []
+        for ep in endpoints:
+            host, _, port = ep.partition(":")
+            self._clients.append(HeterClient(host or "127.0.0.1",
+                                             int(port)))
+
+    @property
+    def n_shards(self):
+        return len(self._clients)
+
+    def add_edges(self, src, dst):
+        src = np.asarray(src, np.int64).ravel()
+        dst = np.asarray(dst, np.int64).ravel()
+        if not self.directed:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        sid = src % self.n_shards
+        pending = []
+        for i, c in enumerate(self._clients):
+            m = sid == i
+            if m.any():
+                pending.append((c, c.submit(
+                    "graph/add_edges", {"src": src[m], "dst": dst[m]})))
+        for c, h in pending:
+            c.wait(h)
+
+    def set_node_feature(self, node_ids, features):
+        nodes = np.asarray(node_ids, np.int64).ravel()
+        feats = np.asarray(features, np.float32)
+        sid = nodes % self.n_shards
+        for i, c in enumerate(self._clients):
+            m = sid == i
+            if m.any():
+                c.call("graph/set_node_feature",
+                       {"nodes": nodes[m], "feat": feats[m]})
+
+    def _routed(self, stage, nodes, extra, out_cols, dtype, default=0):
+        """Scatter a per-node query to owner shards (ASYNC fan-out: all
+        shards sample in parallel), gather into one fixed-shape array."""
+        nodes = np.asarray(nodes, np.int64).ravel()
+        sid = nodes % self.n_shards
+        out = np.full((nodes.size,) + out_cols, default, dtype)
+        pending = []
+        for i, c in enumerate(self._clients):
+            m = sid == i
+            if m.any():
+                payload = {"nodes": nodes[m], **extra}
+                pending.append((m, c, c.submit(stage, payload)))
+        for m, c, h in pending:
+            out[m] = c.wait(h)["out"]
+        return out
+
+    def sample_neighbors(self, nodes, sample_size, replace=True):
+        return self._routed(
+            "graph/sample_neighbors", nodes,
+            {"k": np.array([sample_size]),
+             "replace": np.array([int(replace)])},
+            (sample_size,), np.int64, default=-1)
+
+    def degree(self, nodes):
+        return self._routed("graph/degree", nodes, {}, (), np.int64)
+
+    def get_node_feat(self, nodes, feat_dim):
+        return self._routed("graph/get_node_feat", nodes,
+                            {"dim": np.array([feat_dim])},
+                            (feat_dim,), np.float32)
+
+    def random_sample_nodes(self, sample_size):
+        # uniform over shards, then per-shard uniform (matches the
+        # reference's per-server sampling + client merge)
+        per = self._rng.multinomial(
+            sample_size, [1.0 / self.n_shards] * self.n_shards)
+        outs = [c.call("graph/random_sample_nodes",
+                       {"n": np.array([int(k)])})["out"]
+                for c, k in zip(self._clients, per) if k]
+        return np.concatenate(outs) if outs else np.zeros(0, np.int64)
+
+    def random_walk(self, start_nodes, walk_len):
+        return _walk(self.sample_neighbors, start_nodes, walk_len)

@@ -115,9 +115,20 @@ def _lower_eqn(g, eqn):
     elif prim == "broadcast_in_dim":
         _lower_broadcast(g, eqn, ins, outs)
     elif prim == "select_n":
-        if len(ins) != 3:
-            raise NotImplementedError("select_n with >2 cases")
-        g.emit("Where", [ins[0], ins[2], ins[1]], outs)
+        if len(ins) == 3:
+            g.emit("Where", [ins[0], ins[2], ins[1]], outs)
+        else:
+            # n-way select over an INTEGER index: fold into a Where
+            # chain, acc starts at the last case
+            acc = ins[-1]
+            for i in range(len(ins) - 2, 0, -1):
+                idx = g.add_const(
+                    np.asarray(i - 1, eqn.invars[0].aval.dtype))
+                cond = g.fresh()
+                g.emit("Equal", [ins[0], idx], [cond])
+                nxt = outs[0] if i == 1 else g.fresh()
+                g.emit("Where", [cond, ins[i], acc], [nxt])
+                acc = nxt
     elif prim == "reduce_sum":
         axes = g.add_const(np.asarray(p["axes"], np.int64), "axes")
         g.emit("ReduceSum", [ins[0], axes], outs, keepdims=0)
@@ -138,6 +149,51 @@ def _lower_eqn(g, eqn):
         steps = g.add_const(np.asarray(
             p["strides"] or [1] * len(p["start_indices"]), np.int64))
         g.emit("Slice", [ins[0], starts, ends, axes, steps], outs)
+    elif prim == "reduce_window_max":
+        _lower_pool(g, eqn, ins, outs, "MaxPool")
+    elif prim == "reduce_window_sum":
+        # AveragePool * window_size reproduces the sum (ONNX has no
+        # SumPool); count_include_pad matches XLA's sum-over-window
+        tmp = g.fresh()
+        _lower_pool(g, eqn, ins, outs, "AveragePool", out=tmp)
+        wsize = float(np.prod([d for d in eqn.params["window_dimensions"]
+                               if d > 1]) or 1)
+        c = g.add_const(np.asarray(wsize, eqn.invars[0].aval.dtype))
+        g.emit("Mul", [tmp, c], outs)
+    elif prim == "argmax":
+        axes = list(p["axes"])
+        if len(axes) != 1:
+            raise NotImplementedError("argmax over multiple axes")
+        t = g.fresh()
+        g.emit("ArgMax", ins, [t], axis=int(axes[0]), keepdims=0)
+        g.emit("Cast", [t], outs,
+               to=int(_onnx_dtype(eqn.outvars[0].aval.dtype)))
+    elif prim == "pad":
+        cfg = p["padding_config"]
+        if any(interior for _, _, interior in cfg):
+            raise NotImplementedError("interior (dilating) pad")
+        pads = g.add_const(np.asarray(
+            [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg],
+            np.int64), "pads")
+        g.emit("Pad", [ins[0], pads, ins[1]], outs, mode="constant")
+    elif prim == "rev":
+        # Slice with negative steps reverses the listed axes
+        dims = list(p["dimensions"])
+        big = np.iinfo(np.int64).max
+        starts = g.add_const(np.asarray([-1] * len(dims), np.int64))
+        ends = g.add_const(np.asarray([-big] * len(dims), np.int64))
+        axes = g.add_const(np.asarray(dims, np.int64))
+        steps = g.add_const(np.asarray([-1] * len(dims), np.int64))
+        g.emit("Slice", [ins[0], starts, ends, axes, steps], outs)
+    elif prim == "iota":
+        shape = eqn.outvars[0].aval.shape
+        dim = int(p["dimension"])
+        base = np.arange(shape[dim])
+        reshaped = base.reshape([-1 if i == dim else 1
+                                 for i in range(len(shape))])
+        arr = np.broadcast_to(reshaped, shape).astype(
+            eqn.outvars[0].aval.dtype)
+        g.emit("Identity", [g.add_const(arr, "iota")], outs)
     elif prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
                   "custom_vjp_call", "custom_vjp_call_jaxpr",
                   "remat", "checkpoint"):
@@ -212,15 +268,62 @@ def _lower_dot(g, eqn, ins, outs):
 def _lower_conv(g, eqn, ins, outs):
     p = eqn.params
     dn = p["dimension_numbers"]
-    # only the framework's own layout (NCHW / OIHW)
-    if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
-        raise NotImplementedError("ONNX export: conv requires NCHW")
     pads = p["padding"]
-    g.emit("Conv", ins, outs,
+    if any(d != 1 for d in p.get("lhs_dilation", ())):
+        # transposed convolution reaches here as lhs-dilated conv
+        # (nn/functional/conv.py _conv_transpose_nd); ONNX Conv cannot
+        # express input dilation — fail loudly rather than drop it
+        raise NotImplementedError(
+            "ONNX export: lhs-dilated conv (Conv2DTranspose); use "
+            "save_inference_model (StableHLO) for this model")
+    x, w = ins
+    ident = tuple(range(len(dn.lhs_spec)))
+    # any layout: permute operands into NCHW/OIHW, Conv, permute back
+    if dn.lhs_spec != ident:
+        t = g.fresh()
+        g.emit("Transpose", [x], [t], perm=list(dn.lhs_spec))
+        x = t
+    if dn.rhs_spec != ident:
+        t = g.fresh()
+        g.emit("Transpose", [w], [t], perm=list(dn.rhs_spec))
+        w = t
+    conv_out = outs[0] if dn.out_spec == ident else g.fresh()
+    g.emit("Conv", [x, w], [conv_out],
            strides=list(p["window_strides"]),
            dilations=list(p["rhs_dilation"]),
            group=int(p["feature_group_count"]),
            pads=[int(lo) for lo, _ in pads] + [int(hi) for _, hi in pads])
+    if dn.out_spec != ident:
+        # NCHW result -> requested layout: place NCHW component k at
+        # target position out_spec[k]
+        inv = [0] * len(dn.out_spec)
+        for k, d in enumerate(dn.out_spec):
+            inv[d] = k
+        g.emit("Transpose", [conv_out], outs, perm=inv)
+
+
+def _lower_pool(g, eqn, ins, outs, op, out=None):
+    """reduce_window over NCHW spatial dims -> MaxPool/AveragePool."""
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pads = list(p["padding"])
+    if any(d != 1 for d in p.get("base_dilation", ())) or \
+            any(d != 1 for d in p.get("window_dilation", ())):
+        raise NotImplementedError(
+            "ONNX export: dilated reduce_window has no pool mapping")
+    if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1 or \
+            pads[0] != (0, 0) or pads[1] != (0, 0):
+        raise NotImplementedError(
+            "ONNX export: pooling over batch/channel dims")
+    spatial_pads = pads[2:]
+    kwargs = dict(
+        kernel_shape=wd[2:], strides=ws[2:],
+        pads=[int(lo) for lo, _ in spatial_pads] +
+             [int(hi) for _, hi in spatial_pads])
+    if op == "AveragePool":
+        kwargs["count_include_pad"] = 1
+    g.emit(op, ins, [out or outs[0]], **kwargs)
 
 
 def export(layer, path, input_spec=None, opset_version=13, **configs):

@@ -367,3 +367,33 @@ def test_wrapped_function_skips_conversion_with_warning():
         with pytest.raises(Dy2StaticError):
             g(x)                          # unconverted tensor-if: diagnostic
     assert any("decorator-wrapped" in str(r.message) for r in rec)
+
+
+def test_program_translator_kill_switch():
+    """ProgramTranslator.enable(False) runs the ORIGINAL eager Python —
+    reference `program_translator.py` global switch."""
+    from paddle_tpu.jit import ProgramTranslator
+    calls = []
+
+    def f(x):
+        calls.append("ran")          # side effect visible only eagerly
+        if x.mean() > 0:
+            out = x * 2
+        else:
+            out = x
+        return out
+
+    g = to_static(f)
+    pt = ProgramTranslator.get_instance()
+    pt.enable(False)
+    try:
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        out = g(x)
+        np.testing.assert_allclose(_np(out), 2.0)
+        n0 = len(calls)
+        g(x)
+        assert len(calls) == n0 + 1  # every call runs Python directly
+    finally:
+        pt.enable(True)
+    out2 = g(x)                      # converted path resumes
+    np.testing.assert_allclose(_np(out2), 2.0)

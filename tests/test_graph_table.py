@@ -167,3 +167,51 @@ def test_sharded_graph_undirected_both_endpoints():
 def test_dataset_factory_boxps():
     from paddle_tpu.io.dataset import dataset_factory, BoxPSDataset
     assert isinstance(dataset_factory("BoxPSDataset"), BoxPSDataset)
+
+
+def test_remote_graph_service_matches_local():
+    """GraphServer/RemoteShardedGraph: server-side sampling over the TCP
+    transport matches the in-process ShardedGraph (reference
+    graph_brpc_server vs common_graph_table parity)."""
+    from paddle_tpu.distributed.graph import (GraphServer,
+                                              RemoteShardedGraph,
+                                              ShardedGraph)
+    servers = [GraphServer(seed=i).start() for i in range(2)]
+    try:
+        remote = RemoteShardedGraph(
+            [f"127.0.0.1:{s.port}" for s in servers], directed=False)
+        rs = np.random.RandomState(0)
+        src = rs.randint(0, 40, 200)
+        dst = rs.randint(0, 40, 200)
+        remote.add_edges(src, dst)
+        local = ShardedGraph(n_shards=2, directed=False)
+        local.add_edges(src, dst)
+        nodes = np.arange(40)
+        np.testing.assert_array_equal(
+            remote.degree(nodes),
+            np.concatenate([local.shards[i].degree(nodes[nodes % 2 == i])
+                            for i in (0, 1)])[np.argsort(
+                np.concatenate([np.where(nodes % 2 == i)[0]
+                                for i in (0, 1)]))])
+        # sampled neighbors must be real neighbors
+        samp = remote.sample_neighbors(nodes, 4)
+        assert samp.shape == (40, 4)
+        adj = {}
+        for s, d in zip(np.concatenate([src, dst]),
+                        np.concatenate([dst, src])):
+            adj.setdefault(int(s), set()).add(int(d))
+        for i, n in enumerate(nodes):
+            for v in samp[i]:
+                if v >= 0:
+                    assert int(v) in adj.get(int(n), set()), (n, v)
+        # features roundtrip through the owner shard
+        remote.set_node_feature([3, 4], np.ones((2, 5), np.float32) * 7)
+        f = remote.get_node_feat([3, 4, 11], 5)
+        np.testing.assert_allclose(f[:2], 7.0)
+        np.testing.assert_allclose(f[2], 0.0)
+        # walks stay on edges
+        walks = remote.random_walk(nodes[:8], 3)
+        assert walks.shape == (8, 4)
+    finally:
+        for s in servers:
+            s.stop()

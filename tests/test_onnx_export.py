@@ -123,6 +123,35 @@ def _run_graph(dec, feeds):
             r = np.where(ins[0], ins[1], ins[2])
         elif op == "Concat":
             r = np.concatenate(ins, axis=_attr(n, "axis"))
+        elif op == "MaxPool":
+            r = _np_pool(ins[0], _attr(n, "kernel_shape"),
+                         _attr(n, "strides"), _attr(n, "pads"), "max")
+        elif op == "AveragePool":
+            r = _np_pool(ins[0], _attr(n, "kernel_shape"),
+                         _attr(n, "strides"), _attr(n, "pads"), "avg")
+        elif op == "ArgMax":
+            r = np.argmax(ins[0], axis=_attr(n, "axis"))
+            if not _attr(n, "keepdims"):
+                pass
+            else:
+                r = np.expand_dims(r, _attr(n, "axis"))
+        elif op == "Slice":
+            starts, ends, axes, steps = (ins[1].astype(int),
+                                         ins[2].astype(int),
+                                         ins[3].astype(int),
+                                         ins[4].astype(int))
+            sl = [slice(None)] * ins[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                lo = None if (sp < 0 and st == -1) else int(st)
+                hi = None if abs(int(en)) >= 2**62 else int(en)
+                sl[ax] = slice(lo, hi, int(sp))
+            r = ins[0][tuple(sl)]
+        elif op == "Pad":
+            pads = ins[1].astype(int)
+            nd = ins[0].ndim
+            widths = [(pads[i], pads[nd + i]) for i in range(nd)]
+            cval = ins[2] if len(ins) > 2 else 0
+            r = np.pad(ins[0], widths, constant_values=cval)
         elif op == "Conv":
             r = _np_conv(ins[0], ins[1],
                          ins[2] if len(ins) > 2 else None,
@@ -132,6 +161,25 @@ def _run_graph(dec, feeds):
             raise NotImplementedError(f"interp: {op}")
         env[outs[0]] = r
     return [env[o] for o in dec["outputs"]]
+
+
+def _np_pool(x, kernel, strides, pads, mode):
+    N, C, H, W = x.shape
+    kh, kw = kernel
+    ph_lo, pw_lo, ph_hi, pw_hi = pads
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi)),
+                constant_values=fill)
+    Ho = (xp.shape[2] - kh) // strides[0] + 1
+    Wo = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.zeros((N, C, Ho, Wo), x.dtype)
+    for i in range(Ho):
+        for j in range(Wo):
+            win = xp[:, :, i * strides[0]:i * strides[0] + kh,
+                     j * strides[1]:j * strides[1] + kw]
+            out[:, :, i, j] = (win.max((2, 3)) if mode == "max"
+                               else win.mean((2, 3)))
+    return out
 
 
 def _np_conv(x, w, b, strides, pads, dils, group):
@@ -226,3 +274,68 @@ def test_export_unsupported_raises(tmp_path):
     with pytest.raises(NotImplementedError, match="primitive"):
         export(bad, str(tmp_path / "bad"),
                input_spec=[np.ones((3, 3), np.float32)])
+
+
+def test_export_lenet_with_pooling(tmp_path):
+    """Conv + MaxPool + Linear end to end (pooling was previously
+    un-exportable; reduce_window_max -> MaxPool)."""
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    net = LeNet(num_classes=10)
+    net.eval()
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+    path = export(lambda t: net(t), str(tmp_path / "lenet"),
+                  input_spec=[x])
+    dec = _decode_model(path)
+    (out,) = _run_graph(dec, {dec["inputs"][0]: x})
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_export_three_way_select(tmp_path):
+    """select_n with >2 cases folds into a Where chain."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import apply
+    from paddle_tpu import nn
+
+    class Piecewise(nn.Layer):
+        def forward(self, x):
+            return apply(lambda v: jnp.select(
+                [v < 0.0, v < 1.0], [v * 2.0, v * 3.0], v * 4.0), x)
+
+    net = Piecewise()
+    x = np.linspace(-2, 2, 12).astype(np.float32).reshape(3, 4)
+    ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+    path = export(lambda t: net(t), str(tmp_path / "pw"),
+                  input_spec=[x])
+    dec = _decode_model(path)
+    (out,) = _run_graph(dec, {dec["inputs"][0]: x})
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_export_nhwc_conv_and_argmax(tmp_path):
+    """Non-NCHW conv layouts transpose in/out; argmax lowers."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import apply
+    from paddle_tpu import nn
+    rs = np.random.RandomState(0)
+    w = rs.randn(3, 3, 2, 4).astype(np.float32)  # HWIO
+
+    class NHWCNet(nn.Layer):
+        def forward(self, x):
+            def fn(v):
+                out = jax.lax.conv_general_dilated(
+                    v, jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                return jnp.argmax(out, axis=-1)
+            return apply(fn, x)
+
+    net = NHWCNet()
+    x = rs.randn(2, 5, 5, 2).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+    path = export(lambda t: net(t), str(tmp_path / "nhwc"),
+                  input_spec=[x])
+    dec = _decode_model(path)
+    (out,) = _run_graph(dec, {dec["inputs"][0]: x})
+    np.testing.assert_array_equal(out, ref)
