@@ -230,3 +230,8 @@ from .core import dtype  # noqa: F401,E402
 
 
 from . import hub  # noqa: F401  (local-source hub + md5 weight loading)
+from . import distribution  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import reader  # noqa: F401
+from . import compat  # noqa: F401
+from . import regularizer  # noqa: F401

@@ -206,9 +206,11 @@ AmpScaler = GradScaler
 
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate analog: for O2, cast model params to the compute
-    dtype (master fp32 copies live in the optimizer state, which is always
-    fp32 here)."""
+    """paddle.amp.decorate analog: for O2, cast model params to the
+    compute dtype; fp32 master copies are created by the optimizer's
+    multi_precision path (on by default for Adam/AdamW/Momentum) the
+    first time it sees a low-precision param, so updates accumulate at
+    full precision."""
     dt = convert_dtype(dtype)
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)

@@ -1,0 +1,45 @@
+"""py2/3 compatibility helpers kept for API parity (reference
+`python/paddle/compat.py`). Python 3 only, so these are mostly thin."""
+
+__all__ = ["to_text", "to_bytes", "long_type", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if obj is None:
+        return None
+    if isinstance(obj, (list, set)):
+        return type(obj)(to_text(o, encoding) for o in obj)
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if obj is None:
+        return None
+    if isinstance(obj, (list, set)):
+        return type(obj)(to_bytes(o, encoding) for o in obj)
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return bytes(obj)
+
+
+def round(x, d=0):  # noqa: A001
+    """py2 semantics: half rounds AWAY from zero (reference compat.round
+    — builtins.round is banker's rounding)."""
+    import math
+    scale = 10 ** d
+    v = x * scale
+    r = math.floor(v + 0.5) if v >= 0 else math.ceil(v - 0.5)
+    return r / scale
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

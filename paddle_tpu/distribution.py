@@ -1,0 +1,149 @@
+"""Probability distributions (reference `python/paddle/distribution.py`:
+Distribution base, Uniform:169, Normal:391, Categorical:641).
+
+TPU-native: sampling draws from the global Generator's split keys (so
+`paddle.seed` governs reproducibility and sampling is traceable under
+jit), densities are pure jnp expressions XLA fuses.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+from .core.random import default_generator
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    """Abstract base (reference `distribution.py:42`)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference `distribution.py:169`)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = Tensor(_val(low))
+        self.high = Tensor(_val(high))
+
+    def sample(self, shape, seed=0):
+        key = default_generator().split()
+        lo, hi = self.low._value, self.high._value
+        bshape = tuple(shape) + jnp.broadcast_shapes(lo.shape, hi.shape)
+        u = jax.random.uniform(key, bshape, jnp.float32)
+        return Tensor(lo + u * (hi - lo))
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+        return apply(fn, value if isinstance(value, Tensor)
+                     else Tensor(_val(value)), self.low, self.high)
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference `distribution.py:391`)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = Tensor(_val(loc))
+        self.scale = Tensor(_val(scale))
+
+    def sample(self, shape, seed=0):
+        key = default_generator().split()
+        mu, sd = self.loc._value, self.scale._value
+        bshape = tuple(shape) + jnp.broadcast_shapes(mu.shape, sd.shape)
+        return Tensor(mu + sd * jax.random.normal(key, bshape, jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v, mu, sd):
+            var = sd * sd
+            return (-((v - mu) ** 2) / (2 * var)
+                    - jnp.log(sd) - 0.5 * jnp.log(2 * jnp.pi))
+        return apply(fn, value if isinstance(value, Tensor)
+                     else Tensor(_val(value)), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(
+            lambda mu, sd: jnp.broadcast_to(
+                0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(sd),
+                jnp.broadcast_shapes(mu.shape, sd.shape)),
+            self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        """KL(self || other), both Normal (reference `:596`)."""
+        def fn(mu1, sd1, mu2, sd2):
+            var_ratio = (sd1 / sd2) ** 2
+            t1 = ((mu1 - mu2) / sd2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+        return apply(fn, self.loc, self.scale, other.loc, other.scale)
+
+
+class Categorical(Distribution):
+    """Unnormalized-logits categorical (reference `distribution.py:641`;
+    NOTE the reference treats `logits` as unnormalized PROBABILITIES,
+    not log-probabilities — parity kept)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) \
+            else Tensor(_val(logits))
+
+    def _p(self):
+        def fn(l):
+            return l / jnp.sum(l, axis=-1, keepdims=True)
+        return apply(fn, self.logits)
+
+    def sample(self, shape):
+        key = default_generator().split()
+        p = self._p()._value
+        # batched logits: sample over the last axis per batch element
+        # (reference returns shape + batch_shape)
+        out_shape = tuple(shape) + p.shape[:-1]
+        idx = jax.random.categorical(key, jnp.log(p + 1e-12), axis=-1,
+                                     shape=out_shape)
+        return Tensor(idx)
+
+    def probs(self, value):
+        p = self._p()
+
+        def fn(pv, idx):
+            return jnp.take(pv, idx.astype(jnp.int32), axis=-1)
+        return apply(fn, p, value if isinstance(value, Tensor)
+                     else Tensor(jnp.asarray(value)))
+
+    def log_prob(self, value):
+        return apply(jnp.log, self.probs(value))
+
+    def entropy(self):
+        return apply(
+            lambda p: -jnp.sum(p * jnp.log(p + 1e-12), axis=-1), self._p())
+
+    def kl_divergence(self, other):
+        return apply(
+            lambda p, q: jnp.sum(p * (jnp.log(p + 1e-12)
+                                      - jnp.log(q + 1e-12)), axis=-1),
+            self._p(), other._p())

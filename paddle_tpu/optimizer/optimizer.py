@@ -64,6 +64,10 @@ class Optimizer:
             self._decay_is_l1 = False
         self._states = {}
         self._name = name
+        # fp32 master weights for low-precision params (reference
+        # multi_precision / amp O2): subclasses that accept the knob set
+        # this True; base default off
+        self._multi_precision = False
 
     # ---- lr -------------------------------------------------------------
     def get_lr(self):
@@ -86,8 +90,36 @@ class Optimizer:
         st = self._states.get(id(p))
         if st is None:
             st = self._init_state(p)
+            if self._multi_precision and p._value.dtype in (
+                    jnp.bfloat16, jnp.float16):
+                # fp32 master copy: updates accumulate at full precision,
+                # the low-precision param is a cast-down view per step
+                st["master"] = p._value.astype(jnp.float32)
             self._states[id(p)] = st
         return st
+
+    def _apply_with_master(self, pval, gval, state, eff_lr):
+        """Run _apply_one against the fp32 master when present; the
+        emitted param value is the master cast to the param dtype and
+        the new master rides the state dict (shape == param shape, so
+        ZeRO/offload shard and evict it like any moment)."""
+        master = state.get("master")
+        if master is not None:
+            # self-heal a stale master: params mutated OUTSIDE the
+            # optimizer (checkpoint restore, set_state_dict without
+            # master keys) must win over the snapshot — one fused
+            # compare+select per param, branch-free under jit
+            in_sync = jnp.all(pval == master.astype(pval.dtype))
+            master = jnp.where(in_sync, master,
+                               pval.astype(jnp.float32))
+        work = master if master is not None else pval
+        sub = {k: v for k, v in state.items() if k != "master"}
+        new_p, new_sub = self._apply_one(work, gval, sub, eff_lr)
+        if master is not None:
+            new_sub = dict(new_sub)
+            new_sub["master"] = new_p.astype(jnp.float32)
+            new_p = new_p.astype(pval.dtype)
+        return new_p, new_sub
 
     def _init_state(self, p):
         return {}
@@ -143,7 +175,8 @@ class Optimizer:
             gval = gval.astype(jnp.float32)
             wd = self._effective_decay(p)
             eff_lr = lr * self._param_lr(p)
-            p32 = pval.astype(jnp.float32)
+            p32 = state.get("master", pval.astype(jnp.float32)) \
+                if isinstance(state, dict) else pval.astype(jnp.float32)
             if wd and not self._decoupled_weight_decay:
                 if self._decay_is_l1:
                     gval = gval + wd * jnp.sign(p32)
@@ -151,7 +184,11 @@ class Optimizer:
                     gval = gval + wd * p32
             if wd and self._decoupled_weight_decay:
                 pval = (p32 * (1.0 - eff_lr * wd)).astype(pval.dtype)
-            new_p, new_state = self._apply_one(pval, gval, state, eff_lr)
+                if "master" in state:
+                    state = dict(state)
+                    state["master"] = state["master"] * (1.0 - eff_lr * wd)
+            new_p, new_state = self._apply_with_master(
+                pval, gval, state, eff_lr)
             new_vals.append(new_p.astype(param_vals[len(new_vals)].dtype))
             new_states.append(new_state)
         return new_vals, new_states
@@ -173,18 +210,25 @@ class Optimizer:
                 gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
                 gval = gval.astype(jnp.float32)
                 pval = p._value
+                state = self._get_state(p)
+                # decay terms read the fp32 master when present — same
+                # precision rule as _functional_apply
+                p32 = state.get("master", pval.astype(jnp.float32))
                 wd = self._effective_decay(p)
                 if wd and not self._decoupled_weight_decay:
                     if self._decay_is_l1:
-                        gval = gval + wd * jnp.sign(pval.astype(jnp.float32))
+                        gval = gval + wd * jnp.sign(p32)
                     else:
-                        gval = gval + wd * pval.astype(jnp.float32)
-                state = self._get_state(p)
+                        gval = gval + wd * p32
                 eff_lr = lr * self._param_lr(p)
                 if wd and self._decoupled_weight_decay:
-                    pval = (pval.astype(jnp.float32) *
-                            (1.0 - eff_lr * wd)).astype(pval.dtype)
-                new_p, new_state = self._apply_one(pval, gval, state, eff_lr)
+                    pval = (p32 * (1.0 - eff_lr * wd)).astype(pval.dtype)
+                    if "master" in state:
+                        state = dict(state)
+                        state["master"] = (state["master"] *
+                                           (1.0 - eff_lr * wd))
+                new_p, new_state = self._apply_with_master(
+                    pval, gval, state, eff_lr)
                 p._value = new_p.astype(p._value.dtype)
                 self._states[id(p)] = new_state
 
@@ -231,9 +275,10 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
+                 multi_precision=True, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = bool(multi_precision)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
@@ -258,6 +303,7 @@ class Adam(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = bool(multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon

@@ -1,0 +1,136 @@
+"""Reader decorators (reference `python/paddle/reader/decorator.py`):
+functional combinators over no-arg sample-generator factories — the
+pre-DataLoader composition layer older reference code uses."""
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    items = None
+
+    def rd():
+        nonlocal items
+        if items is None:
+            items = list(reader())
+        return iter(items)
+    return rd
+
+
+def map_readers(func, *readers):
+    def rd():
+        for xs in zip(*[r() for r in readers]):
+            yield func(*xs)
+    return rd
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a feeder thread. The feeder polls
+    a stop flag so an abandoned consumer (early break / GC'd generator)
+    releases the thread and the source reader instead of leaking them
+    blocked in q.put."""
+    import queue
+    import threading
+    end = object()
+
+    def rd():
+        q = queue.Queue(maxsize=size)
+        stop = threading.Event()
+
+        def _put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def feed():
+            try:
+                for item in reader():
+                    if not _put(item):
+                        return
+            finally:
+                _put(end)
+        threading.Thread(target=feed, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    return
+                yield item
+        finally:
+            stop.set()
+    return rd
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def rd():
+        iters = [r() for r in readers]
+        zipper = zip(*iters) if check_alignment \
+            else itertools.zip_longest(*iters)
+        for xs in zipper:
+            out = ()
+            for x in xs:
+                out = out + (x if isinstance(x, tuple) else (x,))
+            yield out
+    return rd
+
+
+def chain(*readers):
+    def rd():
+        for r in readers:
+            yield from r()
+    return rd
+
+
+def shuffle(reader, buf_size):
+    def rd():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return rd
+
+
+def firstn(reader, n):
+    def rd():
+        return itertools.islice(reader(), n)
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over a reader via threads (reference uses a thread
+    pool too; the heavy multiprocess path is io.DataLoader)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def rd():
+        with ThreadPoolExecutor(process_num) as ex:
+            it = reader()
+            pending = []
+            for item in it:
+                pending.append(ex.submit(mapper, item))
+                if len(pending) >= buffer_size:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Degenerates to chain: single-controller JAX drives the chips from
+    one process; real multiprocess loading lives in io.DataLoader's
+    fork workers."""
+    return chain(*readers)

@@ -1,0 +1,5 @@
+"""paddle.regularizer parity: L1Decay/L2Decay re-exports (the optimizer
+consumes them; reference `python/paddle/regularizer.py`)."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,21 @@
+"""paddle.sysconfig parity (reference `python/paddle/sysconfig.py`)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory with the native headers (the PJRT C API the serving
+    runner builds against)."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.join(os.path.dirname(pkg), "csrc", "third_party")
+    return cand if os.path.isdir(cand) else pkg
+
+
+def get_lib():
+    """Directory with the prebuilt native libraries."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    native = os.path.join(pkg, "_native")
+    if os.path.isdir(native):
+        return native
+    return os.path.join(os.path.dirname(pkg), "csrc", "build")

@@ -249,3 +249,15 @@ def test_api_audit_has_no_missing_symbols():
     missing = {ns: e["missing"] for ns, e in report.items()
                if not ns.startswith("_") and e["missing"]}
     assert not missing, missing
+
+
+def test_secondary_module_namespaces_present():
+    """Module-level imports the __all__-based audit can't see
+    (reference `paddle/__init__.py` imports them as modules)."""
+    import paddle_tpu as paddle
+    assert paddle.distribution.Normal and paddle.distribution.Uniform \
+        and paddle.distribution.Categorical
+    assert callable(paddle.reader.shuffle)
+    assert callable(paddle.sysconfig.get_include)
+    assert paddle.compat.to_text(b"x") == "x"
+    assert paddle.regularizer.L2Decay(0.5).coeff == 0.5

@@ -6,6 +6,7 @@ ModelAverage:3618, LookaheadOptimizer:6608, GradientMergeOptimizer:6780.
 import numpy as np
 
 import paddle_tpu as paddle
+from paddle_tpu import nn
 from paddle_tpu import optimizer as opt
 
 
@@ -88,3 +89,82 @@ def test_gradient_merge_no_step_midway():
     p.grad = paddle.to_tensor(np.ones(2, np.float32))
     gm.step()
     np.testing.assert_allclose(p.numpy(), 0.0)   # not applied yet
+
+
+def test_multi_precision_master_weights():
+    """bf16 params + Adam multi_precision: fp32 master copies accumulate
+    updates a bf16 param would round away (reference multi_precision /
+    amp O2 master weights — previously an accepted-but-inert kwarg)."""
+    import jax.numpy as jnp
+
+    def run(mp):
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        lin.astype("bfloat16")
+        opt = paddle.optimizer.Adam(learning_rate=1e-5,
+                                    parameters=lin.parameters(),
+                                    multi_precision=mp)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+        for _ in range(50):
+            loss = (lin(x) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return lin, opt
+
+    lin_mp, opt_mp = run(True)
+    st = opt_mp._states[id(lin_mp.weight)]
+    assert "master" in st and st["master"].dtype == jnp.float32
+    assert lin_mp.weight._value.dtype == jnp.bfloat16
+    # master holds precision the bf16 param cannot: after 50 tiny steps
+    # master must have drifted from its own bf16 rounding
+    master = np.asarray(st["master"], np.float32)
+    rounded = np.asarray(st["master"].astype(jnp.bfloat16), np.float32)
+    assert np.abs(master - rounded).max() > 0
+
+    lin_off, opt_off = run(False)
+    assert "master" not in opt_off._states[id(lin_off.weight)]
+
+
+def test_multi_precision_in_train_step():
+    """Master weights thread through the fused TrainStep path too."""
+    import jax.numpy as jnp
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    lin.astype("bfloat16")
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    step = paddle.jit.TrainStep(
+        lin, lambda a: (lin(a) ** 2).sum(), opt)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+    l0 = float(step(x).item())
+    l1 = float(step(x).item())
+    assert l1 < l0
+    st = opt._states[id(lin.weight)]
+    assert "master" in st and st["master"].dtype == jnp.float32
+    assert lin.weight._value.dtype == jnp.bfloat16
+
+
+def test_master_self_heals_after_external_param_load():
+    """Params mutated OUTSIDE the optimizer (checkpoint restore without
+    master keys) must win over the stale fp32 master snapshot."""
+    import jax.numpy as jnp
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    lin.astype("bfloat16")
+    o = paddle.optimizer.Adam(learning_rate=1e-4,
+                              parameters=lin.parameters())
+    o._get_state(lin.weight)             # master snapshot of init weights
+    # external restore: overwrite params with new values, no master key
+    new_w = np.full((4, 4), 0.25, np.float32)
+    lin.weight._value = jnp.asarray(new_w, jnp.bfloat16)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    w_after = np.asarray(lin.weight._value.astype(jnp.float32))
+    # one tiny step away from the RESTORED value, not the init snapshot
+    assert np.abs(w_after - 0.25).max() < 0.01, w_after
+    master = np.asarray(o._states[id(lin.weight)]["master"])
+    assert np.abs(master - 0.25).max() < 0.01
