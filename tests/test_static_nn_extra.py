@@ -1,0 +1,65 @@
+"""Grad checks + semantics for the round-3 static.nn ops (the OpTest
+finite-difference pattern, reference `op_test.py:1420`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+from op_test import check_grad
+
+
+def test_row_conv_grads():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 6, 3).astype(np.float32)
+    w = rs.randn(3, 3).astype(np.float32)        # k+1=3, D=3
+    check_grad(lambda a, b: snn.row_conv(a, 2, weight=b), [x, w])
+
+
+def test_row_conv_lookahead_semantics():
+    x = np.zeros((1, 4, 1), np.float32)
+    x[0, 2, 0] = 1.0                             # impulse at t=2
+    w = np.array([[1.0], [10.0], [100.0]], np.float32)
+    out = np.asarray(snn.row_conv(paddle.to_tensor(x), 2,
+                                  weight=paddle.to_tensor(w)).numpy())
+    # out[t] = sum_i w[i] x[t+i]: impulse influences t=2 (w0), t=1 (w1),
+    # t=0 (w2)
+    np.testing.assert_allclose(out[0, :, 0], [100.0, 10.0, 1.0, 0.0])
+
+
+def test_bilinear_tensor_product_grads_and_oracle():
+    rs = np.random.RandomState(1)
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(3, 5).astype(np.float32)
+    w = rs.randn(2, 4, 5).astype(np.float32)
+    out = np.asarray(snn.bilinear_tensor_product(
+        paddle.to_tensor(x), paddle.to_tensor(y), 2,
+        weight=paddle.to_tensor(w), bias_attr=False).numpy())
+    ref = np.einsum("bi,kij,bj->bk", x, w, y)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    check_grad(lambda a, b, c: snn.bilinear_tensor_product(
+        a, c, 2, weight=b, bias_attr=False), [x, w, y])
+
+
+def test_spectral_norm_grads():
+    rs = np.random.RandomState(2)
+    w = rs.randn(6, 4).astype(np.float32)
+    check_grad(lambda a: snn.spectral_norm(a, power_iters=5), [w],
+               max_relative_error=2e-2)
+
+
+def test_nce_grads():
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, 3).astype(np.float32)
+    w = rs.randn(10, 3).astype(np.float32)
+    lbl = paddle.to_tensor(rs.randint(0, 10, (4, 1)))
+    check_grad(lambda a, b: snn.nce(a, lbl, 10, weight=b,
+                                    num_neg_samples=5, seed=7),
+               [x, w])
+
+
+def test_sequence_scatter_grads():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 5, 3).astype(np.float32)
+    upd = rs.randn(2, 2, 3).astype(np.float32)
+    idx = paddle.to_tensor(np.array([[0, 2], [1, 3]]))
+    check_grad(lambda a, b: snn.sequence_scatter(a, idx, b), [x, upd])
