@@ -236,3 +236,17 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return Tensor(jnp.cov(ensure_tensor(x)._value, rowvar=rowvar,
                           ddof=1 if ddof else 0))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference `tensor/linalg.py lu`): returns the
+    packed LU matrix, pivots (1-based, paddle convention), and optional
+    info codes."""
+    def fn(v):
+        lu_m, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_m, piv.astype(jnp.int32) + 1   # paddle pivots are 1-based
+    lu_m, piv = apply(fn, ensure_tensor(x))
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_m, piv, info
+    return lu_m, piv
