@@ -146,3 +146,50 @@ def test_two_process_jax_distributed():
         assert recs[0]["total"] == recs[1]["total"]
         assert abs(recs[0]["total"] - recs[0]["expected"]) < 1e-3
         assert all(r["n_global"] == 4 for r in recs)
+
+
+def test_graph_service_cross_process():
+    """GraphServer in a CHILD process, sampled from the parent over TCP
+    — the true multi-host shape of the graph service (reference
+    graph_brpc_server runs server-side sampling in its own process)."""
+    import numpy as np
+    server_script = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {root!r})
+import numpy as np
+from paddle_tpu.distributed.graph import GraphServer
+srv = GraphServer(seed=0)
+srv.start()
+print(srv.port, flush=True)
+import time
+time.sleep(30)
+"""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", server_script.format(root=root)],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().strip())
+        from paddle_tpu.distributed.graph import RemoteShardedGraph
+        g = RemoteShardedGraph([f"127.0.0.1:{port}"], directed=False)
+        rs = np.random.RandomState(0)
+        src, dst = rs.randint(0, 20, 60), rs.randint(0, 20, 60)
+        g.add_edges(src, dst)
+        deg = g.degree(np.arange(20))
+        assert deg.sum() == 2 * 60            # undirected doubling
+        samp = g.sample_neighbors(np.arange(20), 3)
+        assert samp.shape == (20, 3)
+        adj = {}
+        for s, d in zip(np.concatenate([src, dst]),
+                        np.concatenate([dst, src])):
+            adj.setdefault(int(s), set()).add(int(d))
+        for i in range(20):
+            for v in samp[i]:
+                if v >= 0:
+                    assert int(v) in adj.get(i, set())
+    finally:
+        proc.kill()
+        proc.wait()
