@@ -195,6 +195,79 @@ class Executor:
             outs.append(np.asarray(t._value) if return_numpy else t)
         return outs
 
+    def _run_dataset(self, program, dataset, fetch_list, debug=False,
+                     fetch_info=None, print_period=100, collect=False):
+        """Shared batch driver for train/infer_from_dataset. Feed
+        contract: every placeholder must be covered by the batch dict
+        (checked on the first batch — a name mismatch must not silently
+        train on the build-time zeros), and a short final batch (the
+        drop_last=False tail) is SKIPPED with a warning: recorded ops
+        bake the build-time batch shape."""
+        results = []
+        checked = False
+        it = 0
+        for batch in dataset:
+            feed = {k: v for k, v in batch.items()
+                    if k in program.placeholders}
+            if not checked:
+                missing = [n for n in program.placeholders if n not in feed]
+                if missing:
+                    raise KeyError(
+                        f"dataset batches do not cover placeholder(s) "
+                        f"{missing}; batch keys: {sorted(batch)}")
+                checked = True
+            short = [k for k, v in feed.items()
+                     if np.shape(v) != tuple(
+                         program.placeholders[k].shape)]
+            if short:
+                import warnings
+                warnings.warn(
+                    f"skipping dataset batch {it}: feed shapes for "
+                    f"{short} differ from the program's build-time "
+                    "shapes (set the dataset batch size to divide the "
+                    "data, or use drop_last)", UserWarning)
+                continue
+            outs = self.run(program, feed=feed, fetch_list=fetch_list)
+            if collect and fetch_list:
+                results.append(outs)
+            it += 1
+            if debug and fetch_list and it % max(1, print_period) == 0:
+                names = fetch_info or [str(f) for f in fetch_list]
+                msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
+                                for n, o in zip(names, outs))
+                print(f"[dataset run] batch {it}: {msg}")
+        return results
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive a slot Dataset through the program's recorded train
+        hooks, batch by batch (reference `executor.py
+        train_from_dataset` -> `Executor::RunFromDataset`,
+        `framework/executor.cc:152`, DeviceWorker::TrainFiles)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        self._run_dataset(program or _default_main, dataset, fetch_list,
+                          debug, fetch_info, print_period)
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (reference
+        `infer_from_dataset`): replays WITHOUT running train hooks."""
+        if dataset is None:
+            raise ValueError("infer_from_dataset needs a dataset")
+        program = program or _default_main
+        saved = program.train_hooks
+        program.train_hooks = []
+        try:
+            return self._run_dataset(program, dataset, fetch_list,
+                                     debug, fetch_info, print_period,
+                                     collect=True)
+        finally:
+            program.train_hooks = saved
+
     def close(self):
         pass
 
