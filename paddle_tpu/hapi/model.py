@@ -118,8 +118,28 @@ class Model:
                 outs = self.network(*batch[:self._n_in])
                 return self._loss_value(outs, list(batch[self._n_in:]))
 
-            self._train_step = TrainStep(self.network, loss_fn,
-                                         self._optimizer)
+            # reference fleet path (`fleet_base.py:881`): a fleet-wrapped
+            # optimizer or an installed multi-device mesh means the step
+            # must run GSPMD-sharded — params placed per their tags,
+            # batch sharded over dp, ZeRO/offload from the strategy
+            from ..distributed import env as dist_env
+            mesh = dist_env.current_mesh()
+            fleet_wrapped = hasattr(self._optimizer,
+                                    "user_defined_strategy")
+            if fleet_wrapped or (mesh is not None
+                                 and mesh.devices.size > 1):
+                from ..distributed.sharded_train import (ShardedTrainStep,
+                                                         shard_model)
+                if mesh is None:
+                    from ..distributed import env as _e
+                    mesh = _e.build_mesh(
+                        dp=__import__("jax").device_count())
+                shard_model(self.network, mesh)
+                self._train_step = ShardedTrainStep(
+                    self.network, loss_fn, self._optimizer, mesh=mesh)
+            else:
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
         loss = self._train_step(*inputs, *labels)
         return [loss.numpy()]
 

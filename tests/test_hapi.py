@@ -221,3 +221,30 @@ def test_accumulation_logs_unscaled_loss():
     scaled = m2.train_batch([xs], [ys], update=False, loss_scale=0.25)[0]
     np.testing.assert_allclose(np.asarray(full), np.asarray(scaled),
                                rtol=1e-5)
+
+
+def test_model_fit_uses_sharded_step_on_mesh():
+    """hapi Model.fit under an installed multi-device mesh trains
+    through ShardedTrainStep (the fleet.distributed_model semantics) —
+    params placed on the mesh, batch dp-sharded."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.sharded_train import ShardedTrainStep
+    dist.build_mesh(dp=8)
+    try:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = hapi.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rs = np.random.RandomState(0)
+        xs = rs.randn(64, 8).astype(np.float32)
+        ys = rs.randint(0, 4, (64, 1)).astype(np.int64)
+        model.fit(list(zip(xs, ys)), epochs=1, batch_size=16, verbose=0)
+        assert isinstance(model._train_step, ShardedTrainStep)
+        # params actually live on the mesh
+        spec = net[0].weight._value.sharding
+        assert spec.mesh.devices.size == 8
+    finally:
+        from paddle_tpu.distributed import env as dist_env
+        dist_env.clear_mesh()
