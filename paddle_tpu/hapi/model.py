@@ -131,9 +131,17 @@ class Model:
                 from ..distributed.sharded_train import (ShardedTrainStep,
                                                          shard_model)
                 if mesh is None:
-                    from ..distributed import env as _e
-                    mesh = _e.build_mesh(
-                        dp=__import__("jax").device_count())
+                    # fleet-wrapped but fleet.init not yet called: run
+                    # it with the optimizer's strategy so hybrid_configs
+                    # (mp/pp/sp/ep degrees) shape the mesh — a hand-built
+                    # dp-only mesh would silently drop the requested
+                    # model parallelism. fleet.init installs the global
+                    # mesh by design (reference fleet semantics).
+                    from ..distributed import fleet as _fleet
+                    _fleet.init(
+                        is_collective=True,
+                        strategy=self._optimizer.user_defined_strategy)
+                    mesh = dist_env.current_mesh()
                 shard_model(self.network, mesh)
                 self._train_step = ShardedTrainStep(
                     self.network, loss_fn, self._optimizer, mesh=mesh)

@@ -248,3 +248,35 @@ def test_model_fit_uses_sharded_step_on_mesh():
     finally:
         from paddle_tpu.distributed import env as dist_env
         dist_env.clear_mesh()
+
+
+def test_model_fit_fleet_strategy_shapes_mesh():
+    """A fleet-wrapped optimizer with hybrid_configs drives the mesh
+    through fleet.init — mp degree must materialize, not collapse to
+    dp-only."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import fleet as fl
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.distributed.sharded_train import ShardedTrainStep
+    dist_env.clear_mesh()
+    try:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        strat = dist.DistributedStrategy()
+        strat.hybrid_configs["mp_degree"] = 2
+        opt = fl.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=net.parameters()),
+            strategy=strat)
+        model = hapi.Model(net)
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rs = np.random.RandomState(0)
+        xs = rs.randn(32, 8).astype(np.float32)
+        ys = rs.randint(0, 4, (32, 1)).astype(np.int64)
+        model.fit(list(zip(xs, ys)), epochs=1, batch_size=8, verbose=0)
+        assert isinstance(model._train_step, ShardedTrainStep)
+        mesh = dist_env.current_mesh()
+        assert mesh.shape["mp"] == 2 and mesh.devices.size == 8
+    finally:
+        dist_env.clear_mesh()
