@@ -69,6 +69,11 @@ _register(
     "materialized; backward recomputes chunk logits (flash-style). "
     "Off falls back to logits + F.cross_entropy.")
 _register(
+    "use_pallas_layernorm", False, bool,
+    "Use the Pallas fused residual+LayerNorm kernel "
+    "(ops/pallas_layernorm.py) where shapes divide; off (default until "
+    "measured faster at the caller's shape) composes add+LN in XLA.")
+_register(
     "use_pallas_attention", True, bool,
     "Master switch for the Pallas flash-attention kernel; off forces the "
     "composed XLA attention everywhere.")

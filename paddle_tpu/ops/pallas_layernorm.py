@@ -1,0 +1,122 @@
+"""Pallas fused residual-add + LayerNorm (forward + custom VJP).
+
+Reference analog: `operators/fused/fused_bias_dropout_residual_layer_
+norm_op` family / `skip_layernorm_fuse_pass.cc` — the reference fuses
+residual+LN into one CUDA kernel because its op-by-op executor would
+otherwise materialize the sum. Under XLA the elementwise add DOES fuse
+into the LN reduction already, so this kernel's win is narrower:
+one VMEM pass computes the sum, the two reduction moments, and the
+normalized output without re-reading HBM, and the saved residual-sum
+for backward is produced in the same pass (XLA keeps sum + rstd + mean
+as three kernels on some shapes).
+
+Dispatch policy mirrors `ops/fused_ce.py`: OFF by default
+(`use_pallas=False`) until measured faster on real hardware at the
+caller's shape — the composed XLA path is already good; flip per-call
+or via `paddle_tpu.set_flags({"use_pallas_layernorm": True})`.
+
+Shapes: x, residual [rows, d] (callers flatten leading dims), weight/
+bias [d]; d should be a multiple of 128 for clean lanes (padding
+otherwise — handled by the caller check).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_ROWS = 256
+
+
+def _fwd_kernel(x_ref, res_ref, w_ref, b_ref, out_ref, sum_ref, rstd_ref,
+                *, eps):
+    xs = x_ref[...].astype(jnp.float32)
+    rs = res_ref[...].astype(jnp.float32)
+    s = xs + rs
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    norm = (s - mean) * rstd
+    out = norm * w_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    out_ref[...] = out.astype(out_ref.dtype)
+    sum_ref[...] = s.astype(sum_ref.dtype)
+    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape).astype(
+        rstd_ref.dtype)
+
+
+def _fwd(x, residual, weight, bias, eps):
+    from jax.experimental import pallas as pl
+    rows, d = x.shape
+    grid = (max(1, rows // _BLOCK_ROWS),)
+    br = min(_BLOCK_ROWS, rows)
+    out, s, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x.dtype),
+            jax.ShapeDtypeStruct((rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(x, residual, weight, bias)
+    return out, s, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_add_layer_norm(x, residual, weight, bias, eps=1e-5):
+    """LayerNorm(x + residual) * weight + bias, one VMEM pass."""
+    out, _, _ = _fwd(x, residual, weight, bias, eps)
+    return out
+
+
+def _vjp_fwd(x, residual, weight, bias, eps):
+    out, s, rstd = _fwd(x, residual, weight, bias, eps)
+    return out, (s, rstd, weight)
+
+
+def _vjp_bwd(eps, saved, g):
+    s, rstd, weight = saved
+    g32 = g.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    norm = (s - mean) * rstd
+    d_norm = g32 * w32
+    d = s.shape[-1]
+    # standard LN backward over the saved residual sum
+    ds = (d_norm - jnp.mean(d_norm, axis=-1, keepdims=True)
+          - norm * jnp.mean(d_norm * norm, axis=-1, keepdims=True)) * rstd
+    dw = jnp.sum(g32 * norm, axis=0)
+    db = jnp.sum(g32, axis=0)
+    dx = ds.astype(g.dtype)
+    return dx, dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+fused_add_layer_norm.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def add_layer_norm(x, residual, weight, bias, eps=1e-5, use_pallas=None):
+    """Dispatching wrapper: composed XLA path by default; the Pallas
+    kernel when requested (flag `use_pallas_layernorm` or use_pallas=
+    True) AND the shape divides cleanly on a TPU backend."""
+    if use_pallas is None:
+        from ..flags import get_flag
+        use_pallas = bool(get_flag("use_pallas_layernorm"))
+    rows_ok = (x.ndim == 2 and x.shape[0] % _BLOCK_ROWS == 0
+               and x.shape[-1] % 128 == 0)
+    if use_pallas and rows_ok and jax.default_backend() == "tpu":
+        return fused_add_layer_norm(x, residual, weight, bias, eps)
+    s = x + residual
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    return ((s - mean) * jax.lax.rsqrt(var + eps) * weight + bias).astype(
+        x.dtype)

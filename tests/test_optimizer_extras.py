@@ -168,3 +168,32 @@ def test_master_self_heals_after_external_param_load():
     assert np.abs(w_after - 0.25).max() < 0.01, w_after
     master = np.asarray(o._states[id(lin.weight)]["master"])
     assert np.abs(master - 0.25).max() < 0.01
+
+
+def test_amp_o2_decorate_end_to_end():
+    """amp.decorate(level='O2'): params cast to bf16, master weights
+    materialize in the optimizer, training converges."""
+    import jax.numpy as jnp
+    from paddle_tpu import amp
+    import paddle_tpu.nn.functional as F
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    o = paddle.optimizer.AdamW(learning_rate=5e-3,
+                               parameters=net.parameters())
+    net, o = amp.decorate(net, o, level="O2", dtype="bfloat16")
+    assert net[0].weight._value.dtype == jnp.bfloat16
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32)).astype(
+        "bfloat16")
+    y = paddle.to_tensor(rs.randn(16, 1).astype(np.float32)).astype(
+        "bfloat16")
+    losses = []
+    for _ in range(25):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.astype("float32").item()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    st = o._states[id(net[0].weight)]
+    assert st["master"].dtype == jnp.float32
