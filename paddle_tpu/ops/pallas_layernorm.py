@@ -47,6 +47,11 @@ def _fwd_kernel(x_ref, res_ref, w_ref, b_ref, out_ref, sum_ref, rstd_ref,
 def _fwd(x, residual, weight, bias, eps):
     from jax.experimental import pallas as pl
     rows, d = x.shape
+    if rows > _BLOCK_ROWS and rows % _BLOCK_ROWS:
+        raise ValueError(
+            f"fused_add_layer_norm: rows ({rows}) must divide by "
+            f"{_BLOCK_ROWS} (trailing rows would be left unwritten); "
+            "use add_layer_norm, whose dispatcher guards this")
     grid = (max(1, rows // _BLOCK_ROWS),)
     br = min(_BLOCK_ROWS, rows)
     out, s, rstd = pl.pallas_call(
@@ -72,11 +77,43 @@ def _fwd(x, residual, weight, bias, eps):
     return out, s, rstd
 
 
+def _fwd_only_kernel(x_ref, res_ref, w_ref, b_ref, out_ref, *, eps):
+    xs = x_ref[...].astype(jnp.float32)
+    rs = res_ref[...].astype(jnp.float32)
+    s = xs + rs
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    out = ((s - mean) * jax.lax.rsqrt(var + eps)
+           * w_ref[...].astype(jnp.float32)
+           + b_ref[...].astype(jnp.float32))
+    out_ref[...] = out.astype(out_ref.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def fused_add_layer_norm(x, residual, weight, bias, eps=1e-5):
-    """LayerNorm(x + residual) * weight + bias, one VMEM pass."""
-    out, _, _ = _fwd(x, residual, weight, bias, eps)
-    return out
+    """LayerNorm(x + residual) * weight + bias, one VMEM pass. The
+    primal (inference) path runs an output-only kernel — pallas outputs
+    cannot be DCE'd, so the 3-output forward is reserved for the vjp."""
+    from jax.experimental import pallas as pl
+    rows, d = x.shape
+    if rows > _BLOCK_ROWS and rows % _BLOCK_ROWS:
+        raise ValueError(
+            f"fused_add_layer_norm: rows ({rows}) must divide by "
+            f"{_BLOCK_ROWS}; use add_layer_norm")
+    grid = (max(1, rows // _BLOCK_ROWS),)
+    br = min(_BLOCK_ROWS, rows)
+    return pl.pallas_call(
+        functools.partial(_fwd_only_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+    )(x, residual, weight, bias)
 
 
 def _vjp_fwd(x, residual, weight, bias, eps):
@@ -115,8 +152,11 @@ def add_layer_norm(x, residual, weight, bias, eps=1e-5, use_pallas=None):
                and x.shape[-1] % 128 == 0)
     if use_pallas and rows_ok and jax.default_backend() == "tpu":
         return fused_add_layer_norm(x, residual, weight, bias, eps)
-    s = x + residual
+    # fp32 moments exactly like the kernel: flipping the flag must not
+    # change numerics beyond kernel-level tolerance
+    s = x.astype(jnp.float32) + residual.astype(jnp.float32)
     mean = jnp.mean(s, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
-    return ((s - mean) * jax.lax.rsqrt(var + eps) * weight + bias).astype(
-        x.dtype)
+    out = ((s - mean) * jax.lax.rsqrt(var + eps)
+           * weight.astype(jnp.float32) + bias.astype(jnp.float32))
+    return out.astype(x.dtype)

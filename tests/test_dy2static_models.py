@@ -1,0 +1,159 @@
+"""Reference dygraph_to_static test MODELS re-implemented as fixtures
+(the VERDICT ask: port >=3): the ifelse_simple_func family
+(`dygraph_to_static/ifelse_simple_func.py:31`), the while/for loop
+functions (`test_loop.py:31,81`), and the MNIST train-under-to_static
+model (`test_mnist.py:86` — conv-pool x2 + fc, trained compiled and
+compared to eager). Semantics re-implemented TPU-first, not copied:
+tensor conditions route through converted lax control flow.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import to_static
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+# ---- fixture 1: ifelse_simple_func.dyfunc_with_if_else ----------------
+
+def dyfunc_with_if_else(x_v, label=None):
+    if x_v.mean() > 5:
+        x_v = x_v - 1
+    else:
+        x_v = x_v + 1
+    if label is not None:                  # plain-python if (arm by arg)
+        loss = F.cross_entropy(x_v, label)
+        return loss
+    return x_v
+
+
+def test_dyfunc_with_if_else_both_branches():
+    f = to_static(dyfunc_with_if_else)
+    lo = paddle.to_tensor(np.full((3, 4), 1.0, np.float32))
+    hi = paddle.to_tensor(np.full((3, 4), 9.0, np.float32))
+    np.testing.assert_allclose(_np(f(lo)), 2.0)      # mean<=5: +1
+    np.testing.assert_allclose(_np(f(hi)), 8.0)      # mean>5: -1
+    lbl = paddle.to_tensor(np.array([0, 1, 2]))
+    loss = f(hi, lbl)
+    assert float(loss.item()) > 0                    # label arm taken
+
+
+# ---- fixture 2: test_loop while/for functions -------------------------
+
+def while_loop_dyfunc(x):
+    i = x * 1.0
+    while x < 10:
+        i = i + x
+        x = x + 1
+    return i
+
+
+def for_loop_dyfunc(max_len, base):
+    ret = paddle.zeros([1])
+    for i in range(max_len):
+        ret = ret + base
+    return ret
+
+
+def test_loop_fixtures_match_eager():
+    f = to_static(while_loop_dyfunc)
+    x = paddle.to_tensor(np.array([7.0], np.float32))
+    out = f(x)
+    # eager oracle: 7 + 7+8+9 = 31
+    np.testing.assert_allclose(_np(out), [31.0])
+    ref = while_loop_dyfunc(paddle.to_tensor(np.array([7.0], np.float32)))
+    np.testing.assert_allclose(_np(out), _np(ref))
+
+    g = to_static(for_loop_dyfunc)
+    b = paddle.to_tensor(np.array([2.0], np.float32))
+    np.testing.assert_allclose(
+        _np(g(paddle.to_tensor(np.int32(5)), b)), [10.0])
+    np.testing.assert_allclose(_np(g(3, b)), [6.0])  # python bound
+
+
+# ---- fixture 3: test_mnist.MNIST trained under to_static --------------
+
+class SimpleImgConvPool(nn.Layer):
+    """`test_mnist.py` SimpleImgConvPool: conv (+relu) then max-pool."""
+
+    def __init__(self, in_c, out_c, filter_size, pool_size, pool_stride):
+        super().__init__()
+        self._conv = nn.Conv2D(in_c, out_c, filter_size, padding=0)
+        self._pool = nn.MaxPool2D(pool_size, pool_stride)
+
+    def forward(self, x):
+        return self._pool(F.relu(self._conv(x)))
+
+
+class MNIST(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self._block1 = SimpleImgConvPool(1, 20, 5, 2, 2)
+        self._block2 = SimpleImgConvPool(20, 50, 5, 2, 2)
+        self._fc = nn.Linear(50 * 4 * 4, 10)
+
+    def forward(self, inputs, label=None):
+        x = self._block2(self._block1(inputs))
+        x = paddle.flatten(x, 1)
+        logits = self._fc(x)
+        if label is not None:
+            return F.cross_entropy(logits, label)
+        return logits
+
+
+def _digit_batch(n, rs):
+    templates = np.random.RandomState(42).rand(10, 28, 28) > 0.6
+    ys = rs.randint(0, 10, n)
+    xs = templates[ys].astype(np.float32)
+    xs += rs.randn(n, 28, 28).astype(np.float32) * 0.3
+    return xs[:, None], ys.astype(np.int64)
+
+
+def test_mnist_trains_same_eager_and_to_static():
+    """The `test_mnist.py` contract: identical training trajectories
+    eager vs compiled (there: ProgramTranslator on/off; here: dygraph
+    autograd vs TrainStep over the same model)."""
+    def train(compiled):
+        paddle.seed(0)
+        net = MNIST()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        losses = []
+        if compiled:
+            step = paddle.jit.TrainStep(
+                net, lambda a, b: net(a, b), opt)
+            for _ in range(4):
+                xs, ys = _digit_batch(16, rs)
+                losses.append(float(step(
+                    paddle.to_tensor(xs), paddle.to_tensor(ys)).item()))
+        else:
+            for _ in range(4):
+                xs, ys = _digit_batch(16, rs)
+                loss = net(paddle.to_tensor(xs), paddle.to_tensor(ys))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.item()))
+        return losses
+
+    eager = train(False)
+    static = train(True)
+    np.testing.assert_allclose(eager, static, rtol=1e-4)
+    assert static[-1] < static[0]
+
+
+def test_mnist_inference_parity_after_to_static():
+    paddle.seed(0)
+    net = MNIST()
+    xs, _ = _digit_batch(4, np.random.RandomState(1))
+    x = paddle.to_tensor(xs)
+    eager_logits = _np(net(x))
+    to_static(net)
+    np.testing.assert_allclose(_np(net(x)), eager_logits, rtol=1e-4,
+                               atol=1e-5)

@@ -131,7 +131,6 @@ def test_fused_add_layer_norm_matches_composed():
         return jnp.sum(composed(xx, rr, ww, bb) ** 2)
 
     gc = jax.grad(loss_c, argnums=(0, 1, 2, 3))(x, res, w, b)
-    g = jnp.full((rows, d), 0.0, jnp.float32)
     out_c = composed(x, res, w, b)
     gd = 2 * out_c
     dx, dres, dw, db = pln._vjp_bwd(1e-5, (x + res, (1.0 / jnp.sqrt(
