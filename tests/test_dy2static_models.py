@@ -157,3 +157,95 @@ def test_mnist_inference_parity_after_to_static():
     to_static(net)
     np.testing.assert_allclose(_np(net(x)), eager_logits, rtol=1e-4,
                                atol=1e-5)
+
+
+# ---- fixture 4: seq2seq_dygraph_model.BaseModel (encoder + stepwise
+# decoder loop + beam inference via dynamic_decode) ---------------------
+
+class Seq2Seq(nn.Layer):
+    """`seq2seq_dygraph_model.py:84` BaseModel re-implemented: GRU
+    encoder, per-timestep teacher-forced decoder written as a Python
+    loop over time (the construct dy2static exists for), beam-search
+    inference through generation.dynamic_decode."""
+
+    def __init__(self, vocab=32, hidden=16):
+        super().__init__()
+        self.vocab, self.hidden = vocab, hidden
+        self.embed = nn.Embedding(vocab, hidden)
+        self.enc = nn.GRU(hidden, hidden)
+        self.dec_cell = nn.GRUCell(hidden, hidden)
+        self.proj = nn.Linear(hidden, vocab)
+
+    def forward(self, src, trg):
+        """Teacher-forced training loss; the decoder timeloop is a
+        plain Python for over the (static) target length."""
+        _, h = self.enc(self.embed(src))
+        h = h[0]                                   # [b, hidden]
+        emb_t = self.embed(trg)
+        total = paddle.zeros([])
+        T = trg.shape[1] - 1
+        for t in range(T):                         # unrolled under trace
+            out, h = self.dec_cell(emb_t[:, t], h)
+            logits = self.proj(out)
+            total = total + F.cross_entropy(logits, trg[:, t + 1])
+        return total / T
+
+    def beam_search(self, src, beam_size=2, max_len=8):
+        from paddle_tpu.generation import (BeamSearchDecoder,
+                                           dynamic_decode)
+        _, h = self.enc(self.embed(src))
+        h = h[0]
+
+        def step(tok, state):
+            out, new_h = self.dec_cell(self.embed(tok), state)
+            return F.log_softmax(self.proj(out), axis=-1), new_h
+
+        dec = BeamSearchDecoder(step, start_token=1, end_token=0,
+                                beam_size=beam_size)
+        return dynamic_decode(dec, inits=h, max_step_num=max_len)
+
+
+def test_seq2seq_trains_same_eager_and_compiled():
+    def data(rs, n):
+        src = rs.randint(2, 32, (n, 6))
+        trg = np.concatenate(
+            [np.full((n, 1), 1), np.minimum(src + 1, 31)], 1)
+        return src.astype(np.int32), trg.astype(np.int32)
+
+    def train(compiled):
+        paddle.seed(0)
+        net = Seq2Seq()
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        losses = []
+        if compiled:
+            step = paddle.jit.TrainStep(net, lambda s, t: net(s, t), opt)
+            for _ in range(5):
+                s, t = data(rs, 8)
+                losses.append(float(step(
+                    paddle.to_tensor(s), paddle.to_tensor(t)).item()))
+        else:
+            for _ in range(5):
+                s, t = data(rs, 8)
+                loss = net(paddle.to_tensor(s), paddle.to_tensor(t))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.item()))
+        return losses
+
+    eager = train(False)
+    compiled = train(True)
+    np.testing.assert_allclose(eager, compiled, rtol=1e-4)
+    assert compiled[-1] < compiled[0]
+
+
+def test_seq2seq_beam_decode_runs():
+    paddle.seed(0)
+    net = Seq2Seq()
+    src = paddle.to_tensor(
+        np.random.RandomState(0).randint(2, 32, (3, 6)).astype(np.int32))
+    ids, scores = net.beam_search(src, beam_size=2, max_len=6)
+    assert np.asarray(ids.numpy()).shape[0] == 3
+    assert np.isfinite(np.asarray(scores.numpy())).all()
