@@ -235,3 +235,4 @@ from . import sysconfig  # noqa: F401
 from . import reader  # noqa: F401
 from . import compat  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import fluid  # noqa: F401  (legacy namespace shim)

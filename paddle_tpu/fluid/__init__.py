@@ -1,0 +1,41 @@
+"""Legacy `paddle.fluid` namespace shim.
+
+Reference-era code (including every dygraph_to_static test model and
+most pre-2.0 tutorials) spells its imports `import paddle.fluid as
+fluid`. The 2.x surfaces this package already provides are re-exported
+under the fluid names so that code parses and runs; genuinely dead
+machinery (transpilers, py_reader creation, ParallelExecutor internals)
+is NOT resurrected here — port those call sites per MIGRATION.md.
+"""
+from ..core.tensor import Tensor, Parameter  # noqa: F401
+from ..framework import (CPUPlace, CUDAPlace, TPUPlace,  # noqa: F401
+                         get_flags, set_flags)
+from ..nn import ParamAttr  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..static import (Program, Executor, CompiledProgram,  # noqa: F401
+                      program_guard, default_main_program,
+                      default_startup_program, data, scope_guard,
+                      global_scope, name_scope, BuildStrategy,
+                      ExecutionStrategy)
+from .. import optimizer  # noqa: F401
+from ..io import serialization as io  # noqa: F401
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+
+# fluid.io save/load surface
+save = io.save
+load = io.load
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def cuda_places(device_ids=None):
+    from ..static import cuda_places as _cp
+    return _cp(device_ids)
+
+
+def cpu_places(device_count=None):
+    from ..static import cpu_places as _cp
+    return _cp(device_count)

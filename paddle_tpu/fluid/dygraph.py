@@ -1,0 +1,64 @@
+"""`fluid.dygraph` name surface (reference `fluid/dygraph/`): the
+imperative-mode aliases legacy code imports. Eager IS the default mode
+here, so `guard()` is a no-op context and `to_variable` is to_tensor."""
+import contextlib
+
+from ..nn import Layer  # noqa: F401
+from ..nn import Sequential  # noqa: F401
+from ..core.tensor import Tensor
+from ..distributed.parallel import DataParallel  # noqa: F401
+from ..jit import TracedLayer  # noqa: F401
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    import paddle_tpu as p
+    return p.to_tensor(value, dtype=dtype)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager mode is always on (`fluid.dygraph.guard` boundary
+    dissolves); kept so `with fluid.dygraph.guard():` blocks run."""
+    yield
+
+
+def enabled():
+    return True
+
+
+class Linear(Layer):
+    """fluid.dygraph.Linear had (input_dim, output_dim, act=...)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        from ..nn import Linear as _L
+        self._inner = _L(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner.bias
+
+    def forward(self, x):
+        out = self._inner(x)
+        if self._act:
+            import paddle_tpu.nn.functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+def no_grad(func=None):
+    from ..core import autograd
+    if func is None:
+        return autograd.no_grad()
+
+    def wrapper(*a, **k):
+        with autograd.no_grad():
+            return func(*a, **k)
+    return wrapper

@@ -1,0 +1,146 @@
+"""`fluid.layers` name surface over the modern ops.
+
+Reference `python/paddle/fluid/layers/` (36.5k LoC of program-building
+wrappers) — here every name is the SAME computation exposed through the
+2.x namespaces (tensor ops, nn.functional, static.nn, control flow), so
+legacy call sites resolve; program capture happens exactly as it does
+for the 2.x APIs (the recorder hooks `apply`, not the layer helpers).
+"""
+import paddle_tpu as _p
+import paddle_tpu.nn.functional as _F
+from ..static import nn as _snn
+from ..static.control_flow import while_loop, cond, case, switch_case  # noqa: F401,E501
+from ..tensor.sequence import (sequence_pad, sequence_unpad,  # noqa: F401
+                               sequence_pool, sequence_softmax,
+                               sequence_concat, sequence_reverse,
+                               sequence_expand_as)
+
+# math / tensor builders
+concat = _p.concat
+reshape = _p.reshape
+transpose = _p.transpose
+reduce_sum = _p.sum
+reduce_mean = _p.mean
+reduce_max = _p.max
+reduce_min = _p.min
+elementwise_add = _p.add
+elementwise_sub = _p.subtract
+elementwise_mul = _p.multiply
+elementwise_div = _p.divide
+matmul = _p.matmul
+mul = _p.matmul
+cast = _p.cast
+shape = _p.shape
+zeros = _p.zeros
+ones = _p.ones
+def fill_constant(shape, dtype, value, force_cpu=False, out=None,
+                  name=None):
+    # fluid arg order is (shape, dtype, value); paddle.full takes
+    # (shape, fill_value, dtype)
+    return _p.full(shape, value, dtype=dtype)
+assign = _p.assign
+increment = _p.increment
+argmax = _p.argmax
+argmin = _p.argmin
+topk = _p.topk
+gather = _p.gather
+scatter = _p.scatter
+slice = _p.slice  # noqa: A001
+split = _p.split
+stack = _p.stack
+unstack = _p.unstack
+squeeze = _p.squeeze
+unsqueeze = _p.unsqueeze
+expand = _p.expand
+clip = _p.clip
+abs = _p.abs  # noqa: A001
+sqrt = _p.sqrt
+square = _p.square
+log = _p.log
+exp = _p.exp
+floor = _p.floor
+ceil = _p.ceil
+round = _p.round  # noqa: A001
+mean = _p.mean
+sums = _p.add_n
+sum = _p.add_n  # noqa: A001  (fluid.layers.sum sums a LIST of tensors)
+accuracy = None  # bound below (import-order)
+one_hot = _F.one_hot
+where = _p.where
+range = _p.arange  # noqa: A001
+
+# activations / nn functionals
+relu = _F.relu
+sigmoid = _F.sigmoid
+tanh = _F.tanh
+softmax = _F.softmax
+log_softmax = _F.log_softmax
+softplus = _F.softplus
+leaky_relu = _F.leaky_relu
+elu = _F.elu
+gelu = _F.gelu
+hard_sigmoid = _F.hardsigmoid
+swish = _F.swish
+dropout = _F.dropout
+cross_entropy = _F.cross_entropy
+softmax_with_cross_entropy = _F.softmax_with_cross_entropy
+square_error_cost = _F.square_error_cost
+l2_normalize = _F.normalize
+pad = _F.pad
+unfold = _F.unfold
+grid_sampler = _F.grid_sample
+affine_grid = _F.affine_grid
+interpolate = _F.interpolate
+resize_bilinear = _F.interpolate
+layer_norm = _F.layer_norm
+batch_norm = _F.batch_norm
+lod_reset = None  # LoD dissolves: padded+lengths (tensor/sequence.py)
+
+# static.nn builders
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, weight=None, bias=None):
+    """fluid.layers.fc: flatten trailing dims, project to `size`, add
+    bias, apply act (reference `layers/nn.py fc`). Functional form:
+    pass `weight`/`bias` or they are created per call."""
+    x = input
+    lead = x.shape[:num_flatten_dims]
+    import numpy as _np
+    in_dim = int(_np.prod(x.shape[num_flatten_dims:]))
+    x = _p.reshape(x, list(lead) + [in_dim])
+    if weight is None:
+        weight = _p.create_parameter([in_dim, size], attr=param_attr)
+    if bias is None and bias_attr is not False:
+        bias = _p.create_parameter([size], attr=bias_attr, is_bias=True)
+    out = _F.linear(x, weight, bias)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+conv2d = _F.conv2d
+pool2d = _F.max_pool2d
+embedding = _F.embedding
+row_conv = _snn.row_conv
+bilinear_tensor_product = _snn.bilinear_tensor_product
+spectral_norm = _snn.spectral_norm
+data_norm = _snn.data_norm
+nce = _snn.nce
+py_func = _snn.py_func
+crf_decoding = _snn.crf_decoding
+
+from ..static.compat import accuracy, auc  # noqa: E402,F401
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core.dtype import convert_dtype
+    return Tensor(jnp.zeros((), convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype="float32", **kw):
+    return _p.create_parameter(shape, dtype=dtype, **kw)
+
+
+def create_global_var(shape, value, dtype="float32", **kw):
+    from ..static.compat import create_global_var as _cgv
+    return _cgv(shape, value, dtype, **kw)

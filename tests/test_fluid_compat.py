@@ -1,0 +1,61 @@
+"""Legacy `paddle.fluid` namespace shim: reference-era code patterns
+run unchanged (reference `python/paddle/fluid/` surfaces re-exported
+over the 2.x implementations)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_dygraph_style_snippet():
+    with fluid.dygraph.guard():
+        lin = fluid.dygraph.Linear(4, 3, act="relu")
+        x = fluid.dygraph.to_variable(np.ones((2, 4), np.float32))
+        y = lin(x)
+        assert tuple(y.shape) == (2, 3)
+        loss = fluid.layers.reduce_mean(y)
+        loss.backward()
+        assert lin.weight.grad is not None
+
+
+def test_static_style_snippet():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        d = fluid.data("x", [2, 4], "float32")
+        w = fluid.dygraph.to_variable(np.ones((4, 3), np.float32))
+        h = fluid.layers.relu(fluid.layers.matmul(d, w))
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                     fetch_list=[h])
+    np.testing.assert_allclose(out, 4.0)
+
+
+def test_layers_surface():
+    x = fluid.dygraph.to_variable(
+        np.random.RandomState(0).randn(2, 3, 4).astype(np.float32))
+    out = fluid.layers.fc(x, size=5, act="tanh")
+    assert tuple(out.shape) == (2, 5)
+    s = fluid.layers.sum([x, x])
+    np.testing.assert_allclose(np.asarray(s.numpy()),
+                               np.asarray(x.numpy()) * 2, rtol=1e-6)
+    fc_out = fluid.layers.fill_constant([2], "float32", 7.0)
+    np.testing.assert_allclose(np.asarray(fc_out.numpy()), 7.0)
+    acc = fluid.layers.accuracy(
+        fluid.dygraph.to_variable(np.array([[0.1, 0.9]], np.float32)),
+        fluid.dygraph.to_variable(np.array([1])))
+    np.testing.assert_allclose(np.asarray(acc.numpy()), 1.0)
+    # control flow reaches lax
+    import paddle_tpu.nn.functional as F  # noqa: F401
+    r = fluid.layers.cond(paddle.to_tensor(True),
+                          lambda: paddle.ones([1]),
+                          lambda: paddle.zeros([1]))
+    np.testing.assert_allclose(np.asarray(r.numpy()), 1.0)
+
+
+def test_paddle_fluid_attr_and_save_load(tmp_path):
+    assert paddle.fluid is fluid
+    lin = fluid.dygraph.Linear(3, 3)
+    path = str(tmp_path / "m.pdparams")
+    fluid.save(lin._inner.state_dict(), path)
+    state = fluid.load(path)
+    assert set(state) == set(lin._inner.state_dict())
