@@ -36,8 +36,13 @@ ones = _p.ones
 def fill_constant(shape, dtype, value, force_cpu=False, out=None,
                   name=None):
     # fluid arg order is (shape, dtype, value); paddle.full takes
-    # (shape, fill_value, dtype)
-    return _p.full(shape, value, dtype=dtype)
+    # (shape, fill_value, dtype). `out` is written IN PLACE (loop
+    # counters rely on it).
+    result = _p.full(shape, value, dtype=dtype)
+    if out is not None:
+        out._value = result._value
+        return out
+    return result
 assign = _p.assign
 increment = _p.increment
 argmax = _p.argmax
@@ -79,19 +84,49 @@ softplus = _F.softplus
 leaky_relu = _F.leaky_relu
 elu = _F.elu
 gelu = _F.gelu
-hard_sigmoid = _F.hardsigmoid
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    # fluid default slope 0.2 (F.hardsigmoid uses 1/6)
+    return _p.clip(x * slope + offset, 0.0, 1.0)
 swish = _F.swish
-dropout = _F.dropout
-cross_entropy = _F.cross_entropy
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    # fluid defaulted to downgrade_in_infer (no train-time upscale,
+    # (1-p) infer-time downscale); F.dropout defaults upscale_in_train
+    return _F.dropout(x, p=dropout_prob, training=not is_test,
+                      mode=dropout_implementation)
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    # fluid semantics: input is PROBABILITIES; per-example [N, 1]
+    # -log p, no reduction (F.cross_entropy softmaxes and means)
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+
+    def fn(p_, y):
+        eps = 1e-12
+        if soft_label:
+            return -jnp.sum(y * jnp.log(p_ + eps), -1, keepdims=True)
+        yv = y.reshape(-1).astype(jnp.int32)
+        picked = jnp.take_along_axis(p_, yv[:, None], axis=-1)
+        out = -jnp.log(picked + eps)
+        if ignore_index >= 0:
+            out = jnp.where(yv[:, None] == ignore_index, 0.0, out)
+        return out
+    return apply(fn, input, label)
 softmax_with_cross_entropy = _F.softmax_with_cross_entropy
 square_error_cost = _F.square_error_cost
-l2_normalize = _F.normalize
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    # fluid's second positional arg is AXIS (F.normalize's is p)
+    return _F.normalize(x, p=2, axis=axis, epsilon=epsilon)
 pad = _F.pad
 unfold = _F.unfold
 grid_sampler = _F.grid_sample
 affine_grid = _F.affine_grid
 interpolate = _F.interpolate
-resize_bilinear = _F.interpolate
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True,
+                    align_mode=1, data_format="NCHW"):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="bilinear", align_corners=align_corners)
 layer_norm = _F.layer_norm
 batch_norm = _F.batch_norm
 lod_reset = None  # LoD dissolves: padded+lengths (tensor/sequence.py)
@@ -117,7 +152,19 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         out = getattr(_F, act)(out)
     return out
 conv2d = _F.conv2d
-pool2d = _F.max_pool2d
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None, exclusive=True, data_format="NCHW"):
+    # fluid defaults: stride 1 (max_pool2d defaults stride=kernel) and
+    # an avg mode F.max_pool2d cannot express
+    if global_pooling:
+        return (_F.adaptive_avg_pool2d(input, 1) if pool_type == "avg"
+                else _F.adaptive_max_pool2d(input, 1))
+    if pool_type == "avg":
+        return _F.avg_pool2d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+    return _F.max_pool2d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode)
 embedding = _F.embedding
 row_conv = _snn.row_conv
 bilinear_tensor_product = _snn.bilinear_tensor_product
