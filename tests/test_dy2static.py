@@ -397,3 +397,40 @@ def test_program_translator_kill_switch():
         pt.enable(True)
     out2 = g(x)                      # converted path resumes
     np.testing.assert_allclose(_np(out2), 2.0)
+
+
+def test_elif_chain_and_containers():
+    """if/elif/else over tensors (nested-If desugaring) and reference
+    test_dict/test_container patterns (python dict/list state survives
+    conversion)."""
+    def grade(x):
+        if x.mean() > 2:
+            out = x * 3
+        elif x.mean() > 0:
+            out = x * 2
+        else:
+            out = x * 0
+        return out
+
+    f = to_static(grade)
+    for v, k in ((3.0, 9.0), (1.0, 2.0), (-1.0, 0.0)):
+        xv = paddle.to_tensor(np.full((2,), v, np.float32))
+        np.testing.assert_allclose(_np(f(xv)), k)
+
+    def container(x):
+        cache = {}
+        acc = []
+        for i in range(3):                  # python loop, dict/list state
+            cache[i] = x + i
+            acc.append(cache[i])
+        if x.mean() > 0:
+            out = acc[0] + acc[2]
+        else:
+            out = acc[1]
+        return out
+
+    g = to_static(container)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(g(x)), 4.0)      # (x+0)+(x+2)
+    xm = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(g(xm)), 0.0)     # x+1
