@@ -129,7 +129,12 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None,
                           mode="bilinear", align_corners=align_corners)
 layer_norm = _F.layer_norm
 batch_norm = _F.batch_norm
-lod_reset = None  # LoD dissolves: padded+lengths (tensor/sequence.py)
+def lod_reset(x, y=None, target_lod=None):
+    raise NotImplementedError(
+        "fluid.layers.lod_reset: LoD tensors dissolve in this framework "
+        "— variable-length data is padded [B, T, ...] + lengths; see "
+        "paddle_tpu.tensor.sequence (sequence_pad/unpad) and "
+        "MIGRATION.md 'Honest divergences'")
 
 # static.nn builders
 
