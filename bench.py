@@ -30,6 +30,19 @@ def _peak_flops(kind):
     return None
 
 
+def _fetch_latency(sync):
+    """Median-of-3 device->host fetch round-trip: the per-probe RTT
+    jitters on the tunnel, and subtracting one inflated probe from a
+    timed window can clamp it to the 1e-9 floor (observed as an absurd
+    '4e12 tok/s' artifact). Shared by bench_extra.py."""
+    probes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync()
+        probes.append(time.perf_counter() - t0)
+    return sorted(probes)[1]
+
+
 def _time_train_steps(step, inputs, steps, warmup):
     """Shared timing discipline for every phase.
 
@@ -42,9 +55,7 @@ def _time_train_steps(step, inputs, steps, warmup):
     for _ in range(warmup):
         loss = step(*inputs)
     float(loss.item())  # sync
-    t0 = time.perf_counter()
-    float(loss.item())
-    fetch_latency = time.perf_counter() - t0
+    fetch_latency = _fetch_latency(lambda: float(loss.item()))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(*inputs)
