@@ -133,21 +133,33 @@ def backward(tensor, grad=None, retain_graph=False):
     """Reverse-mode over the tape. Analog of BasicEngine::Execute
     (`imperative/basic_engine.cc:379`) + GradientAccumulator summation
     (`gradient_accumulator.cc`)."""
+    backward_multi([tensor], [grad], retain_graph)
+
+
+def backward_multi(tensors, grads=None, retain_graph=False):
+    """One reverse walk with every root's cotangent seeded up front —
+    shared subgraphs run each node's vjp once, not once per root
+    (paddle.autograd.backward semantics)."""
     from .tensor import Tensor
 
-    if grad is None:
-        seed = jnp.ones_like(tensor._value)
-    elif isinstance(grad, Tensor):
-        seed = grad._value
-    else:
-        seed = jnp.asarray(grad, dtype=tensor._value.dtype)
+    if grads is None:
+        grads = [None] * len(tensors)
 
     # pending cotangents for non-leaf values, keyed by tape key (per-value
     # identity — survives in-place mutation of the Tensor object)
-    pending = {tensor._key: seed}
-    if tensor._retain_grad or not tensor._has_producer:
-        if not tensor.stop_gradient:
-            tensor._accumulate_grad(seed)
+    pending = {}
+    for tensor, grad in zip(tensors, grads):
+        if grad is None:
+            seed = jnp.ones_like(tensor._value)
+        elif isinstance(grad, Tensor):
+            seed = grad._value
+        else:
+            seed = jnp.asarray(grad, dtype=tensor._value.dtype)
+        prev = pending.get(tensor._key)
+        pending[tensor._key] = seed if prev is None else prev + seed
+        if tensor._retain_grad or not tensor._has_producer:
+            if not tensor.stop_gradient:
+                tensor._accumulate_grad(seed)
 
     for node in reversed(_state.nodes):
         if not any(k in pending for k in node.out_keys):
@@ -157,6 +169,11 @@ def backward(tensor, grad=None, retain_graph=False):
             c = pending.pop(k, None)
             if c is None:
                 c = jnp.zeros(shape, dtype)
+            elif c.dtype != dtype:
+                # accumulation across mixed-dtype consumers promotes
+                # (bf16 + f32 -> f32); jax.vjp requires the cotangent in
+                # the output's own dtype
+                c = c.astype(dtype)
             cots.append(c)
         cot = tuple(cots) if node.multi_output else cots[0]
         in_grads = node.vjp_fn(cot)
@@ -178,12 +195,23 @@ def backward(tensor, grad=None, retain_graph=False):
         clear_tape()
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
-         allow_unused=True):
-    """Analog of paddle.grad (`imperative/partial_grad_engine.cc`): grads of
-    outputs w.r.t. an explicit input list, without touching .grad fields."""
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, only_inputs=True, allow_unused=True,
+         no_grad_vars=None):
+    """Analog of paddle.grad (`imperative/partial_grad_engine.cc`,
+    signature parity with `fluid/dygraph/base.py` grad): grads of
+    outputs w.r.t. an explicit input list, without touching .grad
+    fields. only_inputs=False is unsupported in the reference too;
+    no_grad_vars blocks gradient flow through the listed tensors."""
     from .tensor import Tensor
 
+    if not only_inputs:
+        raise AssertionError(
+            "only_inputs=False is not supported (the reference's "
+            "partial-grad engine asserts the same)")
+    if isinstance(no_grad_vars, Tensor):
+        no_grad_vars = [no_grad_vars]
+    blocked = {id(t) for t in (no_grad_vars or [])}
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -221,13 +249,21 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
         cots = []
         for (shape, dtype), k in zip(node.out_avals, node.out_keys):
             c = pending.pop(k, None)
-            cots.append(jnp.zeros(shape, dtype) if c is None else c)
+            if c is None:
+                c = jnp.zeros(shape, dtype)
+            elif c.dtype != dtype:
+                # mixed-dtype consumer accumulation promotes; jax.vjp
+                # requires the output's own dtype (same as backward())
+                c = c.astype(dtype)
+            cots.append(c)
         cot = tuple(cots) if node.multi_output else cots[0]
         in_grads = node.vjp_fn(cot)
         for inp, key, had_producer, g in zip(
                 node.inputs, node.in_keys, node.in_had_producer, in_grads):
             if inp.stop_gradient or g.dtype == float0:
                 continue
+            if id(inp) in blocked:
+                continue  # no_grad_vars: gradient does not flow through
             if had_producer:
                 prev = pending.get(key)
                 pending[key] = g if prev is None else prev + g

@@ -198,12 +198,18 @@ class GPTBlock(Layer):
     def forward(self, x, cache=None, offset=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache, offset=offset)
-            x = x + self.dropout(a)
-            x = x + self.dropout(self.mlp(self.ln2(x)))
+            y, h = self._add_ln2(x, self.dropout(a))
+            x = h + self.dropout(self.mlp(y))
             return x, new_cache
-        x = x + self.dropout(self.attn(self.ln1(x)))
-        x = x + self.dropout(self.mlp(self.ln2(x)))
+        y, h = self._add_ln2(x, self.dropout(self.attn(self.ln1(x))))
+        x = h + self.dropout(self.mlp(y))
         return x
+
+    def _add_ln2(self, x, delta):
+        """The residual-add + ln2 site in one op: (ln2(x+delta), x+delta).
+        Routes to the Pallas pair kernel under `use_pallas_layernorm`."""
+        return F.fused_add_layer_norm(x, delta, self.ln2.weight,
+                                      self.ln2.bias, self.ln2._epsilon)
 
 
 class GPTModel(Layer):

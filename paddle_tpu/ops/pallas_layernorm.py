@@ -141,6 +141,42 @@ def _vjp_bwd(eps, saved, g):
 fused_add_layer_norm.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_add_layer_norm_pair(x, residual, weight, bias, eps=1e-5):
+    """(LayerNorm(x + residual) * weight + bias, x + residual) in one
+    VMEM pass. The second output is the residual CARRY the pre-LN
+    transformer block threads to the next add — the 3-output forward
+    already produces the sum for backward, so returning it is free."""
+    out, s, _ = _fwd(x, residual, weight, bias, eps)
+    return out, s.astype(x.dtype)
+
+
+def _pair_vjp_fwd(x, residual, weight, bias, eps):
+    out, s, rstd = _fwd(x, residual, weight, bias, eps)
+    return (out, s.astype(x.dtype)), (s, rstd, weight)
+
+
+def _pair_vjp_bwd(eps, saved, gs):
+    g_out, g_sum = gs
+    s, rstd, weight = saved
+    g32 = g_out.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    norm = (s - mean) * rstd
+    d_norm = g32 * w32
+    ds = (d_norm - jnp.mean(d_norm, axis=-1, keepdims=True)
+          - norm * jnp.mean(d_norm * norm, axis=-1, keepdims=True)) * rstd
+    # the carry cotangent flows straight into the sum
+    ds = ds + g_sum.astype(jnp.float32)
+    dw = jnp.sum(g32 * norm, axis=0)
+    db = jnp.sum(g32, axis=0)
+    dx = ds.astype(g_out.dtype)
+    return dx, dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+fused_add_layer_norm_pair.defvjp(_pair_vjp_fwd, _pair_vjp_bwd)
+
+
 def add_layer_norm(x, residual, weight, bias, eps=1e-5, use_pallas=None):
     """Dispatching wrapper: composed XLA path by default; the Pallas
     kernel when requested (flag `use_pallas_layernorm` or use_pallas=
