@@ -190,10 +190,10 @@ def get_cuda_rng_state():
     return [default_generator().get_state()]
 
 
-def set_cuda_rng_state(state):
+def set_cuda_rng_state(state_list):
     from .core.random import default_generator
-    if state:
-        default_generator().set_state(state[0])
+    if state_list:
+        default_generator().set_state(state_list[0])
 
 
 # Place shims for API parity — framework.py owns the canonical aliases
@@ -204,17 +204,33 @@ def get_cudnn_version():
     return None                         # no cudnn in an XLA/TPU build
 
 
-def check_shape(shape):
-    """Reference creation-op shape validation (`all` must be the builtin
-    — the tensor reduction op shadows it in this namespace)."""
+def check_shape(shape, op_name="check_shape",
+                expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """Reference creation-op shape validation
+    (`fluid/data_feeder.py:142`). A Tensor-valued shape is accepted when
+    its dtype is in expected_tensor_dtype (the dynamic-shape program
+    case); `all` must be the builtin — the tensor reduction op shadows
+    it in this namespace."""
     import builtins
     import numpy as _np
     from .enforce import enforce
+    from .core.tensor import Tensor
+    if isinstance(shape, Tensor):
+        enforce(str(shape.dtype).rsplit(".", 1)[-1] in expected_tensor_dtype,
+                f"Tensor shape dtype must be one of "
+                f"{expected_tensor_dtype}, got {shape.dtype}", op=op_name)
+        return shape
+    enforce(isinstance(shape, tuple(t for t in expected_shape_type
+                                    if isinstance(t, type))),
+            f"shape must be {expected_shape_type}, got {type(shape)}",
+            op=op_name)
     shape = list(shape)
     ok = builtins.all(
-        isinstance(s, (builtins.int, _np.integer))
+        isinstance(s, tuple(expected_element_type) + (_np.integer,))
         and not isinstance(s, builtins.bool) for s in shape)
-    enforce(ok, f"shape must be ints, got {shape}", op="check_shape")
+    enforce(ok, f"shape must be ints, got {shape}", op=op_name)
     return shape
 
 

@@ -11,8 +11,25 @@ import jax
 import jax.numpy as jnp
 
 from ..core.autograd import (  # noqa: F401
-    no_grad, enable_grad, set_grad_enabled, grad, backward,
+    no_grad, enable_grad, set_grad_enabled, grad,
 )
+from ..core.autograd import backward_multi as _backward_multi
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (`autograd/backward_mode.py`): seed one
+    or many root tensors into ONE reverse walk, so shared subgraphs run
+    each node's vjp once."""
+    from ..core.tensor import Tensor
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors must match tensors in length")
+    _backward_multi(list(tensors), list(grad_tensors), retain_graph)
 from ..core.tensor import Tensor, apply
 
 __all__ = ["PyLayer", "PyLayerContext", "no_grad", "enable_grad",

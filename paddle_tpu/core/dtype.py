@@ -65,12 +65,14 @@ def convert_dtype(dtype):
     return _demote_64(np.dtype(dtype))
 
 
-def set_default_dtype(dtype):
+def set_default_dtype(d):
+    # param named `d` for reference signature parity
+    # (`framework/framework.py` set_default_dtype(d))
     global _default_dtype
-    dtype = convert_dtype(dtype)
-    if dtype not in (float16, bfloat16, float32, float64):
-        raise TypeError(f"default dtype must be floating, got {dtype}")
-    _default_dtype = dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
 
 
 def get_default_dtype():

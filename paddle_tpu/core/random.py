@@ -66,9 +66,9 @@ class _RngState(threading.local):
 _state = _RngState()
 
 
-def seed(s):
-    """paddle.seed analog."""
-    _state.generator.manual_seed(int(s))
+def seed(seed):
+    """paddle.seed analog (`framework/random.py` — same param name)."""
+    _state.generator.manual_seed(int(seed))
     return _state.generator
 
 

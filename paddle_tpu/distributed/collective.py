@@ -67,8 +67,8 @@ def new_group(ranks=None, backend=None, axis_name=None):
     return g
 
 
-def get_group(gid=0):
-    return _GROUPS.get(gid, _world())
+def get_group(id=0):  # noqa: A002 — reference param name
+    return _GROUPS.get(id, _world())
 
 
 def is_initialized():
@@ -96,9 +96,17 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 # ---- global-view collectives (single-controller semantics) ----------------
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
+               sync_op=None):
     """Global-array view: the tensor already holds the global value; a
-    sharded value gets re-materialized replicated (XLA all-reduce under jit)."""
+    sharded value gets re-materialized replicated (XLA all-reduce under jit).
+
+    Both stream-control generations are accepted across this module for
+    signature parity — `use_calc_stream` (reference era,
+    `distributed/collective.py:415`) and `sync_op` (its 2.3+ successor).
+    Under single-controller XLA every collective is synchronous in
+    program order (no comm streams exist to toggle), so both carry no
+    behavioral weight; neither is silently dropped from the signature."""
     t = ensure_tensor(tensor)
     mesh = env.current_mesh()
     if mesh is not None:
@@ -108,15 +116,18 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return t
 
 
-def broadcast(tensor, src=0, group=None, sync_op=True):
+def broadcast(tensor, src=0, group=None, use_calc_stream=True,
+              sync_op=None):
     return ensure_tensor(tensor)
 
 
-def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):  # noqa: A001
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None,  # noqa: A001
+           use_calc_stream=True, sync_op=None):
     return all_reduce(tensor, op, group)
 
 
-def all_gather(tensor_list, tensor, group=None, sync_op=True):
+def all_gather(tensor_list, tensor, group=None, use_calc_stream=True,
+               sync_op=None):
     t = ensure_tensor(tensor)
     n = (group or _world()).nranks
     for _ in range(max(n, 1)):
@@ -129,13 +140,15 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
-def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+def scatter(tensor, tensor_list=None, src=0, group=None,
+            use_calc_stream=True, sync_op=None):
     if tensor_list:
         tensor.set_value(ensure_tensor(tensor_list[0])._value)
     return tensor
 
 
-def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             use_calc_stream=True, sync_op=None):
     outs = [ensure_tensor(t) for t in in_tensor_list]
     if out_tensor_list is not None:
         out_tensor_list.extend(outs)
@@ -143,11 +156,11 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return outs
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
+def send(tensor, dst=0, group=None, use_calc_stream=True, sync_op=None):
     return ensure_tensor(tensor)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, use_calc_stream=True, sync_op=None):
     return ensure_tensor(tensor)
 
 

@@ -38,9 +38,16 @@ class ParallelEnv:
         return self.world_size
 
 
-def init_parallel_env():
+def init_parallel_env(backend="auto"):
     """Bootstrap multi-host (DCN) if env vars say so, and install a pure-dp
-    mesh over all chips."""
+    mesh over all chips. `backend` keeps the reference signature
+    (`distributed/parallel.py:85` — 'auto'/'nccl'/'gloo'); every value
+    lands on the one XLA/ICI backend, but unknown strings are rejected
+    the way the reference rejects them."""
+    if backend not in ("auto", "nccl", "gloo", "bkcl", "hccl", "xccl"):
+        raise ValueError(
+            f"unknown backend {backend!r}; expected 'auto' or a vendor "
+            "collective name (all map onto XLA collectives here)")
     env.init_distributed()
     if env.current_mesh() is None:
         env.build_mesh(dp=jax.device_count())

@@ -207,9 +207,9 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     return decorate
 
 
-def not_to_static(function):
-    function._not_to_static = True
-    return function
+def not_to_static(func):
+    func._not_to_static = True
+    return func
 
 
 class TrainStep:

@@ -176,5 +176,8 @@ def tanh_(x, name=None):
     return x._inplace_apply(jnp.tanh)
 
 
-def softmax_(x, axis=-1, name=None):
-    return x._inplace_apply(lambda v: jnn.softmax(v, axis=axis))
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    dt = convert_dtype(dtype) if dtype is not None else None
+    return x._inplace_apply(
+        lambda v: jnn.softmax(v.astype(dt) if dt else v, axis=axis))

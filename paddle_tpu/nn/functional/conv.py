@@ -163,8 +163,10 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
                      data_format="NCHW", name=None):
+    # param ORDER follows the reference (`nn/functional/conv.py`:
+    # dilation before groups) for positional users
     return _conv_transpose_nd(ensure_tensor(x), ensure_tensor(weight), bias,
                               stride, padding, output_padding, dilation,
                               groups, 2, data_format == "NHWC", output_size)

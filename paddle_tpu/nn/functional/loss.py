@@ -287,10 +287,15 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean", norm_by_times=False):
+             reduction="mean", norm_by_times=False,
+             norm_by_batchsize=False, norm_by_total_logits_len=False):
     """CTC via lax.scan dynamic programming — TPU-native replacement for
     warpctc (`operators/warpctc_op.cc`). log_probs: [T, B, C] (paddle layout);
-    labels: [B, L] int padded."""
+    labels: [B, L] int padded. The three norm_* switches mirror the
+    reference warpctc attrs: per-sequence-length, per-batch-size, or
+    per-total-logit-length scaling of the per-example loss (mutually
+    exclusive in the reference; first true one wins here in the same
+    precedence order)."""
     log_probs = ensure_tensor(log_probs)
     labels_v = ensure_tensor(labels)._value.astype(jnp.int32)
     in_len = ensure_tensor(input_lengths)._value.astype(jnp.int32).reshape(-1)
@@ -344,6 +349,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         loss = -ll
         if norm_by_times:
             loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1)
+        elif norm_by_batchsize:
+            loss = loss / loss.shape[0]
+        elif norm_by_total_logits_len:
+            loss = loss / jnp.maximum(
+                jnp.sum(in_len).astype(loss.dtype), 1)
         if reduction == "mean":
             return jnp.mean(loss / jnp.maximum(lb_len.astype(loss.dtype), 1))
         return _reduce(loss, reduction)

@@ -113,8 +113,10 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     return apply(fn, x, grid)
 
 
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
-                   name=None):
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    # param ORDER follows the reference (`fluid/layers/nn.py`
+    # temporal_shift: name before data_format) for positional users
     x = ensure_tensor(x)
 
     def fn(v):

@@ -42,7 +42,7 @@ class Constant(Initializer):
 
 
 class Normal(Initializer):
-    def __init__(self, mean=0.0, std=1.0):
+    def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=None):
@@ -52,7 +52,7 @@ class Normal(Initializer):
 
 
 class TruncatedNormal(Initializer):
-    def __init__(self, mean=0.0, std=1.0):
+    def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=None):
@@ -62,7 +62,7 @@ class TruncatedNormal(Initializer):
 
 
 class Uniform(Initializer):
-    def __init__(self, low=-1.0, high=1.0):
+    def __init__(self, low=-1.0, high=1.0, name=None):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype=None):
@@ -72,7 +72,7 @@ class Uniform(Initializer):
 
 
 class XavierUniform(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    def __init__(self, fan_in=None, fan_out=None, name=None, gain=1.0):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):
@@ -86,7 +86,7 @@ class XavierUniform(Initializer):
 
 
 class XavierNormal(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    def __init__(self, fan_in=None, fan_out=None, name=None, gain=1.0):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):
@@ -128,7 +128,7 @@ class KaimingNormal(KaimingUniform):
 
 
 class Assign(Initializer):
-    def __init__(self, value):
+    def __init__(self, value, name=None):
         self.value = value
 
     def __call__(self, shape, dtype=None):

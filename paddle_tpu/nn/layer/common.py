@@ -231,8 +231,10 @@ class PairwiseDistance(Layer):
 
 
 class Unfold(Layer):
-    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1,
                  name=None):
+        # param ORDER follows the reference (`nn/layer/common.py` Unfold:
+        # kernel_sizes, dilations, paddings, strides) for positional users
         super().__init__()
         self.kernel_sizes = kernel_sizes
         self.strides = strides

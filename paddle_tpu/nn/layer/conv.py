@@ -116,10 +116,14 @@ class Conv2DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
-                                  self._groups, self._dilation, output_size,
-                                  self._data_format)
+        return F.conv2d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride,
+                                  padding=self._padding,
+                                  output_padding=self._output_padding,
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  output_size=output_size,
+                                  data_format=self._data_format)
 
 
 class Conv3DTranspose(_ConvNd):
@@ -132,7 +136,11 @@ class Conv3DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
-                                  self._groups, self._dilation, output_size,
-                                  self._data_format)
+        return F.conv3d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride,
+                                  padding=self._padding,
+                                  output_padding=self._output_padding,
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  output_size=output_size,
+                                  data_format=self._data_format)

@@ -49,14 +49,17 @@ class BatchNorm(_BatchNormBase):
     """fluid-style BatchNorm (`python/paddle/fluid/dygraph/nn.py` BatchNorm):
     acts like 2.x BatchNorm but defaults in_place semantics."""
 
-    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
-                 param_attr=None, bias_attr=None, dtype="float32",
-                 data_layout="NCHW", in_place=False, moving_mean_name=None,
-                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-05, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
                  use_global_stats=False, trainable_statistics=False):
+        # is_test=True == reference inference mode: normalize with the
+        # running statistics regardless of Layer.training
         super().__init__(num_channels, momentum, epsilon, param_attr,
                          bias_attr, data_layout,
-                         use_global_stats or None)
+                         (use_global_stats or is_test) or None)
         self._act = act
 
     def forward(self, x):

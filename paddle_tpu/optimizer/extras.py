@@ -18,20 +18,54 @@ __all__ = ["ExponentialMovingAverage", "ModelAverage",
 
 class ExponentialMovingAverage:
     """Shadow copies: ema = decay*ema + (1-decay)*param, with the
-    reference's optional Adam-style bias correction (thres_steps
-    analog omitted; `update()` after each optimizer step)."""
+    reference's optional Adam-style bias correction and thres_steps
+    decay scheduling (actual decay = min(decay, (1+t)/(10+t)), fluid/
+    optimizer.py:3963); `update()` after each optimizer step.
 
-    def __init__(self, parameters, decay=0.999, bias_correction=True):
+    Signature follows the reference (decay first); `parameters` is
+    keyword-style and required here — eager mode has no default-program
+    persistable list to collect from (reference collects trainable vars
+    of the default Program)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameters=None, bias_correction=True):
+        if parameters is None:
+            raise ValueError(
+                "ExponentialMovingAverage(parameters=...) is required: "
+                "pass model.parameters() (no default-Program var list "
+                "exists in the eager/trace world)")
         self._params = list(parameters)
         self._decay = float(decay)
+        self._thres_steps = thres_steps
         self._bias = bias_correction
         self._step = 0
-        self._shadow = [p._value.astype(jnp.float32) for p in self._params]
+        # running product of the decays actually applied: the bias
+        # correction must divide by 1 - prod(d_t), which equals
+        # 1 - decay**step only when the decay is un-scheduled
+        self._decay_prod = 1.0
+        # zero init + debias reconstructs the true average for ANY
+        # initial param value (shadow/(1-prod) after one step == p
+        # exactly); without correction, seed from the params so apply()
+        # before any update() yields the params themselves
+        if bias_correction:
+            self._shadow = [jnp.zeros_like(p._value, jnp.float32)
+                            for p in self._params]
+        else:
+            self._shadow = [p._value.astype(jnp.float32)
+                            for p in self._params]
         self._backup = None
+
+    def _decay_now(self):
+        if self._thres_steps is None:
+            return self._decay
+        t = self._thres_steps
+        t = float(t.item() if hasattr(t, "item") else t)
+        return min(self._decay, (1.0 + t) / (10.0 + t))
 
     def update(self):
         self._step += 1
-        d = self._decay
+        d = self._decay_now()
+        self._decay_prod *= d
         self._shadow = [
             d * s + (1.0 - d) * p._value.astype(jnp.float32)
             for s, p in zip(self._shadow, self._params)]
@@ -39,7 +73,9 @@ class ExponentialMovingAverage:
     def _corrected(self):
         if not self._bias:
             return self._shadow
-        c = 1.0 - self._decay ** max(self._step, 1)
+        c = 1.0 - self._decay_prod
+        if c <= 0.0:  # apply() before any update(): shadow is raw init
+            return self._shadow
         return [s / c for s in self._shadow]
 
     @contextlib.contextmanager
@@ -67,8 +103,13 @@ class ModelAverage:
     min/max_average_window); `accumulate()` each step, `apply()` swaps
     the averaged weights in for evaluation."""
 
-    def __init__(self, parameters, average_window_rate=0.15,
-                 min_average_window=10000, max_average_window=10000):
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        # param ORDER follows the reference ModelAverage
+        # (`incubate/optimizer/modelaverage.py`: rate first)
+        if parameters is None:
+            raise ValueError("ModelAverage requires parameters")
         self._params = list(parameters)
         self._rate = average_window_rate
         self._min_w = int(min_average_window)
@@ -115,7 +156,7 @@ class Lookahead:
     slow += alpha * (fast - slow) and fast resets to slow (reference
     LookaheadOptimizer:6608)."""
 
-    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
         self.inner = inner_optimizer
         self._alpha = float(alpha)
         self._k = int(k)

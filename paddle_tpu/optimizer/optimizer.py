@@ -275,17 +275,20 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=True, name=None):
+                 multi_precision=True, rescale_grad=1.0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._multi_precision = bool(multi_precision)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._rescale_grad = float(rescale_grad)
 
     def _init_state(self, p):
         return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
 
     def _apply_one(self, pval, gval, state, lr):
+        if self._rescale_grad != 1.0:
+            gval = gval * self._rescale_grad
         v = self._momentum * state["velocity"] + gval
         if self._use_nesterov:
             new_p = pval.astype(jnp.float32) - lr * (gval + self._momentum * v)
@@ -372,8 +375,10 @@ class Adamax(Optimizer):
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
-                 weight_decay=None, grad_clip=None,
-                 initial_accumulator_value=0.0, name=None):
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        # param ORDER follows the reference Adagrad (`optimizer/
+        # adagrad.py`: name before initial_accumulator_value)
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._epsilon = epsilon

@@ -276,8 +276,9 @@ class CompiledProgram:
     """Fused-XLA execution of a recorded Program (the ParallelExecutor /
     BuildStrategy analog — here simply one jit over the replay)."""
 
-    def __init__(self, program, build_strategy=None):
-        self.program = program
+    def __init__(self, program_or_graph, build_strategy=None):
+        # reference param name (`fluid/compiler.py` CompiledProgram)
+        self.program = program_or_graph
         self._jit_cache = {}
         self._leaves = None
 

@@ -184,7 +184,10 @@ def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
     return pickle.dumps(blob, protocol=4)
 
 
-def deserialize_persistables(program, data, scope=None):
+def deserialize_persistables(program, data, executor=None, scope=None):
+    # third param named `executor` like the reference (`static/io.py`);
+    # it is unused here (no scope machinery to thread through), `scope`
+    # stays as a trailing alias
     blob = pickle.loads(data)
     params = _program_params(program)
     for n, arr in blob.items():

@@ -21,8 +21,13 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
-           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
-           data_format="NCHW"):
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """`use_cudnn` is the reference's CUDA kernel-choice hint
+    (`fluid/layers/nn.py` conv2d); accepted for signature parity — on
+    this backend every conv lowers through XLA, which owns kernel
+    selection, so True and False compile identically (obviated, not
+    dropped)."""
     from ..nn import Conv2D
     from ..nn import functional as F
     layer = Conv2D(input.shape[1], num_filters, filter_size, stride=stride,
@@ -35,11 +40,30 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     return out
 
 
-def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
-               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None,
+               do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    """Reference `fluid/layers/nn.py` batch_norm signature (param order
+    included: is_test sits after act). in_place is obviated (XLA owns
+    buffer reuse); do_model_average_for_mean_and_var is obviated
+    (ModelAverage here averages an explicit parameter list);
+    moving_*_name label the running-stat tensors for state_dict keys;
+    use_global_stats=True normalizes with the running statistics even
+    in training, exactly like the reference."""
     from ..nn import BatchNorm2D
     from ..nn import functional as F
-    layer = BatchNorm2D(input.shape[1], momentum=momentum, epsilon=epsilon)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_layout,
+                        use_global_stats=use_global_stats or None)
+    if moving_mean_name:
+        layer._mean.name = moving_mean_name
+    if moving_variance_name:
+        layer._variance.name = moving_variance_name
     if is_test:
         layer.eval()
     out = layer(input)
@@ -48,8 +72,13 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
     return out
 
 
-def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype="float32"):
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """is_sparse/is_distributed are the reference's SelectedRows / PS
+    placement hints (`fluid/input.py` embedding). Dense GSPMD embedding
+    obviates both on this backend: sparse-grad tables live in the PS
+    runtime instead (paddle_tpu.distributed.fleet SparseTable /
+    csrc/pskv.cc), which is where is_distributed=True workloads land."""
     from ..nn import Embedding
     layer = Embedding(size[0], size[1], padding_idx=padding_idx,
                       weight_attr=param_attr)
@@ -62,8 +91,9 @@ from .control_flow import (while_loop, cond, case,  # noqa: F401,E402
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
-           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
-           data_format="NCDHW"):
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    # use_cudnn: see conv2d — obviated CUDA kernel hint, kept for parity
     from ..nn import Conv3D
     from ..nn import functional as F
     layer = Conv3D(input.shape[1], num_filters, filter_size, stride=stride,
@@ -76,8 +106,9 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
-                     param_attr=None, bias_attr=None, act=None, name=None,
-                     data_format="NCHW"):
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    # use_cudnn: see conv2d — obviated CUDA kernel hint, kept for parity
     from ..nn import Conv2DTranspose
     from ..nn import functional as F
     layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,

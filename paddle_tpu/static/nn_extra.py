@@ -52,10 +52,21 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     return out
 
 
-def conv3d_transpose(input, num_filters=None, filter_size=None, stride=1,
-                     padding=0, weight=None, bias=None, name=None, **kw):
-    """NCDHW transposed 3D convolution (reference conv3d_transpose op).
-    `weight` [in, out, kd, kh, kw]."""
+def conv3d_transpose(input, num_filters=None, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW", weight=None, bias=None, **kw):
+    """NCDHW transposed 3D convolution (reference conv3d_transpose,
+    `fluid/layers/nn.py:4088` — same param order). `weight`
+    [in, out, kd, kh, kw] is this backend's explicit-tensor extension
+    (trailing, defaulted). use_cudnn is the obviated CUDA kernel hint;
+    dilation/groups != 1, output_size and act are not implemented here
+    and raise."""
+    if dilation != 1 or groups != 1 or output_size is not None or act:
+        raise NotImplementedError(
+            "conv3d_transpose: dilation/groups/output_size/act are not "
+            "supported by this backend's functional form")
     if weight is None:
         raise ValueError("conv3d_transpose needs an explicit weight "
                          "tensor in functional form")

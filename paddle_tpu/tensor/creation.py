@@ -147,9 +147,9 @@ def numel(x, name=None):
                               dtype=jnp.int64))
 
 
-def shape(x):
-    x = ensure_tensor(x)
-    return Tensor(jnp.asarray(x._value.shape, dtype=jnp.int32))
+def shape(input):
+    input = ensure_tensor(input)
+    return Tensor(jnp.asarray(input._value.shape, dtype=jnp.int32))
 
 
 def real(x, name=None):

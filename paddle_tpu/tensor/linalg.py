@@ -48,13 +48,13 @@ def mv(x, vec, name=None):
     return matmul(x, vec)
 
 
-def t(x, name=None):
-    x = ensure_tensor(x)
-    if x.ndim < 2:
-        return apply(jnp.asarray, x)
-    if x.ndim > 2:
+def t(input, name=None):
+    input = ensure_tensor(input)
+    if input.ndim < 2:
+        return apply(jnp.asarray, input)
+    if input.ndim > 2:
         raise ValueError("paddle.t only supports ndim<=2; use transpose")
-    return apply(lambda v: v.T, x)
+    return apply(lambda v: v.T, input)
 
 
 def transpose_last(x):

@@ -125,8 +125,8 @@ def chunk(x, chunks, axis=0, name=None):
     return split(x, int(chunks), axis=axis)
 
 
-def unbind(x, axis=0, name=None):
-    x = ensure_tensor(x)
+def unbind(input, axis=0, name=None):
+    x = ensure_tensor(input)
     n = x._value.shape[axis]
 
     def fn(v):
@@ -134,8 +134,8 @@ def unbind(x, axis=0, name=None):
     return list(apply(fn, x))
 
 
-def slice(x, axes, starts, ends):  # noqa: A001
-    x = ensure_tensor(x)
+def slice(input, axes, starts, ends):  # noqa: A001
+    x = ensure_tensor(input)
     axes = [int(a) for a in axes]
     starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
     ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
@@ -202,8 +202,8 @@ def broadcast_to(x, shape, name=None):
     return apply(lambda v: jnp.broadcast_to(v, shape_arg(shape)), x)
 
 
-def broadcast_tensors(inputs, name=None):
-    tensors = [ensure_tensor(t) for t in inputs]
+def broadcast_tensors(input, name=None):
+    tensors = [ensure_tensor(t) for t in input]
     return list(apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *tensors))
 
 

@@ -134,7 +134,9 @@ sign = _u(jnp.sign)
 floor = _u(jnp.floor)
 ceil = _u(jnp.ceil)
 round = _u(jnp.round)  # noqa: A001
-trunc = _u(jnp.trunc)
+def trunc(input, name=None):
+    # `input` (not x): reference tensor/math.py trunc keeps torch's name
+    return unary(jnp.trunc, ensure_tensor(input))
 frac = _u(lambda v: v - jnp.trunc(v))
 sin = _u(jnp.sin)
 cos = _u(jnp.cos)

@@ -69,13 +69,14 @@ def sequence_pad(x, lengths, maxlen=None, pad_value=0.0, name=None):
     return apply(fn, ensure_tensor(x)), Tensor(lv)
 
 
-def sequence_unpad(x, lengths, name=None):
+def sequence_unpad(x, length, name=None):
     """Padded [B, T, ...] -> packed [sum(L), ...] (static total length =
     B*T with the tail rows zero — the valid rows are LEFT-PACKED; use
-    `lengths.sum()` to know how many are real). Reference
-    `sequence_unpad_op.cc` with the fixed-shape contract."""
+    `length.sum()` to know how many are real). Reference
+    `sequence_unpad_op.cc` (param name `length` matches it) with the
+    fixed-shape contract."""
     xv = _val(ensure_tensor(x))
-    lv = _lengths(lengths)
+    lv = _lengths(length)
     B, T = xv.shape[:2]
     valid = (jnp.arange(T)[None, :] < lv[:, None]).reshape(-1)
     # stable argsort on ~valid left-packs valid rows preserving order

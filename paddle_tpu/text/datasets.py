@@ -16,7 +16,18 @@ from ..io.dataloader import Dataset
 
 
 class _SyntheticTextBase(Dataset):
-    def _check_source(self, data_file):
+    def _check_source(self, data_file, download=True):
+        """`download` keeps the reference signature: reference datasets
+        fetch the corpus when data_file is None and download=True, and
+        RAISE when both are off. Here synthesis replaces fetching (zero-
+        egress image), so download=True lands on the synthetic corpus;
+        download=False with no data_file raises exactly like the
+        reference."""
+        if data_file is None and not download:
+            raise AssertionError(
+                f"{type(self).__name__}: data_file must be given when "
+                "download is False (reference semantics; note this "
+                "build synthesizes instead of downloading)")
         if data_file is not None and not os.path.exists(data_file):
             raise FileNotFoundError(
                 f"{type(self).__name__}: data_file {data_file!r} not found; "
@@ -30,8 +41,9 @@ class Imdb(_SyntheticTextBase):
     task learnable."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
-                 vocab_size=2000, n_samples=512, seq_len=64, seed=0):
-        self._check_source(data_file)
+                 download=True, vocab_size=2000, n_samples=512, seq_len=64,
+                 seed=0):
+        self._check_source(data_file, download)
         self.mode = mode
         if data_file is not None:
             self._load_real(data_file, mode, cutoff)
@@ -83,9 +95,9 @@ class Imikolov(_SyntheticTextBase):
     """PTB-style n-gram LM dataset; synthetic mode samples a Markov chain."""
 
     def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
-                 mode="train", min_word_freq=50, vocab_size=1000,
-                 n_samples=2048, seed=0):
-        self._check_source(data_file)
+                 mode="train", min_word_freq=50, download=True,
+                 vocab_size=1000, n_samples=2048, seed=0):
+        self._check_source(data_file, download)
         self.window_size = window_size
         rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
         # learnable structure: next token = (sum of context) % vocab, noised
@@ -109,8 +121,9 @@ class UCIHousing(_SyntheticTextBase):
 
     FEATURE_DIM = 13
 
-    def __init__(self, data_file=None, mode="train", n_samples=404, seed=0):
-        self._check_source(data_file)
+    def __init__(self, data_file=None, mode="train", download=True,
+                 n_samples=404, seed=0):
+        self._check_source(data_file, download)
         if data_file is not None:
             raw = np.loadtxt(data_file)
             feats, prices = raw[:, :-1], raw[:, -1:]
@@ -156,8 +169,9 @@ class Movielens(_SyntheticTextBase):
     title_ids, rating) records with a learnable user-movie affinity."""
 
     def __init__(self, data_file=None, mode="train", test_ratio=0.1,
-                 rand_seed=0, n_users=100, n_movies=200, n_samples=2048):
-        self._check_source(data_file)
+                 rand_seed=0, download=True, n_users=100, n_movies=200,
+                 n_samples=2048):
+        self._check_source(data_file, download)
         rs = np.random.RandomState(rand_seed)
         u_bias = rs.randn(n_users)
         m_bias = rs.randn(n_movies)
@@ -187,9 +201,9 @@ class _SyntheticTranslation(_SyntheticTextBase):
     tuples over a synthetic learnable copy/shift task."""
 
     def __init__(self, data_file=None, mode="train", src_dict_size=1000,
-                 trg_dict_size=1000, lang="en", n_samples=512, seq_len=16,
-                 seed=0):
-        self._check_source(data_file)
+                 trg_dict_size=1000, lang="en", download=True,
+                 n_samples=512, seq_len=16, seed=0):
+        self._check_source(data_file, download)
         rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
         self.src_dict_size = src_dict_size
         self.trg_dict_size = trg_dict_size
@@ -209,7 +223,14 @@ class _SyntheticTranslation(_SyntheticTextBase):
 
 
 class WMT14(_SyntheticTranslation):
-    """EN-FR translation tuples (reference `text/datasets/wmt14.py`)."""
+    """EN-FR translation tuples (reference `text/datasets/wmt14.py`:
+    one shared `dict_size` for both sides)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 download=True, n_samples=512, seq_len=16, seed=0):
+        super().__init__(data_file, mode, src_dict_size=dict_size,
+                         trg_dict_size=dict_size, download=download,
+                         n_samples=n_samples, seq_len=seq_len, seed=seed)
 
 
 class WMT16(_SyntheticTranslation):

@@ -213,9 +213,19 @@ class Flowers(DatasetFolder):
     zero-egress here: point `root`/FLOWERS_DATA_ROOT at a class-per-dir
     layout)."""
 
-    def __init__(self, root=None, mode="train", transform=None,
-                 download=False, backend=None):
-        root = root or os.environ.get("FLOWERS_DATA_ROOT", "")
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None,
+                 root=None):
+        # reference signature (`vision/datasets/flowers.py`): explicit
+        # archive paths. A data_file pointing at an extracted class-per-
+        # dir tree works as root here; label/setid files are part of the
+        # .mat archive layout this build does not parse.
+        if label_file or setid_file:
+            raise NotImplementedError(
+                "Flowers: .mat label/setid archives are not parsed in "
+                "this build; point data_file/root at an extracted "
+                "class-per-directory tree")
+        root = root or data_file or os.environ.get("FLOWERS_DATA_ROOT", "")
         if not root or not os.path.isdir(root):
             raise FileNotFoundError(
                 "Flowers data not found; this environment has no network "
@@ -228,9 +238,11 @@ class VOC2012(Dataset):
     """VOC2012 segmentation pairs from a local VOCdevkit (reference
     downloads; zero-egress here)."""
 
-    def __init__(self, root=None, mode="train", transform=None,
-                 download=False, backend=None):
-        root = root or os.environ.get("VOC_DATA_ROOT", "")
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, root=None):
+        # reference signature (`vision/datasets/voc2012.py`): data_file
+        # is the archive path — an extracted VOCdevkit dir works here
+        root = root or data_file or os.environ.get("VOC_DATA_ROOT", "")
         base = os.path.join(root, "VOC2012")
         lists = os.path.join(base, "ImageSets", "Segmentation",
                              f"{'train' if mode == 'train' else 'val'}.txt")

@@ -21,6 +21,24 @@ class Compose:
         return img
 
 
+def _pad_with_fill(img, widths, padding_mode, fill):
+    """np.pad with reference fill semantics: a sequence fill is a
+    PER-CHANNEL constant color (np.pad's own sequence rule is per-axis,
+    which crashes or mis-fills for [left,top,right,bottom] layouts)."""
+    if padding_mode != "constant":
+        return np.pad(img, widths, mode=padding_mode)
+    if np.isscalar(fill):
+        return np.pad(img, widths, constant_values=fill)
+    fill = np.asarray(fill)
+    if img.ndim < 3 or fill.size != img.shape[-1]:
+        raise ValueError(
+            f"fill {fill.tolist()} must match the channel count "
+            f"{img.shape[-1] if img.ndim >= 3 else 1}")
+    chans = [np.pad(img[..., c], widths[:-1], constant_values=fill[c])
+             for c in range(img.shape[-1])]
+    return np.stack(chans, axis=-1)
+
+
 class BaseTransform:
     def __call__(self, img):
         return self._apply_image(np.asarray(img))
@@ -85,10 +103,18 @@ class CenterCrop(BaseTransform):
 
 
 class RandomCrop(BaseTransform):
-    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
         self.size = _size_pair(size)
         self.padding = padding
         self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        if padding_mode not in ("constant", "edge", "reflect", "symmetric"):
+            raise ValueError(f"unknown padding_mode {padding_mode!r}")
+        self.padding_mode = padding_mode
+
+    def _pad(self, img, pads):
+        return _pad_with_fill(img, pads, self.padding_mode, self.fill)
 
     def _apply_image(self, img):
         h, w = self.size
@@ -97,12 +123,12 @@ class RandomCrop(BaseTransform):
                 else [self.padding] * 4
             pads = [(p[1], p[3]), (p[0], p[2])] + \
                 [(0, 0)] * (img.ndim - 2)
-            img = np.pad(img, pads)
+            img = self._pad(img, pads)
         ih, iw = img.shape[:2]
         if self.pad_if_needed and (ih < h or iw < w):
             ph, pw = max(h - ih, 0), max(w - iw, 0)
             pads = [(ph, ph), (pw, pw)] + [(0, 0)] * (img.ndim - 2)
-            img = np.pad(img, pads)
+            img = self._pad(img, pads)
             ih, iw = img.shape[:2]
         top = np.random.randint(0, max(ih - h, 0) + 1)
         left = np.random.randint(0, max(iw - w, 0) + 1)
@@ -367,8 +393,7 @@ def pad(img, padding, fill=0, padding_mode="constant"):
     widths = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
     mode = {"constant": "constant", "edge": "edge",
             "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
-    kw = {"constant_values": fill} if mode == "constant" else {}
-    return np.pad(img, widths, mode=mode, **kw)
+    return _pad_with_fill(img, widths, mode, fill)
 
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,

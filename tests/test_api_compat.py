@@ -251,6 +251,24 @@ def test_api_audit_has_no_missing_symbols():
     assert not missing, missing
 
 
+def test_api_signatures_match_reference():
+    """Signature-level diff (tools/api_sig_audit.py — the
+    check_api_compatible.py argspec comparison): every resolvable
+    public symbol keeps the reference's parameter names and relative
+    order, and adds no new required parameters."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import api_sig_audit
+    if not os.path.isdir(api_sig_audit.REF_ROOT):
+        pytest.skip("reference tree unavailable")
+    report = api_sig_audit.audit()
+    bad = {f"{ns}:{s}": m for ns, e in report.items()
+           if not ns.startswith("_") and isinstance(e, dict)
+           for s, m in e.get("mismatch", {}).items()}
+    assert not bad, bad
+
+
 def test_secondary_module_namespaces_present():
     """Module-level imports the __all__-based audit can't see
     (reference `paddle/__init__.py` imports them as modules)."""

@@ -18,7 +18,7 @@ def _param(v):
 
 def test_ema_update_and_apply():
     p = _param([1.0, 2.0])
-    ema = opt.ExponentialMovingAverage([p], decay=0.5,
+    ema = opt.ExponentialMovingAverage(decay=0.5, parameters=[p],
                                        bias_correction=False)
     p._value = p._value * 0 + 3.0          # params moved by training
     ema.update()                            # ema = .5*1 + .5*3 = [2, 2.5]
@@ -30,7 +30,7 @@ def test_ema_update_and_apply():
 
 def test_ema_bias_correction():
     p = _param([0.0])
-    ema = opt.ExponentialMovingAverage([p], decay=0.9)
+    ema = opt.ExponentialMovingAverage(decay=0.9, parameters=[p])
     p._value = p._value + 1.0
     ema.update()
     # shadow = 0.9*0 + 0.1*1 = 0.1; corrected by (1-0.9^1) -> 1.0
@@ -40,7 +40,7 @@ def test_ema_bias_correction():
 
 def test_model_average():
     p = _param([0.0])
-    ma = opt.ModelAverage([p], min_average_window=100)
+    ma = opt.ModelAverage(parameters=[p], min_average_window=100)
     for v in (1.0, 2.0, 3.0):
         p._value = p._value * 0 + v
         ma.accumulate()

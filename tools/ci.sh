@@ -39,6 +39,9 @@ rm -rf "$SMOKE_DIR"
 
 echo "== [2/4] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
+# signature-level diff (check_api_compatible.py analog): param names,
+# relative order, and no new required params vs the reference
+python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
 echo "== [3/4] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
