@@ -5,6 +5,8 @@ from .gpt import (  # noqa: F401
 )
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+    ErnieForPretraining, ernie_knowledge_mask,
 )
 from .ocr import (  # noqa: F401
     CRNN, DBNet, db_loss, ctc_greedy_decode,

@@ -6,6 +6,7 @@ attention routes through the fused TPU path instead of
 `fused_transformer_op.cu`.
 """
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor, apply
 from ..nn import (Layer, Linear, LayerNorm, Dropout, Embedding,
@@ -140,3 +141,60 @@ class BertForPretraining(Layer):
                                     attention_mask=attention_mask)
         return self.cls(seq_out, pooled,
                         self.bert.embeddings.word_embeddings.weight)
+
+
+# ---- ERNIE-1.0 (BASELINE config 3's second named model) -------------------
+
+class ErnieConfig(BertConfig):
+    """ERNIE-1.0 (Baidu): architecturally a BERT-base encoder over a
+    Chinese-centric vocab (18000, max_position 513). What distinguishes
+    ERNIE is the PRETRAINING DATA strategy — phrase/entity-level
+    knowledge masking — provided here as `ernie_knowledge_mask`."""
+
+    @staticmethod
+    def ernie_1_0(**kw):
+        kw.setdefault("vocab_size", 18000)
+        kw.setdefault("max_position", 513)
+        return ErnieConfig(**kw)
+
+
+class ErnieModel(BertModel):
+    """Encoder trunk; same module tree as BertModel so converted BERT/
+    ERNIE checkpoints load via the same state_dict keys."""
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, config, num_classes=2):
+        super().__init__(config, num_classes)
+        # keep the attribute name users expect from ernie code
+        self.ernie = self.bert
+
+
+class ErnieForPretraining(BertForPretraining):
+    def __init__(self, config):
+        super().__init__(config)
+        self.ernie = self.bert
+
+
+def ernie_knowledge_mask(input_ids, spans, mask_token_id, rng,
+                         mask_prob=0.15):
+    """ERNIE-1.0 knowledge masking: masking decisions are made per SPAN
+    (phrase/entity), and a selected span is masked WHOLE — unlike BERT's
+    independent per-token masking.
+
+    input_ids: [B, S] numpy int array.
+    spans: list (len B) of lists of (start, end) half-open token spans
+        covering the maskable units (single tokens are (i, i+1) spans).
+    Returns (masked_ids, labels) where labels hold the original ids at
+    masked positions and -100 elsewhere (the ignore index).
+    """
+    masked = input_ids.copy()
+    # explicit signed dtype: full_like on uint ids would wrap -100 to a
+    # huge positive value and the ignore-index would never match
+    labels = np.full(input_ids.shape, -100, dtype=np.int64)
+    for b, row_spans in enumerate(spans):
+        for (s, e) in row_spans:
+            if rng.rand() < mask_prob:
+                labels[b, s:e] = input_ids[b, s:e]
+                masked[b, s:e] = mask_token_id
+    return masked, labels

@@ -66,3 +66,57 @@ def test_bert_finetune_converges():
         ids, ys = batch(16)
         losses.append(float(step(ids, ys).item()))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_ernie_finetune_converges():
+    """Config 3's second named model: ERNIE-1.0 fine-tune (same encoder
+    family; ernie-default vocab/max_position, `ernie` attribute alias)."""
+    from paddle_tpu.models.bert import (ErnieConfig,
+                                        ErnieForSequenceClassification)
+    paddle.seed(0)
+    cfg = ErnieConfig.ernie_1_0(hidden_size=64, num_layers=2, num_heads=4,
+                                intermediate_size=128, hidden_dropout=0.0,
+                                attn_dropout=0.0)
+    assert cfg.vocab_size == 18000 and cfg.max_position == 513
+    net = ErnieForSequenceClassification(cfg, num_classes=2)
+    assert net.ernie is net.bert
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda ids, y: F.cross_entropy(net(ids), y), opt)
+    rs = np.random.RandomState(0)
+
+    def batch(n):
+        ids = rs.randint(10, 1000, (n, 16))
+        ys = rs.randint(0, 2, n)
+        ids[ys == 1, :3] = 7
+        return (paddle.to_tensor(ids.astype(np.int32)),
+                paddle.to_tensor(ys.astype(np.int64)))
+
+    losses = []
+    for _ in range(12):
+        ids, ys = batch(16)
+        losses.append(float(step(ids, ys).item()))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_ernie_knowledge_mask_spans_whole():
+    """ERNIE's distinguishing pretraining mechanic: a selected
+    phrase/entity span is masked WHOLE, never partially."""
+    from paddle_tpu.models.bert import ernie_knowledge_mask
+    rs = np.random.RandomState(0)
+    ids = np.arange(1, 21).reshape(2, 10)
+    spans = [[(0, 3), (3, 6), (6, 10)], [(0, 5), (5, 10)]]
+    masked, labels = ernie_knowledge_mask(ids, spans, mask_token_id=0,
+                                          rng=rs, mask_prob=0.5)
+    for b, row_spans in enumerate(spans):
+        for (s, e) in row_spans:
+            span_masked = masked[b, s:e] == 0
+            # whole-span: all or none
+            assert span_masked.all() or (~span_masked).all()
+            if span_masked.all():
+                np.testing.assert_array_equal(labels[b, s:e], ids[b, s:e])
+            else:
+                assert (labels[b, s:e] == -100).all()
+    # with prob .5 over 5 spans, at least one masked and one not (seeded)
+    assert (masked == 0).any() and (labels == -100).any()
