@@ -432,11 +432,16 @@ class DeformConv2D(Layer):
 # ---------------------------------------------------------------------------
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
-             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
     """Decode YOLOv3 head output to boxes/scores
     (`python/paddle/vision/ops.py:252`, `operators/detection/yolo_box_op.h`).
 
-    x [N, A*(5+nc), H, W]; img_size [N, 2] (h, w).
+    x [N, A*(5+nc), H, W] — or [N, A*(6+nc), H, W] when iou_aware: the
+    FIRST A channels hold per-anchor IoU predictions (reference
+    GetIoUIndex layout) and confidence becomes
+    obj^(1-iou_aware_factor) * iou^iou_aware_factor (yolo_box_op.h:151).
+    img_size [N, 2] (h, w).
     Returns (boxes [N, A*H*W, 4] xyxy image pixels, scores [N, A*H*W, nc]);
     predictions with objectness < conf_thresh are zeroed (the reference's
     LoD-less "score=0" convention — fixed shapes, no compaction).
@@ -447,7 +452,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
         N, C, H, W = xv.shape
         A = anchors.shape[0]
         nc = class_num
-        assert C == A * (5 + nc), f"yolo_box: C={C} != A*(5+nc)"
+        if iou_aware:
+            assert C == A * (6 + nc), f"yolo_box: C={C} != A*(6+nc)"
+            iou = jax.nn.sigmoid(xv[:, :A])          # [N, A, H, W]
+            xv = xv[:, A:]
+        else:
+            assert C == A * (5 + nc), f"yolo_box: C={C} != A*(5+nc)"
         t = xv.reshape(N, A, 5 + nc, H, W)
         input_size = downsample_ratio * H
         gx = jnp.arange(W, dtype=xv.dtype)
@@ -462,6 +472,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
         bw = jnp.exp(t[:, :, 2]) * aw / input_size
         bh = jnp.exp(t[:, :, 3]) * ah / input_size
         conf = jax.nn.sigmoid(t[:, :, 4])
+        if iou_aware:
+            conf = (jnp.power(conf, 1.0 - iou_aware_factor)
+                    * jnp.power(iou, iou_aware_factor))
         on = conf >= conf_thresh
         imh = imv[:, 0].astype(xv.dtype)[:, None, None, None]
         imw = imv[:, 1].astype(xv.dtype)[:, None, None, None]

@@ -462,3 +462,30 @@ def test_detection_ops_jit_clean():
     s = rs.uniform(0, 1, (1, 3, 16)).astype(np.float32)
     det, nums = head(jnp.asarray(b), jnp.asarray(s))
     assert det.shape == (1, 10, 6) and int(nums[0]) >= 0
+
+
+def test_yolo_box_iou_aware():
+    """iou_aware layout: first A channels are per-anchor IoU predictions;
+    conf = obj^(1-f) * iou^f (reference yolo_box_op.h:151). Boxes must
+    match the non-aware decode of the trailing block; scores scale by
+    the iou-aware confidence ratio."""
+    N, A, H, W, nc = 1, 2, 4, 4, 3
+    f = 0.4
+    rs = np.random.RandomState(9)
+    core = rs.randn(N, A * (5 + nc), H, W).astype(np.float32)
+    iou_ch = rs.randn(N, A, H, W).astype(np.float32)
+    x = np.concatenate([iou_ch, core], axis=1)
+    img = np.array([[128, 128]], np.int32)
+    anchors = [10, 13, 16, 30]
+    boxes, scores = V.yolo_box(x, img, anchors, nc, 0.0, 32,
+                               iou_aware=True, iou_aware_factor=f)
+    ref_boxes, ref_scores = V.yolo_box(core, img, anchors, nc, 0.0, 32)
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    t = core.reshape(N, A, 5 + nc, H, W)
+    obj = sig(t[:, :, 4])
+    conf_aware = obj ** (1 - f) * sig(iou_ch) ** f
+    ratio = (conf_aware / obj).reshape(N, A * H * W, 1)
+    np.testing.assert_allclose(boxes.numpy(), ref_boxes.numpy(), rtol=1e-4,
+                               atol=1e-3)
+    np.testing.assert_allclose(scores.numpy(), ref_scores.numpy() * ratio,
+                               rtol=1e-3, atol=1e-5)

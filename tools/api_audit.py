@@ -56,6 +56,13 @@ NAMESPACES = {
     "paddle.utils": "utils",
     "paddle.device": "device",
     "paddle.incubate": "incubate",
+    # single-file reference namespaces
+    "paddle.linalg": "linalg",
+    "paddle.distribution": "distribution",
+    "paddle.regularizer": "regularizer",
+    "paddle.sysconfig": "sysconfig",
+    "paddle.callbacks": "callbacks",
+    "paddle.hub": "hub",
 }
 
 # symbol -> one-line reason the TPU-native design dissolves it.
@@ -90,7 +97,10 @@ def ref_public_symbols(ns):
     rel = ns.replace("paddle", "", 1).replace(".", "/")
     path = os.path.join(REF_ROOT + rel, "__init__.py")
     if not os.path.exists(path):
-        return None
+        # single-file namespaces (paddle/linalg.py, distribution.py, ...)
+        path = REF_ROOT + rel + ".py"
+        if not os.path.exists(path):
+            return None
     tree = ast.parse(open(path, encoding="utf-8").read())
     symbols = []
 

@@ -174,6 +174,9 @@ def resolve_by_imports(ns, sym, max_hops=8):
     the caller must report unresolvable instead of guessing."""
     rel_dir = ns.replace("paddle", "", 1).replace(".", "/").lstrip("/")
     cur = os.path.join(rel_dir, "__init__.py") if rel_dir else "__init__.py"
+    if rel_dir and not os.path.isfile(os.path.join(REF_ROOT, cur)):
+        # single-file namespace (paddle/linalg.py, hub.py, callbacks.py)
+        cur = rel_dir + ".py"
     return _resolve_in_file(cur, sym, max_hops, hopped=False)
 
 
