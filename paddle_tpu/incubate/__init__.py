@@ -8,6 +8,8 @@ dygraph recompute/LookAhead-style utilities.
 """
 from .. import sparsity as asp  # noqa: F401
 from . import nn  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import auto_checkpoint  # noqa: F401
 from ..distributed.recompute import recompute  # noqa: F401
 # paddle.incubate.LookAhead / ModelAverage compat aliases
 from .ops import (  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle.incubate.checkpoint namespace (reference
+`incubate/checkpoint/__init__.py`): exposes the auto_checkpoint module.
+The implementation lives in `distributed/checkpoint.py` (orbax-backed
+TrainEpochRange with crash-safe commit ordering)."""
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,12 @@
+"""Auto-checkpoint module alias (reference
+`fluid/incubate/checkpoint/auto_checkpoint.py`): epoch-granular
+train-resume bookkeeping. The TPU-native implementation is
+`paddle_tpu.distributed.checkpoint` (async orbax array checkpoint +
+atomic status commit); this module re-exports its surface under the
+reference path."""
+from ...distributed.checkpoint import (  # noqa: F401
+    TrainEpochRange, train_epoch_range, save_checkpoint, load_checkpoint,
+)
+
+__all__ = ["TrainEpochRange", "train_epoch_range",
+           "save_checkpoint", "load_checkpoint"]

@@ -21,8 +21,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_Q = None   # None -> per-shape policy (_resolve_blocks)
+DEFAULT_BLOCK_K = None
+
+
+def _resolve_blocks(sq, block_q, block_k):
+    """Measured block policy (v5e sweep, tools/tpu_microbench.py +
+    ROUND3_NOTES): bk=1024 wins at every shape tested (512..16384,
+    D 64/128); bq=1024 wins while the merged-backward VMEM working set
+    fits, 512 beyond (1024 fails to compile at QUERY length 16384 — the
+    constraint is governed by sq, not sk). Explicit block args
+    override."""
+    if block_k is None:
+        block_k = 1024
+    if block_q is None:
+        block_q = 1024 if sq <= 8192 else 512
+    return block_q, block_k
 _LANES = 128  # stats buffers padded to a full lane register
 _SUB = 8     # row-stats (lse/delta) replicated over 8 sublanes so their
              # [.., _SUB, bq] blocks satisfy the TPU (8, 128) tile minimum
@@ -456,6 +470,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, sq, n, h = q.shape
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
     return out.reshape(b, n, sq, h).transpose(0, 2, 1, 3)
 
@@ -464,6 +479,7 @@ def _vjp_fwd(q, k, v, causal, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, sq, n, h = q.shape
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
     res = (q, k, v, out, lse)
     return out.reshape(b, n, sq, h).transpose(0, 2, 1, 3), res
@@ -474,6 +490,7 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     sq, h = q.shape[1], q.shape[3]
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
     if sq * h * 4 <= _MERGED_BWD_DQ_SCRATCH_LIMIT:
         dq, dk, dv = _flash_bwd_merged(q, k, v, out, lse, g, causal, scale,
                                        block_q, block_k)
