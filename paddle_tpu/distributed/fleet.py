@@ -343,6 +343,11 @@ class _UtilsNamespace:
         return fs_mod
 
     @property
+    def DistributedInfer(self):
+        from .ps_util import DistributedInfer as cls
+        return cls
+
+    @property
     def LocalFS(self):
         from .fs import LocalFS as cls
         return cls

@@ -1,0 +1,42 @@
+"""Parameter-server inference utility — reference
+`distributed/fleet/utils/ps_util.py` DistributedInfer.
+
+In the reference, distributed inference over a PS cluster needs the
+main program rewritten (distributed sparse lookups -> local lookups
+against pulled tables) plus an env bootstrap that starts servers /
+pulls params to workers. Here the pskv runtime's lookups are already
+issued from the worker against the live tables, so "making the program
+inferable" = making sure the PS env is up and the dense params are
+loaded; no program surgery is needed (that rewrite is the part GSPMD/
+pskv dissolves — documented rather than imitated).
+"""
+
+
+class DistributedInfer:
+    def __init__(self, main_program=None, startup_program=None):
+        self.origin_main_program = main_program
+        self.origin_startup_program = startup_program
+        self.sparse_table_maps = None
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        """Bootstrap the PS env for inference: fleet.init + server/worker
+        split exactly like the reference's flow (`ps_util.py:43-66`)."""
+        from . import fleet
+
+        if not fleet._state.initialized:
+            fleet.init(role_maker=role_maker)
+        if fleet.is_server():
+            fleet.init_server(model_dir=dirname)
+            fleet.run_server(block=False)
+        else:
+            fleet.init_worker()
+            if self.origin_startup_program is not None and exe is not None:
+                exe.run(self.origin_startup_program)
+
+    def get_dist_infer_program(self):
+        """The reference rewrites `distributed_lookup_table` ops into
+        local `lookup_table` ops; pskv workers already evaluate lookups
+        against the live tables, so the original program IS the
+        inference program."""
+        return self.origin_main_program

@@ -1,6 +1,7 @@
 """paddle_tpu.nn — mirrors `python/paddle/nn/__init__.py`."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 
 from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .layer.container import (  # noqa: F401

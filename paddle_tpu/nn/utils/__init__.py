@@ -1,0 +1,168 @@
+"""paddle.nn.utils — weight reparameterization hooks.
+
+Reference surface: `python/paddle/nn/utils/__init__.py`
+(`weight_norm_hook.py`, `spectral_norm_hook.py`). Same mechanism here:
+the original parameter is removed from the layer's parameter dict,
+replaced by the reparameterized pieces, and a forward-pre-hook
+recomputes the effective weight each call — so the recomputation is
+part of the traced program and gradients flow to the pieces in both
+eager and jit regimes.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter, apply
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(v, dim):
+    """L2 norm over every axis except `dim` (paddle weight_norm's g
+    shape: [v.shape[dim]])."""
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes))
+
+
+def _compute_weight_wn(g, v, dim):
+    def fn(gv, vv):
+        n = _norm_except(vv, dim)
+        shape = [1] * vv.ndim
+        shape[dim] = -1
+        return vv * (gv / jnp.maximum(n, 1e-12)).reshape(shape)
+    return apply(fn, g, v)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v/||v|| (reference `weight_norm_hook.py` weight_norm).
+    dim=None means a single scalar g over the whole tensor."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"{type(layer).__name__}.{name} is None")
+    eff_dim = 0 if dim is None else dim
+    if eff_dim < 0:
+        eff_dim += w.ndim
+    wv = w._value
+    if dim is None:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv))).reshape(1)
+    else:
+        g0 = _norm_except(wv, eff_dim)
+    del layer._parameters[name]
+    g = Parameter(g0, name=f"{name}_g")
+    v = Parameter(wv, name=f"{name}_v")
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def hook(lyr, inputs):
+        gp = lyr._parameters[f"{name}_g"]
+        vp = lyr._parameters[f"{name}_v"]
+        if dim is None:
+            def fn(gv, vv):
+                n = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return vv * (gv[0] / jnp.maximum(n, 1e-12))
+            w_eff = apply(fn, gp, vp)
+        else:
+            w_eff = _compute_weight_wn(gp, vp, eff_dim)
+        object.__setattr__(lyr, name, w_eff)
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (handle, dim)
+    hook(layer, ())   # effective weight available immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter and drop the hook."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm hook on {name!r}")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    g = layer._parameters.pop(f"{name}_g")
+    v = layer._parameters.pop(f"{name}_v")
+    eff_dim = 0 if dim is None else dim
+    if eff_dim < 0:
+        eff_dim += v.ndim
+    if dim is None:
+        n = jnp.sqrt(jnp.sum(jnp.square(v._value)))
+        w = v._value * (g._value[0] / jnp.maximum(n, 1e-12))
+    else:
+        n = _norm_except(v._value, eff_dim)
+        shape = [1] * v.ndim
+        shape[eff_dim] = -1
+        w = v._value * (g._value / jnp.maximum(n, 1e-12)).reshape(shape)
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, Parameter(w, name=name))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """w_sn = w / sigma_max(w) via power iteration (reference
+    `spectral_norm_hook.py`). The u/v estimate vectors live as
+    non-trainable buffers updated on every forward, exactly like the
+    reference hook."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"{type(layer).__name__}.{name} is None")
+    if dim is None:
+        # reference default (spectral_norm_hook.py:202-207): dim=1 for
+        # Linear and the transposed convs (their weight is [in, out, ...]),
+        # else 0
+        dim = 1 if type(layer).__name__ in (
+            "Linear", "Conv1DTranspose", "Conv2DTranspose",
+            "Conv3DTranspose") else 0
+    if dim < 0:
+        dim += w.ndim
+    wv = w._value
+    h = wv.shape[dim]
+    del layer._parameters[name]
+    orig = Parameter(wv, name=f"{name}_orig")
+    layer.add_parameter(f"{name}_orig", orig)
+    import numpy as _np
+    rs = _np.random.RandomState(0)
+    u0 = rs.randn(h).astype(_np.float32)
+    u0 /= max(float(_np.linalg.norm(u0)), eps)
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0)),
+                          persistable=True)
+
+    def hook(lyr, inputs):
+        wp = lyr._parameters[f"{name}_orig"]
+        u_buf = lyr._buffers[f"{name}_u"]
+
+        # reference gates iteration on training
+        # (spectral_norm_hook.py:92 do_power_iteration): in eval the
+        # stored estimate is used as-is so repeated inference is pure
+        iters = max(1, n_power_iterations) if lyr.training else 0
+
+        def fn(wval, uval):
+            mat = jnp.moveaxis(wval, dim, 0).reshape(h, -1)
+            u = uval.astype(jnp.float32)
+            for _ in range(iters):
+                v = mat.T.astype(jnp.float32) @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = mat.astype(jnp.float32) @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            v = mat.T.astype(jnp.float32) @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            # u/v are treated as constants for the gradient, like the
+            # reference hook's detached estimates
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ (mat.astype(jnp.float32) @ v)
+            return (wval / sigma.astype(wval.dtype)), u
+
+        w_eff, u_new = apply(fn, wp, u_buf)
+        if lyr.training:
+            # in-place value update, same pattern as batch_norm's running
+            # stats: the buffer OBJECT stays in _buffers so TrainStep's
+            # buffer-carry tracking picks the new value up under jit
+            u_buf._value = u_new._value
+        object.__setattr__(lyr, name, w_eff)
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks", {})
+    layer._spectral_norm_hooks[name] = handle
+    hook(layer, ())
+    return layer

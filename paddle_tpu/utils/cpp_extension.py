@@ -17,7 +17,8 @@ import os
 import subprocess
 import threading
 
-__all__ = ["load", "CppExtension", "get_build_directory"]
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup",
+           "get_build_directory"]
 
 _cache = {}
 _cache_lock = threading.Lock()
@@ -39,7 +40,7 @@ _CTYPE = {
 }
 
 
-def get_build_directory():
+def get_build_directory(verbose=False):
     d = os.environ.get(
         "PADDLE_EXTENSION_DIR",
         os.path.join(os.path.expanduser("~"), ".cache",
@@ -93,10 +94,20 @@ def _parse_sig(sig):
     return name.strip(), _CTYPE[ret], argtypes
 
 
-def load(name, sources=None, extension=None, functions=None,
-         extra_cflags=None, extra_ldflags=None, include_dirs=None,
-         build_directory=None, verbose=False):
-    """Compile C++ `sources` and return the bound library.
+def load(name, sources=None, extra_cxx_cflags=None,
+         extra_cuda_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False,
+         extension=None, functions=None, extra_cflags=None,
+         include_dirs=None):
+    """Compile C++ `sources` and return the bound library. Positional
+    layout follows the reference `cpp_extension.load`
+    (`utils/cpp_extension/cpp_extension.py:727`); `extension`,
+    `functions`, `extra_cflags` and `include_dirs` are this backend's
+    extensions (ctypes binding needs declared C signatures).
+
+    extra_cxx_cflags/extra_include_paths merge with extra_cflags/
+    include_dirs; extra_cuda_cflags raises — there is no CUDA compile
+    on this backend (write device kernels in Pallas).
 
     functions: list of C signatures to declare, e.g.
         ["double dotf(float*, float*, int64)", "void scale(float*, int64,
@@ -104,6 +115,12 @@ def load(name, sources=None, extension=None, functions=None,
     Exported symbols must be `extern "C"`. Recompiles only when any
     source is newer than the cached .so (hash of name+sources).
     """
+    if extra_cuda_cflags:
+        raise NotImplementedError(
+            "extra_cuda_cflags: no CUDA compile exists on this backend; "
+            "device kernels are Pallas (see paddle_tpu/ops/pallas_*.py)")
+    extra_cflags = (extra_cflags or []) + list(extra_cxx_cflags or [])
+    include_dirs = (include_dirs or []) + list(extra_include_paths or [])
     if extension is not None:
         sources = extension.sources
         extra_cflags = (extra_cflags or []) + extension.extra_compile_args
@@ -153,3 +170,49 @@ def load(name, sources=None, extension=None, functions=None,
             fn.restype = restype
             fn.argtypes = argtypes
     return cached
+
+
+class CUDAExtension(CppExtension):
+    """Reference CUDAExtension signature. CUDA sources have no TPU
+    meaning — this raises at BUILD time with the migration route (the
+    TPU path for custom device kernels is Pallas; host-side C++ stays
+    CppExtension) rather than pretending .cu files compile here."""
+
+    def __init__(self, sources, *args, **kwargs):
+        cu = [s for s in sources if str(s).endswith((".cu", ".cuh"))]
+        if cu:
+            raise NotImplementedError(
+                f"CUDAExtension: CUDA sources {cu} cannot build for TPU. "
+                "Write device kernels in Pallas "
+                "(paddle_tpu/ops/pallas_*.py is the pattern) and keep "
+                "host-side C++ in CppExtension.")
+        super().__init__(sources, *args, **kwargs)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Reference `cpp_extension.setup` analog: build each extension's
+    sources now (same g++ + content-keyed cache as `load`) and install
+    an importable module handle under the caller-visible name. The
+    reference delegates to setuptools; here building IS the install,
+    which keeps the zero-setup `import` contract."""
+    import sys
+    import types
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    if name is not None and len(exts) > 1:
+        raise ValueError(
+            "setup(name=..., ext_modules=[...]) with more than one "
+            "extension is ambiguous here (every module would take the "
+            "same name); call setup once per extension")
+    mods = []
+    for i, ext in enumerate(exts):
+        ext_name = name or f"paddle_tpu_ext_{i}"
+        handle = load(ext_name, extension=ext if isinstance(
+            ext, CppExtension) else CppExtension(list(ext)))
+        mod = types.ModuleType(ext_name)
+        mod.__dict__["_ext"] = handle
+        mod.__getattr__ = lambda item, _h=handle: getattr(_h, item)
+        sys.modules[ext_name] = mod
+        mods.append(mod)
+    return mods

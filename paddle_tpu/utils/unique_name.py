@@ -26,8 +26,11 @@ def generate(key):
     return _generator(key)
 
 
-def switch(new_generator=None):
-    """Install (or reset) the namespace; returns the previous one."""
+def switch(new_generator=None, new_para_name_checker=None):
+    """Install (or reset) the namespace; returns the previous one.
+    new_para_name_checker is accepted for reference signature parity
+    (`fluid/unique_name.py` switch) — this build has no dygraph
+    param-name checker to swap, names are unique by construction."""
     global _generator
     old = _generator
     _generator = new_generator or _Generator()

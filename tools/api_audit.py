@@ -56,6 +56,11 @@ NAMESPACES = {
     "paddle.utils": "utils",
     "paddle.device": "device",
     "paddle.incubate": "incubate",
+    "paddle.nn.utils": "nn.utils",
+    "paddle.distributed.utils": "distributed.utils",
+    "paddle.distributed.fleet.utils": "distributed.fleet.utils",
+    "paddle.utils.unique_name": "utils.unique_name",
+    "paddle.utils.cpp_extension": "utils.cpp_extension",
     # single-file reference namespaces
     "paddle.linalg": "linalg",
     "paddle.distribution": "distribution",
