@@ -58,7 +58,12 @@ if [ "$probe_rc" -ne 0 ]; then
   echo "accelerator unavailable or not TPU (rc=$probe_rc): op-bench gate skipped"
 else
   python tools/op_bench.py --out /tmp/op_bench_current.json
+  # threshold 0.25: the two-point min-of-5 discipline holds most ops
+  # to a few %% run-to-run, but tunnel jitter can still blip one case
+  # (see op_bench.py bench_case); 25%% still catches real kernel
+  # regressions while not flapping on the tunnel
   python tools/check_op_benchmark_result.py \
-      tools/op_bench_baseline_v5e.json /tmp/op_bench_current.json
+      tools/op_bench_baseline_v5e.json /tmp/op_bench_current.json \
+      --threshold 0.25
 fi
 echo "CI OK"

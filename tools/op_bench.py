@@ -90,40 +90,96 @@ def _op_fn(name):
     raise ValueError(name)
 
 
-def bench_case(fn, args, iters):
-    """Time `iters` applications inside ONE jit program, chaining each
-    iteration on the previous result to defeat CSE/dedup."""
+def bench_case(fn, args, iters, simple=False):
+    """Per-iteration op time with the tunnel's constant cost CANCELLED.
+
+    The naive single-loop measurement is dominated by the dispatch+fetch
+    round-trip (~100ms under the axon tunnel): every sub-millisecond op
+    reads as ~RTT/iters, and run-to-run RTT jitter swamps a relative
+    gate (observed: gelu 3.4ms vs 13.2ms back-to-back). So: TWO-POINT
+    measurement — time loop(n) and loop(3n), report
+    (min t3n - min tn)/(2n) with min over 5 runs per side (jitter is
+    additive, so each min converges on base-RTT + compute and the base
+    cancels); `n` adapts so the differential covers >=300ms of real
+    compute."""
     import jax
     import jax.numpy as jnp
 
     args = [jnp.asarray(a) for a in args]
 
-    @jax.jit
-    def loop(*a):
-        def body(i, carry):
-            out = fn(*([carry[0]] + list(a[1:]))) if len(a) > 1 \
-                else fn(carry[0])
-            scale = (1.0 + i.astype(jnp.float32) * 1e-9)
-            if out.shape == a[0].shape and out.dtype == a[0].dtype:
-                # chain directly — no per-iteration reduce overhead
-                nxt = out * scale.astype(out.dtype)
-                extra = jnp.zeros((), jnp.float32)
-            else:
-                # shape changes: keep a (cheap) data dependence on out so
-                # the op cannot be dead-code-eliminated
-                extra = jnp.sum(out.astype(jnp.float32)) * 1e-20
-                nxt = a[0] * (scale + extra).astype(a[0].dtype)
-            return (nxt, carry[1] + extra)
-        final, acc = jax.lax.fori_loop(
-            0, iters, body, (a[0], jnp.zeros((), jnp.float32)))
-        return acc + jnp.sum(final.astype(jnp.float32))
+    def make_loop(n):
+        @jax.jit
+        def loop(*a):
+            def body(i, carry):
+                out = fn(*([carry[0]] + list(a[1:]))) if len(a) > 1 \
+                    else fn(carry[0])
+                scale = (1.0 + i.astype(jnp.float32) * 1e-9)
+                if out.shape == a[0].shape and out.dtype == a[0].dtype:
+                    # chain directly — no per-iteration reduce overhead
+                    nxt = out * scale.astype(out.dtype)
+                    extra = jnp.zeros((), jnp.float32)
+                else:
+                    # shape changes: keep a (cheap) data dependence on
+                    # out so the op cannot be dead-code-eliminated
+                    extra = jnp.sum(out.astype(jnp.float32)) * 1e-20
+                    nxt = a[0] * (scale + extra).astype(a[0].dtype)
+                return (nxt, carry[1] + extra)
+            final, acc = jax.lax.fori_loop(
+                0, n, body, (a[0], jnp.zeros((), jnp.float32)))
+            return acc + jnp.sum(final.astype(jnp.float32))
+        return loop
 
-    out = loop(*args)
-    float(out)                                  # compile+run once
-    t0 = time.perf_counter()
-    out = loop(*args)
-    float(out)
-    return (time.perf_counter() - t0) / iters * 1000.0
+    def run(loop):
+        t0 = time.perf_counter()
+        float(loop(*args))
+        return time.perf_counter() - t0
+
+    if simple:
+        # in-process backend (no tunnel): plain single-loop timing —
+        # the RTT-cancellation machinery below is pure overhead here
+        loop = make_loop(iters)
+        run(loop)                                # compile
+        return min(run(loop) for _ in range(2)) / iters * 1000.0
+
+    def min_pair(loop_a, loop_b, k):
+        """k INTERLEAVED (a, b) samples -> (min a, min b): both mins
+        sample the same tunnel epoch, so a base-RTT drift between
+        separate blocks cannot masquerade as compute."""
+        ta, tb = [], []
+        for _ in range(k):
+            ta.append(run(loop_a))
+            tb.append(run(loop_b))
+        return min(ta), min(tb)
+
+    # pilot: DIFFERENTIAL per-iter estimate — a single-loop time is
+    # RTT-inflated by ~100ms and would size n orders of magnitude too
+    # small for microsecond ops (observed: every cheap op reading ~0).
+    # min-of-3 per side: one jitter blip must not drive est to a floor
+    # that pins n at the cap and stalls the gate for minutes.
+    p1, p3 = make_loop(iters), make_loop(3 * iters)
+    run(p1), run(p3)                             # compile both
+    p1min, p3min = min_pair(p1, p3, 3)
+    est = (p3min - p1min) / (2 * iters)
+    if est <= 0:
+        # still jitter-swamped: fall back to the RTT-inflated upper
+        # bound — n comes out smaller (cheaper, less precise), never
+        # huge (no CI stall)
+        est = p3min / (3 * iters)
+    # size n so the timed differential covers >= ~300ms of real compute
+    # (tunnel jitter is tens of ms; the differential must dwarf it)
+    n = max(50, min(20000, int(0.300 / est)))
+    loop_n, loop_3n = make_loop(n), make_loop(3 * n)
+    run(loop_n)                                  # compile
+    run(loop_3n)                                 # compile
+    t_n, t_3n = min_pair(loop_n, loop_3n, 5)
+    if t_3n - t_n <= 0:
+        t_n, t_3n = min_pair(loop_n, loop_3n, 5)  # one retry
+    if t_3n - t_n <= 0:
+        # never emit 0.0 — a zero would read as 'improved' and, if it
+        # landed in a regenerated baseline, disable the case's gate
+        # forever; report the inflated upper bound instead
+        return t_3n / (3 * n) * 1000.0
+    return (t_3n - t_n) / (2 * n) * 1000.0
 
 
 def main(argv=None):
@@ -144,7 +200,8 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     results = {"_device": jax.devices()[0].device_kind}
     for name, case in _cases(args.small).items():
-        ms = bench_case(_op_fn(case["op"]), case["args"], args.iters)
+        ms = bench_case(_op_fn(case["op"]), case["args"], args.iters,
+                        simple=args.cpu)
         results[name] = {"ms": round(ms, 4),
                          "shapes": [list(getattr(a, "shape", ()))
                                     for a in case["args"]]}
