@@ -152,31 +152,10 @@ def main():
                         use_flash_attention=False)
         batch, seq, steps, warmup = 2, 256, 3, 1
 
-    paddle.seed(0)
-    model = GPTForPretraining(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                          parameters=model.parameters())
-
-    def loss_fn(ids, labels):
-        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
-            return model.loss(ids, labels)
-
-    step = paddle.jit.TrainStep(model, loss_fn, opt)
-
-    rs = np.random.RandomState(0)
-    ids = paddle.to_tensor(
-        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
-    lbl = paddle.to_tensor(
-        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
-
-    sec_per_step, loss = _time_train_steps(step, (ids, lbl), steps, warmup)
-    tokens_per_sec = batch * seq / sec_per_step
-
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    # PaLM-style train FLOPs/token: 6N for matmuls + 12*L*H*S for attention
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    r = gpt_train_bench(cfg, batch, seq, steps, warmup, amp_on=on_tpu)
+    tokens_per_sec, mfu = r["tokens_per_sec"], r["mfu"]
+    loss, n_params, sec_per_step = r["loss"], r["n_params"], r["sec_per_step"]
     peak = _peak_flops(dev.device_kind) if on_tpu else None
-    mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
 
     resnet = bench_resnet50(on_tpu, peak)
     layer13 = bench_gpt1_3b_layer(on_tpu, peak)
@@ -196,6 +175,43 @@ def main():
           f"step={sec_per_step*1000:.1f}ms "
           f"resnet50={resnet['images_per_sec']:.0f}img/s",
           file=sys.stderr)
+
+
+def gpt_train_bench(cfg, batch, seq, steps, warmup, amp_on=True):
+    """Shared GPT train-step benchmark body (model + AdamW + TrainStep +
+    chained timing + PaLM-style MFU): one timing discipline and one
+    FLOPs-per-token formula for every GPT scale point (125M here, 350M
+    in bench_extra)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.gpt import GPTForPretraining
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+
+    def loss_fn(ids, labels):
+        with amp.auto_cast(enable=amp_on, dtype="bfloat16"):
+            return model.loss(ids, labels)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    lbl = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    sec_per_step, loss = _time_train_steps(step, (ids, lbl), steps, warmup)
+    tokens_per_sec = batch * seq / sec_per_step
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # PaLM-style train FLOPs/token: 6N for matmuls + 12*L*H*S for attention
+    flops_per_token = (6 * n_params
+                       + 12 * cfg.num_layers * cfg.hidden_size * seq)
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
+    return {"tokens_per_sec": tokens_per_sec, "mfu": mfu, "loss": loss,
+            "n_params": n_params, "sec_per_step": sec_per_step}
 
 
 def bench_resnet50(on_tpu, peak):

@@ -52,6 +52,25 @@ def bench_decode():
             "batch": B, "prompt": prompt_len, "new_tokens": new}
 
 
+def bench_gpt350m():
+    """Full gpt3-350M train step on one chip — the mid-scale MFU point
+    between the 125M flagship bench and the true-1.3B-dims single-layer
+    microbench (the full 1.3B model needs the pod slice). 350M fits:
+    params+AdamW f32 state ~5.6GB of 16GB HBM. Shares bench.py's
+    gpt_train_bench body so the timing discipline and MFU formula can
+    never drift between scale points."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from bench import gpt_train_bench
+
+    cfg = GPTConfig.gpt3_350m(max_seq_len=1024, dropout=0.0)
+    batch, seq = 8, 1024
+    r = gpt_train_bench(cfg, batch, seq, steps=15, warmup=2)
+    return {"metric": "gpt3_350m_train_tokens_per_sec_per_chip",
+            "value": round(r["tokens_per_sec"], 1), "unit": "tokens/sec",
+            "mfu": round(r["mfu"], 4), "batch": batch, "seq": seq,
+            "params_m": round(r["n_params"] / 1e6, 1)}
+
+
 def bench_bert():
     """BERT-base fwd+bwd+AdamW tokens/sec (the round-1 'BERT never
     timed' gap)."""
@@ -236,7 +255,8 @@ def main():
                                    f"{reason[:300]}"}))
         sys.exit(1)
     wrapped = None
-    for fn in (bench_decode, bench_bert, bench_long_context, bench_ocr,
+    for fn in (bench_decode, bench_gpt350m, bench_bert,
+               bench_long_context, bench_ocr,
                bench_int8_linear):
         try:
             print(json.dumps(fn()))

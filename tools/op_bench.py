@@ -168,18 +168,31 @@ def bench_case(fn, args, iters, simple=False):
     # size n so the timed differential covers >= ~300ms of real compute
     # (tunnel jitter is tens of ms; the differential must dwarf it)
     n = max(50, min(20000, int(0.300 / est)))
-    loop_n, loop_3n = make_loop(n), make_loop(3 * n)
-    run(loop_n)                                  # compile
-    run(loop_3n)                                 # compile
-    t_n, t_3n = min_pair(loop_n, loop_3n, 5)
-    if t_3n - t_n <= 0:
-        t_n, t_3n = min_pair(loop_n, loop_3n, 5)  # one retry
-    if t_3n - t_n <= 0:
+    def measure(n):
+        loop_n, loop_3n = make_loop(n), make_loop(3 * n)
+        run(loop_n)                              # compile
+        run(loop_3n)                             # compile
+        t_n, t_3n = min_pair(loop_n, loop_3n, 5)
+        if t_3n - t_n <= 0:
+            t_n, t_3n = min_pair(loop_n, loop_3n, 5)  # one retry
+        return t_n, t_3n
+
+    t_n, t_3n = measure(n)
+    diff = t_3n - t_n
+    if 0 < diff < 0.15 and n < 20000:
+        # the pilot (possibly its inflated fallback) under-sized n and
+        # the differential does not dwarf jitter — one refinement pass
+        # with n re-sized from the MEASURED differential, or else a
+        # jitter blip here would read as a phantom CI regression
+        n = max(n, min(20000, int(0.300 / max(diff / (2 * n), 1e-7))))
+        t_n, t_3n = measure(n)
+        diff = t_3n - t_n
+    if diff <= 0:
         # never emit 0.0 — a zero would read as 'improved' and, if it
         # landed in a regenerated baseline, disable the case's gate
         # forever; report the inflated upper bound instead
         return t_3n / (3 * n) * 1000.0
-    return (t_3n - t_n) / (2 * n) * 1000.0
+    return diff / (2 * n) * 1000.0
 
 
 def main(argv=None):
