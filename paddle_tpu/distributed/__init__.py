@@ -62,7 +62,12 @@ fleet.DistributedStrategy = DistributedStrategy
 
 # ---- round-3 audit closures (reference `distributed/__init__.py`) ----
 from ..io.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
-from . import launch  # noqa: F401,E402  (python -m ... entry module)
+from . import launch as _launch_module  # noqa: E402
+# reference parity: paddle.distributed.launch is the CALLABLE
+# (`distributed/fleet/launch.py:386` def launch()); the module itself
+# stays importable for `python -m paddle_tpu.distributed.launch`
+# (runpy resolves the module path, not this attribute)
+launch = _launch_module.launch
 from .collective import barrier as _barrier  # noqa: E402
 
 
