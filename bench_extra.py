@@ -194,6 +194,43 @@ def bench_ocr():
             "step_ms": round(dt * 1000, 2)}
 
 
+def bench_wo8_decode():
+    """GPT-125M greedy decode with weight-only int8 (quant/wo8.py) vs
+    the bf16 baseline: decode re-reads every weight per token, so int8
+    storage halves HBM bytes/step (W8A16 serving recipe)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.quant import quantize_weights_int8
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    rs = np.random.RandomState(0)
+    B, prompt_len, new = 8, 128, 128
+    ids = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (B, prompt_len)), "int32")
+
+    def timed(reps=3):
+        out, _ = model.generate(ids, max_new_tokens=new)   # compile
+        _sync(out.sum())
+        fetch = _fetch_latency(lambda: _sync(out.sum()))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out, _ = model.generate(ids, max_new_tokens=new)
+        _sync(out.sum())
+        dt = max(1e-9, time.perf_counter() - t0 - fetch)
+        return B * new * reps / dt
+
+    bf16_tps = timed()
+    n = quantize_weights_int8(model)
+    int8_tps = timed()
+    return {"metric": "wo8_decode_tokens_per_sec", "unit": "tokens/sec",
+            "value": round(int8_tps, 1),
+            "bf16_tokens_per_sec": round(bf16_tps, 1),
+            "speedup": round(int8_tps / max(bf16_tps, 1e-9), 3),
+            "swapped_linears": n}
+
+
 def bench_int8_linear():
     """Per-channel int8 inference linear vs bf16 (the MXU int8 2x-
     throughput claim behind the quant deploy path): chained matmuls at
@@ -257,7 +294,7 @@ def main():
     wrapped = None
     for fn in (bench_decode, bench_gpt350m, bench_bert,
                bench_long_context, bench_ocr,
-               bench_int8_linear):
+               bench_int8_linear, bench_wo8_decode):
         try:
             print(json.dumps(fn()))
         except Exception as e:  # keep later phases running

@@ -288,12 +288,24 @@ def run_generate(model, input_ids, max_new_tokens=32,
         raise ValueError("input_ids must be [batch, prompt_len]")
     b, s0 = ids.shape
 
-    params = [p for _, p in model.named_parameters()]
+    named = list(model.named_parameters())
+    params = [p for _, p in named]
+    # the parameter TREE is part of the cache identity: a structural
+    # change (e.g. quant.quantize_weights_int8 swapping Linears) after
+    # a cached trace would rebind the new flat param list against the
+    # old trace's order and scramble weights silently. The sig tuple
+    # itself is the key component (a hash could collide -> scramble).
+    tree_sig = tuple((n, tuple(p.shape), str(p.dtype)) for n, p in named)
     key = (b, s0, int(max_new_tokens), decode_strategy, int(top_k),
            float(top_p), float(temperature), int(num_beams),
            float(length_penalty), eos_token_id, int(pad_token_id),
-           str(dtype))
+           str(dtype), tree_sig)
     cache = model.__dict__.setdefault("_generate_cache", {})
+    # evict traces built against a DIFFERENT tree: their closures pin
+    # the replaced parameter set (e.g. the pre-quantize bf16 weights)
+    # in device memory for the model's lifetime otherwise
+    for k in [k for k in cache if k[-1] != tree_sig]:
+        del cache[k]
     fn = cache.get(key)
     if fn is None:
         if decode_strategy == "beam_search":

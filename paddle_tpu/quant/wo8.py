@@ -1,0 +1,85 @@
+"""Weight-only int8 — the LLM decode bandwidth lever.
+
+Autoregressive decode is WEIGHT-bandwidth-bound (each generated token
+re-reads every matmul weight; activations are tiny), so storing Linear
+weights as int8 + per-output-channel scales halves the HBM bytes per
+step while activations and accumulation stay bf16/f32 — unlike the
+act+weight Int8Linear path (`ptq.py`), no activation calibration is
+needed and there is no activation-quantization error.
+
+Reference analog: `contrib/slim` weight-quantize utilities
+(`post_training_quantization.py` weight_quantize path); the
+serving-world name for this recipe is "weight-only int8" (W8A16).
+
+Usage:
+    model = GPTForPretraining(cfg)
+    model.set_state_dict(...)                  # trained weights
+    quantize_weights_int8(model)               # in-place Linear swap
+    out, _ = model.generate(ids, max_new_tokens=...)
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor, apply
+
+__all__ = ["WeightOnlyInt8Linear", "quantize_weights_int8",
+           "channelwise_int8"]
+
+
+def channelwise_int8(w, bits=8):
+    """Per-OUTPUT-channel symmetric int8: returns (wq int8, scale f32)
+    with w ~= wq * scale. Shared by the weight-only path here and the
+    act+weight Int8Linear in ptq.py."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = np.maximum(np.max(np.abs(w), axis=0), 1e-8) / qmax   # [out]
+    wq = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
+    return wq, scale.astype(np.float32)
+
+
+class WeightOnlyInt8Linear(nn.Layer):
+    """Drop-in Linear replacement: w int8 [in, out] + f32 scale [out];
+    forward dequantizes IN VMEM after the 1-byte-per-weight HBM read
+    (the cast + scale fuse into the matmul's epilogue under XLA).
+    wq/w_scale are persistable BUFFERS so state_dict round-trips the
+    quantized weights (save-after-quantize serving flow)."""
+
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        wq, ws = channelwise_int8(layer.weight.numpy(), bits)
+        self.register_buffer("w_scale", Tensor(jnp.asarray(ws)),
+                             persistable=True)
+        self.register_buffer("wq", Tensor(jnp.asarray(wq)),
+                             persistable=True)
+        self.bias = layer.bias
+
+    def forward(self, x):
+        def fn(xv, wq, ws, *maybe_bias):
+            # int8 -> activation dtype in VMEM; bf16 MXU matmul; scale
+            # per out-channel in the epilogue
+            out = jnp.matmul(xv, wq.astype(xv.dtype))
+            out = out * ws.astype(xv.dtype)
+            if maybe_bias:
+                out = out + maybe_bias[0].astype(out.dtype)
+            return out
+        args = (x, self.wq, self.w_scale) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply(fn, *args)
+
+
+def quantize_weights_int8(layer, bits=8, min_features=0):
+    """Walk the layer tree replacing every nn.Linear with a
+    WeightOnlyInt8Linear in place (embeddings, norms and the tied
+    lm-head matmul are untouched — they are not nn.Linear modules).
+    min_features skips small projections whose bandwidth doesn't
+    matter. Returns the count of swapped layers."""
+    swapped = 0
+    for name, child in list(layer._sub_layers.items()):
+        if isinstance(child, nn.Linear):
+            w = child.weight
+            if min(w.shape) >= min_features:
+                layer._sub_layers[name] = WeightOnlyInt8Linear(child, bits)
+                swapped += 1
+        else:
+            swapped += quantize_weights_int8(child, bits, min_features)
+    return swapped

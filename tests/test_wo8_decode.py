@@ -1,0 +1,78 @@
+"""Weight-only int8 (paddle_tpu/quant/wo8.py): the decode bandwidth
+lever, plus the generate-cache invalidation it exposed."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quant import WeightOnlyInt8Linear, quantize_weights_int8
+
+
+def _small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0,
+                    use_flash_attention=False)
+    return GPTForPretraining(cfg)
+
+
+def test_wo8_linear_matches_fp32():
+    paddle.seed(0)
+    lin = nn.Linear(64, 48)
+    q = WeightOnlyInt8Linear(lin)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 64).astype(np.float32))
+    ref = lin(x).numpy()
+    got = q(x).numpy()
+    # per-channel int8 weights: ~0.4% relative error scale
+    rel = np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.02, rel
+    assert q.wq.dtype == paddle.int8 if hasattr(paddle, "int8") else True
+
+
+def test_quantize_model_swaps_linears_only():
+    model = _small_gpt()
+    n_emb_before = len([p for n, p in model.named_parameters()
+                        if "wte" in n or "wpe" in n])
+    n = quantize_weights_int8(model)
+    assert n == 8  # qkv/out/fc1/fc2 x 2 layers
+    n_emb_after = len([p for n, p in model.named_parameters()
+                      if "wte" in n or "wpe" in n])
+    assert n_emb_before == n_emb_after  # embeddings untouched
+    # Linear weight Parameters are gone; biases remain
+    names = [n for n, _ in model.named_parameters()]
+    assert not any(n.endswith("qkv_proj.weight") for n in names)
+    assert any(n.endswith("qkv_proj.bias") for n in names)
+
+
+def test_wo8_decode_matches_fp32_greedy():
+    model = _small_gpt()
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 512, (2, 16)), "int32")
+    logits_ref = model(ids).numpy()
+    out_ref, _ = model.generate(ids, max_new_tokens=12)
+    quantize_weights_int8(model)
+    logits_q = model(ids).numpy()
+    rel = np.max(np.abs(logits_q - logits_ref)) / (
+        np.max(np.abs(logits_ref)) + 1e-9)
+    assert rel < 0.05, rel
+    out_q, _ = model.generate(ids, max_new_tokens=12)
+    np.testing.assert_array_equal(out_ref.numpy(), out_q.numpy())
+
+
+def test_generate_cache_invalidates_on_param_tree_change():
+    """The compiled-decode cache must key on the parameter TREE: reusing
+    a pre-quantize trace with the post-quantize flat param list would
+    rebind weights in the old order and scramble them silently (found
+    the hard way). Stale-tree entries are also EVICTED — their closures
+    pin the replaced bf16 weights in device memory otherwise."""
+    model = _small_gpt()
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 512, (2, 8)), "int32")
+    model.generate(ids, max_new_tokens=4)       # populate the cache
+    old_keys = set(model._generate_cache)
+    quantize_weights_int8(model)
+    model.generate(ids, max_new_tokens=4)       # must NOT reuse
+    new_keys = set(model._generate_cache)
+    assert not (old_keys & new_keys)            # stale trace evicted
+    assert len(new_keys) == 1                   # only the current tree
