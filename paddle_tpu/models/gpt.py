@@ -295,7 +295,26 @@ class GPTForPretraining(Layer):
                                      offset=offset)
         else:
             h = self.gpt(input_ids, position_ids)
-        w = self.gpt.wte.weight
+        wte = self.gpt.wte
+        if hasattr(wte, "wq"):
+            # weight-only-int8 tied table (quant/wo8.py): contract
+            # against the int8 rows cast in VMEM and apply the per-row
+            # scale in the EPILOGUE — scaling before the dot would
+            # materialize a dequantized [V, H] temp and forfeit the
+            # 1-byte-per-weight HBM read
+            def head_q(hh, wq, ws):
+                from ..amp import amp_state
+                cdt = jnp.bfloat16 if amp_state().enabled else hh.dtype
+                out = jnp.einsum("bsd,vd->bsv", hh.astype(cdt),
+                                 wq.astype(cdt),
+                                 preferred_element_type=jnp.float32)
+                out = out * ws.astype(jnp.float32)[None, None, :]
+                return out.astype(cdt) if amp_state().enabled else out
+            logits = apply(head_q, h, wte.wq, wte.w_scale)
+            if caches is not None:
+                return logits, new_caches
+            return logits
+        w = wte.weight
         from ..amp import maybe_cast_to_compute as _amp
 
         def head(hh, ww):

@@ -13,6 +13,7 @@ matmuls on the MXU (int8 is 2x bf16 throughput on v5e+).
 from .qat import (FakeQuantAbsMax, QuantizedLinear, QuantizedConv2D,  # noqa: F401
                   QuantizedConv2DBN, QAT, quant_dequant,
                   quant_dequant_channelwise)
-from .wo8 import (WeightOnlyInt8Linear, quantize_weights_int8)  # noqa: F401
+from .wo8 import (WeightOnlyInt8Linear, WeightOnlyInt8Embedding,  # noqa: F401
+                  quantize_weights_int8, channelwise_int8)
 from .ptq import (PTQ, AbsmaxQuantizer, HistQuantizer, KLQuantizer,  # noqa: F401
                   Int8Linear, Int8Conv2D, fold_conv_bn)

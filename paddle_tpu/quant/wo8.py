@@ -23,8 +23,8 @@ import jax.numpy as jnp
 from .. import nn
 from ..core.tensor import Tensor, apply
 
-__all__ = ["WeightOnlyInt8Linear", "quantize_weights_int8",
-           "channelwise_int8"]
+__all__ = ["WeightOnlyInt8Linear", "WeightOnlyInt8Embedding",
+           "quantize_weights_int8", "channelwise_int8"]
 
 
 def channelwise_int8(w, bits=8):
@@ -67,12 +67,61 @@ class WeightOnlyInt8Linear(nn.Layer):
         return apply(fn, *args)
 
 
-def quantize_weights_int8(layer, bits=8, min_features=0):
+class WeightOnlyInt8Embedding(nn.Layer):
+    """Embedding with int8 rows + per-ROW f32 scales. One quantization
+    serves BOTH uses of a tied LM-head table: the lookup dequantizes the
+    gathered rows, and the vocab projection's out-channels ARE the rows,
+    so the head matmul reads the same int8 table and applies the scale
+    in its epilogue (see GPTForPretraining.forward's quantized branch —
+    scaling AFTER the contraction avoids materializing a dequantized
+    [V, H] temp)."""
+
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        w = layer.weight.numpy()                     # [V, H]
+        wq_t, ws = channelwise_int8(w.T, bits)       # per-ROW of w
+        self.register_buffer("wq", Tensor(jnp.asarray(wq_t.T)),
+                             persistable=True)       # int8 [V, H]
+        self.register_buffer("w_scale", Tensor(jnp.asarray(ws)),
+                             persistable=True)       # f32 [V]
+        self._padding_idx = getattr(layer, "_padding_idx", None)
+
+    def forward(self, x):
+        pad = self._padding_idx
+
+        def fn(ids, wq, ws):
+            # dequantize into the SCALE's dtype: generation's
+            # _cast_params casts the float scale buffer to the decode
+            # compute dtype (bf16), so the rows enter the stack in the
+            # same dtype an unquantized embedding would — emitting f32
+            # here would silently downgrade the whole bf16 decode
+            ids = jnp.clip(ids, 0, wq.shape[0] - 1)
+            rows = wq[ids].astype(ws.dtype) * ws[ids][..., None]
+            if pad is not None:
+                # F.embedding masks the padding row at LOOKUP time (the
+                # stored row can drift); mirror it
+                rows = jnp.where((ids == pad)[..., None],
+                                 jnp.zeros((), rows.dtype), rows)
+            return rows
+        from ..core.tensor import apply as _apply
+        from ..tensor._helpers import ensure_tensor
+        return _apply(fn, ensure_tensor(x), self.wq, self.w_scale)
+
+
+def quantize_weights_int8(layer, bits=8, min_features=0,
+                          embeddings=False):
     """Walk the layer tree replacing every nn.Linear with a
-    WeightOnlyInt8Linear in place (embeddings, norms and the tied
-    lm-head matmul are untouched — they are not nn.Linear modules).
-    min_features skips small projections whose bandwidth doesn't
-    matter. Returns the count of swapped layers."""
+    WeightOnlyInt8Linear in place (norms are untouched). With
+    embeddings=True, nn.Embedding tables are also quantized per-row —
+    including a tied LM-head table, whose vocab projection then reads
+    int8 (GPT's head path detects the quantized wte). NOTE measured on
+    v5e: embeddings=True made GPT-125M decode SLOWER (10.2k vs 12.0k
+    bf16 tok/s; linears-only reaches 18.8k) — XLA materializes the
+    dequantized [V, H] copy rather than fusing the int8->bf16 convert
+    into the dot operand. Default False; memory-constrained serving may
+    still want the ~2x smaller table. min_features skips small
+    projections whose bandwidth doesn't matter. Returns the count of
+    swapped layers."""
     swapped = 0
     for name, child in list(layer._sub_layers.items()):
         if isinstance(child, nn.Linear):
@@ -80,6 +129,12 @@ def quantize_weights_int8(layer, bits=8, min_features=0):
             if min(w.shape) >= min_features:
                 layer._sub_layers[name] = WeightOnlyInt8Linear(child, bits)
                 swapped += 1
+        elif embeddings and isinstance(child, nn.Embedding):
+            if min(child.weight.shape) >= min_features:
+                layer._sub_layers[name] = WeightOnlyInt8Embedding(child,
+                                                                  bits)
+                swapped += 1
         else:
-            swapped += quantize_weights_int8(child, bits, min_features)
+            swapped += quantize_weights_int8(child, bits, min_features,
+                                             embeddings)
     return swapped

@@ -76,3 +76,22 @@ def test_generate_cache_invalidates_on_param_tree_change():
     new_keys = set(model._generate_cache)
     assert not (old_keys & new_keys)            # stale trace evicted
     assert len(new_keys) == 1                   # only the current tree
+
+
+def test_wo8_embeddings_quantize_correct():
+    """embeddings=True: per-row int8 table serves both the lookup and
+    the tied LM head (slower on v5e — see wo8.py NOTE — but must stay
+    CORRECT; memory-constrained serving uses it for the 2x table)."""
+    model = _small_gpt()
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 512, (2, 16)), "int32")
+    logits_ref = model(ids).numpy()
+    out_ref, _ = model.generate(ids, max_new_tokens=10)
+    n = quantize_weights_int8(model, embeddings=True)
+    assert n == 10  # 8 linears + wte + wpe
+    logits_q = model(ids).numpy()
+    rel = np.max(np.abs(logits_q - logits_ref)) / (
+        np.max(np.abs(logits_ref)) + 1e-9)
+    assert rel < 0.05, rel
+    out_q, _ = model.generate(ids, max_new_tokens=10)
+    np.testing.assert_array_equal(out_ref.numpy(), out_q.numpy())
