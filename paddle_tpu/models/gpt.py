@@ -297,18 +297,38 @@ class GPTForPretraining(Layer):
             h = self.gpt(input_ids, position_ids)
         wte = self.gpt.wte
         if hasattr(wte, "wq"):
-            # weight-only-int8 tied table (quant/wo8.py): contract
-            # against the int8 rows cast in VMEM and apply the per-row
-            # scale in the EPILOGUE — scaling before the dot would
-            # materialize a dequantized [V, H] temp and forfeit the
-            # 1-byte-per-weight HBM read
+            # weight-only-int8 tied table (quant/wo8.py): the table is
+            # row-padded to the pallas head block; logits slice back to
+            # the true vocab
+            V = wte.num_embeddings
+            from ..core import autograd as _ag
+            # the pallas kernel has no vjp: only take it when no grad
+            # can flow (generate runs under no_grad; tuning paths with
+            # a live tape keep the differentiable einsum)
+            grad_live = _ag.grad_enabled() and not h.stop_gradient
+
             def head_q(hh, wq, ws):
                 from ..amp import amp_state
+                import jax as _jax
+                b, s, d = hh.shape
+                if (_jax.default_backend() == "tpu" and b * s <= 64
+                        and not grad_live):
+                    # decode-sized rows: pallas int8 matvec streams the
+                    # int8 tiles into VMEM (XLA won't fuse the
+                    # int8->bf16 convert into a dot operand and instead
+                    # materializes a dequantized [V, H] copy — measured
+                    # slower than bf16 weights; ops/pallas_int8.py)
+                    from ..ops.pallas_int8 import int8_matvec
+                    out = int8_matvec(hh.reshape(b * s, d), wq, ws)
+                    out = out.reshape(b, s, -1)[..., :V]
+                    return out.astype(jnp.bfloat16) \
+                        if amp_state().enabled else out
                 cdt = jnp.bfloat16 if amp_state().enabled else hh.dtype
                 out = jnp.einsum("bsd,vd->bsv", hh.astype(cdt),
                                  wq.astype(cdt),
                                  preferred_element_type=jnp.float32)
                 out = out * ws.astype(jnp.float32)[None, None, :]
+                out = out[..., :V]
                 return out.astype(cdt) if amp_state().enabled else out
             logits = apply(head_q, h, wte.wq, wte.w_scale)
             if caches is not None:

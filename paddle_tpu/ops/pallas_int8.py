@@ -1,0 +1,66 @@
+"""Pallas int8-weight matmul for the weight-only-int8 LM head.
+
+XLA does not fuse an int8->bf16 convert into a dot operand: the
+quantized tied-head einsum materializes a dequantized [V, H] copy in
+HBM every decode step, measured SLOWER than just reading bf16 weights
+(10.8k vs 12.0k tok/s — see quant/wo8.py NOTE). This kernel does what
+the fusion should: stream int8 weight tiles into VMEM (1 byte/weight
+off HBM), convert + contract + scale in-register, emit [B, V] logits.
+
+Inference-only (no vjp): the head's training path keeps the bf16
+einsum. Row count B pads to the bf16 sublane minimum; V must divide by
+the block (callers pad the table once at quantize time — see
+WeightOnlyInt8Embedding.__init__; the consumer is GPTForPretraining's
+head_q branch).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_V = 1024
+_MIN_ROWS = 16   # bf16 sublane minimum
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(h_ref, wq_ref, s_ref, out_ref):
+    hh = h_ref[...].astype(jnp.bfloat16)            # [Bp, D]
+    w = wq_ref[...].astype(jnp.bfloat16)            # [bv, D]
+    acc = jax.lax.dot_general(
+        hh, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [Bp, bv]
+    out_ref[...] = acc * s_ref[...][None, :]
+
+
+def int8_matvec(h, wq, scale, block_v=_BLOCK_V):
+    """h [B, D] (any float dtype), wq int8 [V, D], scale f32 [V] ->
+    [B, V] f32 logits (= h @ (wq * scale[:, None]).T without ever
+    materializing the dequantized table)."""
+    from jax.experimental import pallas as pl
+
+    B, D = h.shape
+    V = wq.shape[0]
+    if V % block_v:
+        raise ValueError(
+            f"int8_matvec: V ({V}) must divide block_v ({block_v}); "
+            "pad the table once at quantize time")
+    Bp = ((max(_MIN_ROWS, B) + _MIN_ROWS - 1) // _MIN_ROWS) * _MIN_ROWS
+    if Bp != B:
+        h = jnp.concatenate(
+            [h, jnp.zeros((Bp - B, D), h.dtype)], axis=0)
+    out = pl.pallas_call(
+        _kernel,
+        grid=(V // block_v,),
+        in_specs=[
+            pl.BlockSpec((Bp, D), lambda i: (0, 0)),
+            pl.BlockSpec((block_v, D), lambda i: (i, 0)),
+            pl.BlockSpec((block_v,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((Bp, block_v), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((Bp, V), jnp.float32),
+        interpret=_interpret(),
+    )(h, wq, scale.astype(jnp.float32))
+    return out[:B]

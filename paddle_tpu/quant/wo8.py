@@ -76,18 +76,34 @@ class WeightOnlyInt8Embedding(nn.Layer):
     scaling AFTER the contraction avoids materializing a dequantized
     [V, H] temp)."""
 
+    @property
+    def _HEAD_BLOCK(self):
+        # single source of truth: the pad target IS the kernel block
+        from ..ops.pallas_int8 import _BLOCK_V
+        return _BLOCK_V
+
     def __init__(self, layer, bits=8):
         super().__init__()
         w = layer.weight.numpy()                     # [V, H]
         wq_t, ws = channelwise_int8(w.T, bits)       # per-ROW of w
-        self.register_buffer("wq", Tensor(jnp.asarray(wq_t.T)),
-                             persistable=True)       # int8 [V, H]
+        wq, V = wq_t.T, w.shape[0]
+        # pad rows to the pallas head-kernel block once at quantize
+        # time (scale 0 on pad rows; head consumers slice to true V)
+        pad = (-V) % self._HEAD_BLOCK
+        if pad:
+            wq = np.concatenate(
+                [wq, np.zeros((pad, w.shape[1]), np.int8)], axis=0)
+            ws = np.concatenate([ws, np.zeros((pad,), np.float32)])
+        self.num_embeddings = V
+        self.register_buffer("wq", Tensor(jnp.asarray(wq)),
+                             persistable=True)       # int8 [Vp, H]
         self.register_buffer("w_scale", Tensor(jnp.asarray(ws)),
-                             persistable=True)       # f32 [V]
+                             persistable=True)       # f32 [Vp]
         self._padding_idx = getattr(layer, "_padding_idx", None)
 
     def forward(self, x):
         pad = self._padding_idx
+        n_real = self.num_embeddings
 
         def fn(ids, wq, ws):
             # dequantize into the SCALE's dtype: generation's
@@ -95,7 +111,10 @@ class WeightOnlyInt8Embedding(nn.Layer):
             # compute dtype (bf16), so the rows enter the stack in the
             # same dtype an unquantized embedding would — emitting f32
             # here would silently downgrade the whole bf16 decode
-            ids = jnp.clip(ids, 0, wq.shape[0] - 1)
+            # clip to the TRUE vocab (not the padded table): an
+            # out-of-range id must keep mapping to the last real row,
+            # not to a zero-scale pad row
+            ids = jnp.clip(ids, 0, n_real - 1)
             rows = wq[ids].astype(ws.dtype) * ws[ids][..., None]
             if pad is not None:
                 # F.embedding masks the padding row at LOOKUP time (the
@@ -115,11 +134,14 @@ def quantize_weights_int8(layer, bits=8, min_features=0,
     embeddings=True, nn.Embedding tables are also quantized per-row —
     including a tied LM-head table, whose vocab projection then reads
     int8 (GPT's head path detects the quantized wte). NOTE measured on
-    v5e: embeddings=True made GPT-125M decode SLOWER (10.2k vs 12.0k
-    bf16 tok/s; linears-only reaches 18.8k) — XLA materializes the
-    dequantized [V, H] copy rather than fusing the int8->bf16 convert
-    into the dot operand. Default False; memory-constrained serving may
-    still want the ~2x smaller table. min_features skips small
+    v5e (GPT-125M decode, bf16 11.8k tok/s, linears-only 15.9-18.8k):
+    embeddings=True is SLOWER than bf16 for the head even through the
+    dedicated pallas int8 matvec (11.1k; the XLA einsum materializes a
+    dequantized [V, H] copy and is worse still at 10.8k) — at decode
+    sizes the per-step kernel overhead eats the 39MB-vs-77MB read
+    saving. Default False; memory-constrained serving may still want
+    the ~2x smaller table, and the pallas head is its best-known path
+    (ops/pallas_int8.py). min_features skips small
     projections whose bandwidth doesn't matter. Returns the count of
     swapped layers."""
     swapped = 0

@@ -95,3 +95,23 @@ def test_wo8_embeddings_quantize_correct():
     assert rel < 0.05, rel
     out_q, _ = model.generate(ids, max_new_tokens=10)
     np.testing.assert_array_equal(out_ref.numpy(), out_q.numpy())
+
+
+def test_int8_matvec_kernel_matches_reference():
+    """ops/pallas_int8.int8_matvec (interpret mode on CPU): the int8
+    head contraction with epilogue scaling matches the dequantized
+    matmul, including the B < sublane-min padding path."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_int8 import int8_matvec
+    rs = np.random.RandomState(0)
+    B, D, V = 3, 128, 2048
+    h = jnp.asarray(rs.randn(B, D), jnp.float32)
+    wq = jnp.asarray(rs.randint(-127, 128, (V, D)), np.int8)
+    s = jnp.asarray(np.abs(rs.randn(V)) * 0.01, jnp.float32)
+    got = np.asarray(int8_matvec(h, wq, s))
+    ref = (np.asarray(h)
+           @ (np.asarray(wq).astype(np.float32)
+              * np.asarray(s)[:, None]).T)
+    rel = np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert got.shape == (B, V)
+    assert rel < 2e-2, rel
