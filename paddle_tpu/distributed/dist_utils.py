@@ -355,22 +355,21 @@ def terminate_local_procs(procs):
 
 
 def watch_local_trainers(procs, nranks):
-    """Poll until every trainer exits; terminate the pod on first failure
-    (reference `utils.py:717`). Returns the list of still-alive procs
-    (empty when the job is done)."""
+    """Poll ONCE and return the still-alive procs (empty when the job is
+    done); terminate the pod and raise on first failure. The caller loops
+    and pulls worker logs between polls — the reference contract
+    (`utils.py:717` watch_local_trainers returns alive_trainers per call,
+    and launch.py's loop calls pull_worker_log each iteration)."""
     try:
-        while True:
-            alive = [p for p in procs
-                     if p.proc is not None and p.proc.poll() is None]
-            failed = [p for p in procs
-                      if p.proc is not None and p.proc.poll()
-                      not in (None, 0)]
-            if failed:
-                terminate_local_procs(procs)
-                raise SystemExit(failed[0].proc.returncode)
-            if not alive:
-                return []
-            time.sleep(0.5)
+        alive = [p for p in procs
+                 if p.proc is not None and p.proc.poll() is None]
+        failed = [p for p in procs
+                  if p.proc is not None and p.proc.poll()
+                  not in (None, 0)]
+        if failed:
+            terminate_local_procs(procs)
+            raise SystemExit(failed[0].proc.returncode)
+        return alive
     except KeyboardInterrupt:
         terminate_local_procs(procs)
         raise

@@ -288,7 +288,13 @@ def run_generate(model, input_ids, max_new_tokens=32,
         raise ValueError("input_ids must be [batch, prompt_len]")
     b, s0 = ids.shape
 
-    named = list(model.named_parameters())
+    # bind buffers as well as parameters: WeightOnlyInt8Linear/Embedding
+    # carry wq/w_scale as persistable BUFFERS, and leaving them out of the
+    # bound list bakes them into every cached trace as constants (one full
+    # pinned copy of the quantized weights per (batch, prompt_len, ...)
+    # cache key) and hides w_scale from _cast_params' decode-dtype cast
+    named = list(model.named_parameters()) + [
+        (n, b) for n, b in model.named_buffers() if b is not None]
     params = [p for _, p in named]
     # the parameter TREE is part of the cache identity: a structural
     # change (e.g. quant.quantize_weights_int8 swapping Linears) after

@@ -193,16 +193,21 @@ class Layer:
         return [p for _, p in self.named_parameters(
             include_sublayers=include_sublayers)]
 
-    def named_buffers(self, prefix="", include_sublayers=True):
+    def named_buffers(self, prefix="", include_sublayers=True, _seen=None):
+        # id-dedup like named_parameters: a sublayer registered under two
+        # attribute names (e.g. ErnieModel's `ernie = self.bert` alias)
+        # must not emit its buffers twice / under both prefixes
+        seen = _seen if _seen is not None else set()
         for name, b in self._buffers.items():
-            if b is not None:
+            if b is not None and id(b) not in seen:
+                seen.add(id(b))
                 yield (f"{prefix}.{name}" if prefix else name), b
         if include_sublayers:
             for lname, layer in self._sub_layers.items():
                 if layer is None:
                     continue
                 sub_prefix = f"{prefix}.{lname}" if prefix else lname
-                yield from layer.named_buffers(sub_prefix, True)
+                yield from layer.named_buffers(sub_prefix, True, seen)
 
     def buffers(self, include_sublayers=True):
         return [b for _, b in self.named_buffers(

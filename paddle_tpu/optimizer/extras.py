@@ -29,6 +29,13 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay=0.999, thres_steps=None, name=None,
                  parameters=None, bias_correction=True):
+        if isinstance(decay, (list, tuple)) or (
+                hasattr(decay, "__iter__") and not hasattr(decay, "__float__")):
+            raise TypeError(
+                "ExponentialMovingAverage now follows the reference "
+                "signature (decay first); pass the parameter list as "
+                "ExponentialMovingAverage(decay, "
+                "parameters=model.parameters()) — see MIGRATION.md")
         if parameters is None:
             raise ValueError(
                 "ExponentialMovingAverage(parameters=...) is required: "
@@ -108,6 +115,13 @@ class ModelAverage:
                  name=None):
         # param ORDER follows the reference ModelAverage
         # (`incubate/optimizer/modelaverage.py`: rate first)
+        if isinstance(average_window_rate, (list, tuple)) or (
+                hasattr(average_window_rate, "__iter__")
+                and not hasattr(average_window_rate, "__float__")):
+            raise TypeError(
+                "ModelAverage now follows the reference signature (rate "
+                "first); pass the parameter list as ModelAverage(rate, "
+                "parameters=model.parameters()) — see MIGRATION.md")
         if parameters is None:
             raise ValueError("ModelAverage requires parameters")
         self._params = list(parameters)

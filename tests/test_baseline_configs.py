@@ -80,6 +80,16 @@ def test_ernie_finetune_converges():
     assert cfg.vocab_size == 18000 and cfg.max_position == 513
     net = ErnieForSequenceClassification(cfg, num_classes=2)
     assert net.ernie is net.bert
+    # the alias registers the trunk under two names; traversal must
+    # dedup by identity so state_dict keys appear once (advisor r3)
+    pnames = [n for n, _ in net.named_parameters()]
+    assert len(pnames) == len(set(pnames))
+    assert not any(n.startswith("ernie.") for n in pnames)
+    net.bert.register_buffer("probe", paddle.to_tensor(np.zeros(2)))
+    bnames = [n for n, _ in net.named_buffers()]
+    assert bnames.count("bert.probe") == 1
+    assert "ernie.probe" not in bnames
+    del net.bert._buffers["probe"]
     opt = paddle.optimizer.AdamW(learning_rate=5e-4,
                                  parameters=net.parameters())
     step = paddle.jit.TrainStep(

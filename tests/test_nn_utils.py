@@ -114,7 +114,16 @@ def test_start_watch_local_trainers(tmp_path):
                                [0, 1])
     procs = start_local_trainers(cluster, pod, str(script), [],
                                  log_dir=str(tmp_path / "logs"))
-    assert watch_local_trainers(procs, cluster.trainers_nranks()) == []
+    # reference loop contract: poll once per call, stream logs between
+    # polls, stop when no trainer remains alive
+    import time
+    for _ in range(300):
+        alive = watch_local_trainers(procs, cluster.trainers_nranks())
+        for p in procs:
+            pull_worker_log(p)
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert not alive
     for p in procs:
-        pull_worker_log(p)
         assert p.proc.returncode == 0

@@ -97,6 +97,31 @@ def test_wo8_embeddings_quantize_correct():
     np.testing.assert_array_equal(out_ref.numpy(), out_q.numpy())
 
 
+def test_generate_binds_buffers_not_constants():
+    """wq/w_scale are BUFFERS; run_generate must bind them per call like
+    parameters. If they were baked into the trace as constants, (a) every
+    cached (batch, prompt_len, ...) key would pin its own full copy of the
+    quantized weights in device memory, and (b) updating a buffer in place
+    would silently decode with the stale weights (advisor finding r3)."""
+    model = _small_gpt()
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 512, (2, 8)), "int32")
+    quantize_weights_int8(model)
+    out_a, _ = model.generate(ids, max_new_tokens=6)
+    # perturb one quantized table in place: shapes/dtypes (and thus the
+    # cache key) are unchanged, so the same trace is reused — the output
+    # only changes if buffers are BOUND rather than baked in
+    buf = dict(model.named_buffers())
+    wq_names = [n for n in buf if n.endswith(".wq")]
+    assert wq_names, "quantized model must expose wq buffers"
+    import jax.numpy as jnp
+    for n in wq_names:
+        buf[n]._value = jnp.zeros_like(buf[n]._value)
+    out_b, _ = model.generate(ids, max_new_tokens=6)
+    assert len(model._generate_cache) == 1      # same trace both times
+    assert not np.array_equal(out_a.numpy(), out_b.numpy())
+
+
 def test_int8_matvec_kernel_matches_reference():
     """ops/pallas_int8.int8_matvec (interpret mode on CPU): the int8
     head contraction with epilogue scaling matches the dequantized
