@@ -15,11 +15,12 @@ latency-hiding scheduler overlaps the ppermute with compute — the scheduling
 work SectionWorker did by hand.
 """
 import functools
+import warnings
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor, apply
 from ..nn import Layer, LayerList, Sequential
@@ -573,9 +574,71 @@ class PipelineLayer(Layer):
         return self._num_stages - 1
 
 
+def _config_sig(layer, prefix=""):
+    """Recursive scalar-config fingerprint: every int/float/bool/str/None/
+    scalar-tuple attribute of the layer and its sublayers (dropout rate,
+    norm epsilon, activation name, ...). Two same-class blocks whose
+    forwards differ through parameterless config must NOT be stacked and
+    run through one template's forward."""
+    out = []
+    for k in sorted(vars(layer)):
+        if k == "_full_name":        # unique per instance by construction
+            continue
+        v = vars(layer)[k]
+        if isinstance(v, (int, float, bool, str, type(None))):
+            out.append((prefix + k, v))
+        elif isinstance(v, tuple) and all(
+                isinstance(e, (int, float, bool, str, type(None)))
+                for e in v):
+            out.append((prefix + k, v))
+    for n, sub in layer._sub_layers.items():
+        if sub is not None:
+            out.extend(_config_sig(sub, prefix + n + "."))
+    return tuple(out)
+
+
+def _stackable_sig(kind, item):
+    """Homogeneity signature for run detection: type identity + the
+    ordered (name, shape, dtype) parameter tree + the recursive scalar
+    config. Layers with buffers, paramless layers, shared refs, and bare
+    callables are not stackable."""
+    if kind != "layer":
+        return None
+    if any(b is not None for _, b in item.named_buffers()):
+        return None
+    sig = tuple((n, tuple(p.shape), str(p.dtype))
+                for n, p in item.named_parameters())
+    if not sig:
+        return None
+    return (type(item), sig, _config_sig(item))
+
+
 class PipelineParallel(Layer):
-    """Wrapper parity with `meta_parallel/pipeline_parallel.py:30`. The
-    train_batch entry point jits the whole pipelined step."""
+    """Wrapper parity with `meta_parallel/pipeline_parallel.py:30`.
+
+    On a mesh with pp > 1, `train_batch` IS the 1F1B schedule: the
+    PipelineLayer's layer list is auto-partitioned into
+    [front | homogeneous block run | tail] (the analog of the reference's
+    LayerDesc partitioning, `pp_layers.py:63` SegmentLayers), the block
+    run's parameters are STACKED along a leading axis sharded over the
+    `pp` mesh axis, and the batch runs through
+    `pipeline_train_step_1f1b` (warmup/steady/cooldown over `lax.scan` +
+    `lax.ppermute`, O(pp) live activations — `pipeline_parallel.py:80`,
+    `section_worker.cc:143`). Front (embedding side) and tail (final
+    norm / head / loss) differentiate via `jax.vjp` around the pipelined
+    region; a weight tied between front and tail (SharedLayerDesc)
+    accumulates gradient from both paths — the shared-embedding
+    allreduce analog (`pipeline_parallel.py:162`). Without a pp mesh (or
+    when no pp-divisible homogeneous run exists — warned once) the step
+    falls back to sequential gradient accumulation with identical
+    numerics.
+
+    Dropout note: the pipelined step threads one per-step PRNG key,
+    folded per block index, so the backward's recompute-from-saved-input
+    reproduces the forward's masks exactly (the reference preserves RNG
+    state in recompute the same way, `fleet/utils/recompute.py:91`);
+    masks repeat across microbatches within one step.
+    """
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
@@ -586,15 +649,334 @@ class PipelineParallel(Layer):
         if strategy is not None:
             acc = strategy.pipeline_configs.get("accumulate_steps", 1)
         self._num_micro = acc
+        self._pipe_plan = None
+        self._pipe_pp = None
+        self._pipe_step = None
+        self._pipe_step_key = None
+        self._pipe_stack = None
 
     def forward(self, x):
         return self._layers(x)
+
+    # ---- 1F1B wiring ----------------------------------------------------
+
+    def _collect_params(self, items):
+        out = []
+        for kind, item in items:
+            if kind == "layer":
+                out.extend(p for _, p in item.named_parameters())
+            elif kind == "shared":
+                layer = self._layers.shared_layers[item.layer_name]
+                out.extend(p for _, p in layer.named_parameters())
+        seen, res = set(), []
+        for p in out:
+            if id(p) not in seen:
+                seen.add(id(p))
+                res.append(p)
+        return res
+
+    def _plan_pipeline(self, pp):
+        """Find the longest run of consecutive identical-signature layers;
+        stack the largest pp-divisible prefix of it. Leftover run members
+        join the tail (reference: SegmentLayers assigns remainders to
+        stages; here non-stacked layers run on the vjp'd head/tail)."""
+        items = list(self._layers._items)
+        sigs = [_stackable_sig(k, it) for k, it in items]
+        best_start, best_len = 0, 0
+        i = 0
+        while i < len(items):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(items) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        usable = (best_len // pp) * pp
+        if usable < pp or usable < 2:
+            return None
+        front = items[:best_start]
+        blocks = [it for _, it in items[best_start:best_start + usable]]
+        tail = items[best_start + usable:]
+        template = blocks[0]
+        return dict(
+            front=front, blocks=blocks, tail=tail, template=template,
+            template_params=[p for _, p in template.named_parameters()],
+            block_param_rows=[[p for _, p in b.named_parameters()]
+                              for b in blocks],
+            front_params=self._collect_params(front),
+            tail_params=self._collect_params(tail))
+
+    def _build_pipelined_step(self, plan, mesh, n_micro, optimizer=None):
+        """Jit the whole pipelined step. With `optimizer` (fused mode —
+        no scaler/clip), the block-parameter optimizer update runs
+        IN-JIT on the pp-sharded stacked leaves (vmapped over the block
+        axis), so the full block weight set never round-trips through
+        per-layer tensors between steps; front/tail grads return for the
+        eager optimizer. Without, all grads return raw."""
+        from ..core import autograd
+        from ..core.random import rng_guard
+        from ..jit import bind_tensors
+
+        layers = self._layers
+        loss_fn = layers._loss_fn
+        front, tail = plan["front"], plan["tail"]
+        front_params = plan["front_params"]
+        tail_params = plan["tail_params"]
+        template = plan["template"]
+        template_params = plan["template_params"]
+        key_cell = [None]   # per-step PRNG key, set inside the jit trace
+
+        def run_items(items, h):
+            for kind, item in items:
+                if kind == "shared":
+                    layer = layers.shared_layers[item.layer_name]
+                    h = (item.forward_func(layer, h)
+                         if item.forward_func is not None else layer(h))
+                else:
+                    h = item(h)
+            return h
+
+        def front_fn(front_vals, xv):
+            with autograd.fresh_tape(), autograd.no_grad(), \
+                    bind_tensors(front_params, front_vals), \
+                    rng_guard(jax.random.fold_in(key_cell[0], 2 ** 20)):
+                return run_items(front, Tensor(xv))._value
+
+        def stage_fn(stack_vals, h):
+            local = stack_vals[0].shape[0]
+            # fold the GLOBAL block index (stage*local + local idx) into
+            # the dropout key so no two blocks share a mask
+            base = jax.lax.axis_index("pp") * local
+            idx = jnp.arange(local)
+
+            def body(carry, xs):
+                row, li = xs
+                with autograd.fresh_tape(), autograd.no_grad(), \
+                        bind_tensors(template_params, list(row)), \
+                        rng_guard(jax.random.fold_in(key_cell[0],
+                                                     base + li)):
+                    return template(Tensor(carry))._value, None
+            out, _ = jax.lax.scan(body, h, (list(stack_vals), idx))
+            return out
+
+        def head_loss_fn(tail_vals, h, y_mb):
+            with autograd.fresh_tape(), autograd.no_grad(), \
+                    bind_tensors(tail_params, tail_vals), \
+                    rng_guard(jax.random.fold_in(key_cell[0], 2 ** 20 + 1)):
+                out = run_items(tail, Tensor(h))
+                return loss_fn(out, Tensor(y_mb))._value
+
+        rep = NamedSharding(mesh, P())
+        stk = NamedSharding(mesh, P("pp"))
+        n_stack = len(template_params)
+
+        def pipelined_grads(front_vals, stack_vals, tail_vals, xv, yv, rng):
+            key_cell[0] = rng
+            h, front_vjp = jax.vjp(front_fn, front_vals, xv)
+            loss, pg, hg, dx = pipeline_train_step_1f1b(
+                stage_fn, head_loss_fn, stack_vals, tail_vals, h, yv,
+                n_micro, mesh=mesh)
+            gfront = front_vjp(dx)[0]
+            return loss, gfront, pg, hg
+
+        if optimizer is None:
+            in_sh = ([rep] * len(front_params), [stk] * n_stack,
+                     [rep] * len(tail_params), rep, rep, rep)
+            out_sh = (rep, [rep] * len(front_params), [stk] * n_stack,
+                      [rep] * len(tail_params))
+            return jax.jit(pipelined_grads, in_shardings=in_sh,
+                           out_shardings=out_sh)
+
+        def step(front_vals, stack_vals, stack_states, tail_vals, xv, yv,
+                 lr, rng):
+            loss, gfront, pg, hg = pipelined_grads(
+                front_vals, stack_vals, tail_vals, xv, yv, rng)
+            new_vals, new_states = [], []
+            with autograd.no_grad():
+                for j, tp in enumerate(template_params):
+                    if tp.stop_gradient:
+                        new_vals.append(stack_vals[j])
+                        new_states.append(stack_states[j])
+                        continue
+
+                    def upd(pv, gv, st, tp=tp):
+                        nv, ns = optimizer._functional_apply(
+                            [tp], [pv], [gv], [st], lr)
+                        return nv[0], ns[0]
+                    nv, ns = jax.vmap(upd)(stack_vals[j], pg[j],
+                                           stack_states[j])
+                    new_vals.append(nv)
+                    new_states.append(ns)
+            return loss, gfront, hg, new_vals, new_states
+
+        state_sh = [jax.tree_util.tree_map(lambda _: stk, st)
+                    for st in plan["stack_state_tmpl"]]
+        in_sh = ([rep] * len(front_params), [stk] * n_stack, state_sh,
+                 [rep] * len(tail_params), rep, rep, rep, rep)
+        out_sh = (rep, [rep] * len(front_params),
+                  [rep] * len(tail_params), [stk] * n_stack, state_sh)
+        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=(1, 2))
+
+    def _ensure_stacked(self, plan, mesh, optimizer):
+        """Persistent pp-sharded stacked block params + optimizer states.
+        Rebuilt whenever a per-layer tensor or its optimizer state was
+        touched OUTSIDE the fused path (checkpoint load, an eager
+        fallback step, manual mutation) — detected by identity against
+        the views scattered after the last fused step."""
+        rows = plan["block_param_rows"]
+        tps = plan["template_params"]
+        stk = NamedSharding(mesh, P("pp"))
+        cache = self._pipe_stack
+        views = cache.get("views") if cache else None
+        fresh = (
+            cache is None or cache.get("mesh") is not mesh
+            or cache.get("opt") is not optimizer
+            or any(r[j]._value is not views[i][j]
+                   for i, r in enumerate(rows) for j in range(len(tps)))
+            or any(optimizer._states.get(id(r[j])) is not
+                   cache["state_views"][i][j]
+                   for i, r in enumerate(rows) for j in range(len(tps))))
+        if not fresh:
+            return cache
+        vals = [jax.device_put(jnp.stack([r[j]._value for r in rows]), stk)
+                for j in range(len(tps))]
+        states = []
+        for j in range(len(tps)):
+            per = [optimizer._get_state(r[j]) for r in rows]
+            keys = list(per[0].keys())
+            states.append({
+                k: jax.device_put(
+                    jnp.stack([jnp.asarray(s[k]) for s in per]), stk)
+                for k in keys})
+        plan["stack_state_tmpl"] = states
+        cache = {"vals": vals, "states": states, "mesh": mesh,
+                 "opt": optimizer, "views": None, "state_views": None}
+        self._pipe_stack = cache
+        self._scatter_block_views(plan, optimizer, cache)
+        return cache
+
+    def _scatter_block_views(self, plan, optimizer, cache):
+        """Refresh the per-layer tensors (and optimizer states) as lazy
+        device-side slices of the stacked leaves, so state_dict /
+        checkpointing / user reads stay correct; the next fused step
+        reads the stacked cache, not these views."""
+        rows = plan["block_param_rows"]
+        tps = plan["template_params"]
+        views, state_views = [], []
+        for i, r in enumerate(rows):
+            vrow, srow = [], []
+            for j in range(len(tps)):
+                v = cache["vals"][j][i]
+                r[j]._value = v
+                r[j].grad = None
+                st = {k: cache["states"][j][k][i]
+                      for k in cache["states"][j]}
+                optimizer._states[id(r[j])] = st
+                vrow.append(v)
+                srow.append(st)
+            views.append(vrow)
+            state_views.append(srow)
+        cache["views"] = views
+        cache["state_views"] = state_views
+
+    def _train_batch_1f1b(self, plan, mesh, x, y, n_micro, optimizer,
+                          lr_scheduler, scaler):
+        from ..core.random import default_generator
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        fused = (scaler is None or not scaler.is_enable()) and \
+            optimizer._grad_clip is None
+        if scaler is not None and not scaler.is_enable():
+            scaler = None
+        tree_sig = tuple(
+            (tuple(p.shape), str(p.dtype))
+            for p in (plan["front_params"] + plan["template_params"]
+                      + plan["tail_params"]))
+        key = (xv.shape, str(xv.dtype), yv.shape, str(yv.dtype), n_micro,
+               tree_sig, fused, mesh, id(optimizer) if fused else None)
+        rng = default_generator().split()
+
+        grads = {}
+
+        def add(p, g):
+            if p.stop_gradient:
+                return
+            if id(p) in grads:
+                grads[id(p)] = (p, grads[id(p)][1] + g)
+            else:
+                grads[id(p)] = (p, g)
+
+        if fused:
+            cache = self._ensure_stacked(plan, mesh, optimizer)
+            if self._pipe_step is None or self._pipe_step_key != key:
+                self._pipe_step = self._build_pipelined_step(
+                    plan, mesh, n_micro, optimizer=optimizer)
+                self._pipe_step_key = key
+            front_vals = [p._value for p in plan["front_params"]]
+            tail_vals = [p._value for p in plan["tail_params"]]
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            loss, gfront, gtail, new_vals, new_states = self._pipe_step(
+                front_vals, cache["vals"], list(cache["states"]),
+                tail_vals, xv, yv, lr, rng)
+            cache["vals"] = new_vals
+            cache["states"] = new_states
+            self._scatter_block_views(plan, optimizer, cache)
+            for p, g in zip(plan["front_params"], gfront):
+                add(p, g)
+            for p, g in zip(plan["tail_params"], gtail):
+                add(p, g)
+            for p, g in grads.values():
+                p.grad = Tensor(g) if p.grad is None else \
+                    Tensor(p.grad._value + g)
+            optimizer.step()        # block grads are None -> front/tail only
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return Tensor(loss)
+
+        if self._pipe_step is None or self._pipe_step_key != key:
+            self._pipe_step = self._build_pipelined_step(plan, mesh, n_micro)
+            self._pipe_step_key = key
+        front_vals = [p._value for p in plan["front_params"]]
+        tail_vals = [p._value for p in plan["tail_params"]]
+        rows = plan["block_param_rows"]
+        stack_vals = [jnp.stack([r[j]._value for r in rows])
+                      for j in range(len(plan["template_params"]))]
+        loss, gfront, gstack, gtail = self._pipe_step(
+            front_vals, stack_vals, tail_vals, xv, yv, rng)
+        for p, g in zip(plan["front_params"], gfront):
+            add(p, g)
+        for i, row in enumerate(rows):
+            for j, p in enumerate(row):
+                add(p, gstack[j][i])
+        for p, g in zip(plan["tail_params"], gtail):
+            add(p, g)
+        scale = scaler._scale if scaler is not None else None
+        for p, g in grads.values():
+            if scale is not None:
+                g = g * scale
+            p.grad = Tensor(g) if p.grad is None else \
+                Tensor(p.grad._value + g)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Gradient-accumulated microbatch step (reference
         `pipeline_parallel.py:80` train_batch semantics: the global batch is
         split into `accumulate_steps` microbatches, grads accumulate across
-        them, one optimizer step at the end)."""
+        them, one optimizer step at the end). On a pp>1 mesh the step runs
+        the 1F1B pp-sharded executor (see class docstring)."""
         x, y = data
         loss_fn = self._layers._loss_fn
         if loss_fn is None:
@@ -606,6 +988,24 @@ class PipelineParallel(Layer):
         if bsz % n_micro != 0:
             raise ValueError(f"batch size {bsz} not divisible by "
                              f"accumulate_steps {n_micro}")
+        mesh = env.current_mesh()
+        pp = (mesh.shape["pp"]
+              if mesh is not None and "pp" in mesh.axis_names else 1)
+        if pp > 1:
+            if self._pipe_plan is None or self._pipe_pp != (pp, mesh):
+                self._pipe_plan = self._plan_pipeline(pp) or "none"
+                self._pipe_pp = (pp, mesh)
+                if self._pipe_plan == "none":
+                    warnings.warn(
+                        f"PipelineParallel: mesh has pp={pp} but the "
+                        "PipelineLayer has no run of >= pp consecutive "
+                        "identical-architecture layers to pipeline; "
+                        "train_batch runs SEQUENTIAL gradient accumulation "
+                        "on every device (no pipeline parallelism)")
+            if self._pipe_plan != "none":
+                return self._train_batch_1f1b(
+                    self._pipe_plan, mesh, x, y, n_micro, optimizer,
+                    lr_scheduler, scaler)
         mb = bsz // n_micro
         total = None
         for i in range(n_micro):

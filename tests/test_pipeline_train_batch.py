@@ -1,0 +1,374 @@
+"""PipelineParallel.train_batch -> real 1F1B pp-sharded executor.
+
+Reference: `fleet/meta_parallel/pipeline_parallel.py:80-160` — there,
+PipelineLayer + train_batch IS the 1F1B schedule for arbitrary LayerDesc
+lists. Here the wrapper auto-detects the homogeneous block run, stacks
+its params pp-sharded, and drives `pipeline_train_step_1f1b`; these tests
+pin (a) numerics == sequential accumulation, (b) the compiled program is
+actually pipelined (collective-permute present, per-device arg bytes ~
+total/pp), (c) tied front/tail weights (SharedLayerDesc) accumulate grads
+from both paths, (d) the no-run fallback warns instead of silently not
+pipelining.
+"""
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed.pipeline import LayerDesc, SharedLayerDesc
+from paddle_tpu.nn import functional as F
+
+PP = 4
+V, D, L = 64, 32, PP * 2
+
+
+class Embed(nn.Layer):
+    def __init__(self, vocab, d):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, d)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class Block(nn.Layer):
+    def __init__(self, d, dropout=0.0):
+        super().__init__()
+        self.ln = nn.LayerNorm(d)
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return x + self.drop(self.fc2(F.gelu(self.fc1(self.ln(x)))))
+
+
+class Head(nn.Layer):
+    def __init__(self, d, vocab):
+        super().__init__()
+        self.ln = nn.LayerNorm(d)
+        self.proj = nn.Linear(d, vocab)
+
+    def forward(self, x):
+        return self.proj(self.ln(x))
+
+
+def _ce(out, y):
+    vocab = out.shape[-1]
+    return F.cross_entropy(paddle.reshape(out, [-1, vocab]),
+                           paddle.reshape(y, [-1]))
+
+
+def _descs(dropout=0.0):
+    return ([LayerDesc(Embed, V, D)]
+            + [LayerDesc(Block, D, dropout=dropout) for _ in range(L)]
+            + [LayerDesc(Head, D, V)])
+
+
+def _build(seed=7, dropout=0.0, num_stages=PP):
+    paddle.seed(seed)
+    return dist.PipelineLayer(_descs(dropout), num_stages=num_stages,
+                              loss_fn=_ce)
+
+
+def _data(n_micro=4, mb=2, seed=0, seq=8):
+    rs = np.random.RandomState(seed)
+    B = n_micro * mb
+    return (paddle.to_tensor(rs.randint(0, V, (B, seq)), "int32"),
+            paddle.to_tensor(rs.randint(0, V, (B, seq)), "int64"))
+
+
+@pytest.fixture()
+def mesh():
+    m = dist.build_mesh(pp=PP, devices=jax.devices()[:PP])
+    yield m
+    dist_env.clear_mesh()
+
+
+def _strategy(n_micro):
+    s = dist.DistributedStrategy()
+    s.pipeline_configs = {"accumulate_steps": n_micro}
+    return s
+
+
+def test_train_batch_matches_sequential_accumulation(mesh):
+    n_micro = 4
+    x, y = _data(n_micro)
+
+    # reference trajectory: sequential grad accumulation, no mesh
+    dist_env.clear_mesh()
+    m_ref = _build()
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m_ref.parameters())
+    loss_ref = pp_ref.train_batch((x, y), opt_ref)
+
+    dist_env.set_mesh(mesh)
+    m_pp = _build()
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt_pp = paddle.optimizer.SGD(learning_rate=0.1,
+                                  parameters=m_pp.parameters())
+    loss_pp = pp_mod.train_batch((x, y), opt_pp)
+
+    # the plan must have found the block run (front=Embed, tail=Head)
+    plan = pp_mod._pipe_plan
+    assert plan != "none" and len(plan["blocks"]) == L
+    assert np.allclose(float(loss_pp.item()), float(loss_ref.item()),
+                       rtol=1e-4), (loss_pp.item(), loss_ref.item())
+    for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(),
+                                  m_pp.named_parameters()):
+        assert n1 == n2
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=2e-5, err_msg=n1)
+
+
+def test_train_batch_program_is_pipelined(mesh):
+    """The VERDICT r3 gate: compiled step must contain a pp
+    collective-permute AND its per-device parameter bytes must be ~
+    front+tail (replicated) + stacked/pp — i.e. the blocks really are
+    sharded over stages, not replicated everywhere."""
+    n_micro = 4
+    x, y = _data(n_micro)
+    m_pp = _build()
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m_pp.parameters())
+    pp_mod.train_batch((x, y), opt)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    plan = pp_mod._pipe_plan
+    cache = pp_mod._pipe_stack
+    # fused mode: block params + opt states live PERSISTENTLY pp-sharded
+    assert cache is not None
+    for v in cache["vals"]:
+        assert v.sharding.spec == P("pp"), v.sharding
+    front_vals = [jax.device_put(p._value, rep)
+                  for p in plan["front_params"]]
+    tail_vals = [jax.device_put(p._value, rep)
+                 for p in plan["tail_params"]]
+    rng = jax.device_put(jax.random.PRNGKey(0), rep)
+    lr = jax.device_put(jnp.asarray(0.1, jnp.float32), rep)
+    lowered = pp_mod._pipe_step.lower(
+        front_vals, cache["vals"], list(cache["states"]), tail_vals,
+        jax.device_put(x._value, rep), jax.device_put(y._value, rep),
+        lr, rng)
+    hlo = lowered.compile().as_text()
+    assert "collective-permute" in hlo
+
+    bytes_of = lambda vs: sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                              for v in vs)  # noqa: E731
+    stacked_b = bytes_of(cache["vals"]) + sum(
+        bytes_of(list(st.values())) for st in cache["states"])
+    repl_b = bytes_of(front_vals) + bytes_of(tail_vals)
+    data_b = (bytes_of([x._value, y._value]) + 8 * 3 + 64)
+    arg_b = lowered.compile().memory_analysis().argument_size_in_bytes
+    expected = repl_b + stacked_b // PP + data_b
+    full = repl_b + stacked_b + data_b
+    # per-device args must be near the sharded size, far below replicated
+    assert arg_b < expected * 1.25, (arg_b, expected, full)
+    assert arg_b < 0.6 * full, (arg_b, full)
+
+
+def test_train_batch_tied_embedding_head(mesh):
+    """SharedLayerDesc ties the embedding table to the head projection;
+    its grad must accumulate from BOTH the front (lookup) and tail
+    (projection) paths — the shared-embedding allreduce analog
+    (`pipeline_parallel.py:162`)."""
+    n_micro = 4
+
+    def tied_head(layer, h):
+        return paddle.matmul(h, layer.weight, transpose_y=True)
+
+    def build():
+        paddle.seed(11)
+        descs = ([SharedLayerDesc("emb", nn.Embedding, None, "weight",
+                                  V, D)]
+                 + [LayerDesc(Block, D) for _ in range(L)]
+                 + [SharedLayerDesc("emb", nn.Embedding, tied_head,
+                                    "weight", V, D)])
+        return dist.PipelineLayer(descs, num_stages=PP, loss_fn=_ce)
+
+    x, y = _data(n_micro, seed=3)
+
+    dist_env.clear_mesh()
+    m_ref = build()
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m_ref.parameters())
+    loss_ref = pp_ref.train_batch((x, y), opt_ref)
+
+    dist_env.set_mesh(mesh)
+    m_pp = build()
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt_pp = paddle.optimizer.SGD(learning_rate=0.1,
+                                  parameters=m_pp.parameters())
+    loss_pp = pp_mod.train_batch((x, y), opt_pp)
+
+    plan = pp_mod._pipe_plan
+    assert plan != "none" and len(plan["blocks"]) == L
+    # tied table present in BOTH front and tail param sets
+    fp = {id(p) for p in plan["front_params"]}
+    tp = {id(p) for p in plan["tail_params"]}
+    assert fp & tp, "tied weight must appear in front AND tail params"
+    assert np.allclose(float(loss_pp.item()), float(loss_ref.item()),
+                       rtol=1e-4)
+    for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(),
+                                  m_pp.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=2e-5, err_msg=n1)
+
+
+def test_train_batch_dropout_smoke(mesh):
+    """Dropout > 0 through the pipelined step: the recompute-based
+    backward must see the same masks as the forward (per-step key folded
+    per block) — loss finite, params move, no NaN."""
+    n_micro = 4
+    x, y = _data(n_micro, seed=5)
+    m_pp = _build(dropout=0.2)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m_pp.parameters())
+    before = [p.numpy().copy() for p in m_pp.parameters()]
+    loss = pp_mod.train_batch((x, y), opt)
+    assert np.isfinite(float(loss.item()))
+    after = [p.numpy() for p in m_pp.parameters()]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    assert all(np.all(np.isfinite(a)) for a in after)
+
+
+def test_train_batch_scaler_path(mesh):
+    n_micro = 4
+    x, y = _data(n_micro, seed=6)
+
+    dist_env.clear_mesh()
+    m_ref = _build(seed=13)
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m_ref.parameters())
+    pp_ref.train_batch((x, y), opt_ref,
+                       scaler=paddle.amp.GradScaler(
+                           init_loss_scaling=1024.0))
+
+    dist_env.set_mesh(mesh)
+    m_pp = _build(seed=13)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt_pp = paddle.optimizer.SGD(learning_rate=0.1,
+                                  parameters=m_pp.parameters())
+    pp_mod.train_batch((x, y), opt_pp,
+                       scaler=paddle.amp.GradScaler(
+                           init_loss_scaling=1024.0))
+    for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(),
+                                  m_pp.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=2e-5, err_msg=n1)
+
+
+def test_train_batch_multi_step_matches_sequential(mesh):
+    """Several fused Adam steps: the persistent stacked params/opt-states
+    must track the per-layer tensors exactly across steps (moments,
+    beta powers, weight decay) — and state_dict views must round-trip."""
+    n_micro = 4
+    steps = 3
+
+    dist_env.clear_mesh()
+    m_ref = _build(seed=21)
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_ref = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m_ref.parameters())
+    for s in range(steps):
+        x, y = _data(n_micro, seed=100 + s)
+        pp_ref.train_batch((x, y), opt_ref)
+
+    dist_env.set_mesh(mesh)
+    m_pp = _build(seed=21)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt_pp = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                    parameters=m_pp.parameters())
+    for s in range(steps):
+        x, y = _data(n_micro, seed=100 + s)
+        pp_mod.train_batch((x, y), opt_pp)
+
+    for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(),
+                                  m_pp.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=2e-4,
+                                   atol=5e-5, err_msg=n1)
+    # optimizer state views: matching moments (param auto-names differ
+    # between the two builds — translate via the param correspondence)
+    sd_ref = opt_ref.state_dict()
+    sd_pp = opt_pp.state_dict()
+    name_map = {p1.name: p2.name
+                for (_, p1), (_, p2) in zip(m_ref.named_parameters(),
+                                            m_pp.named_parameters())}
+    checked = 0
+    for k, v in sd_ref.items():
+        for ref_name, pp_name in name_map.items():
+            if k.startswith(ref_name + "_"):
+                k2 = pp_name + k[len(ref_name):]
+                assert k2 in sd_pp, k2
+                if "moment1" in k and checked < 4:
+                    np.testing.assert_allclose(
+                        np.asarray(v.numpy()),
+                        np.asarray(sd_pp[k2].numpy()),
+                        rtol=2e-3, atol=1e-4, err_msg=k)
+                    checked += 1
+                break
+    assert checked == 4
+
+
+def test_train_batch_detects_external_param_mutation(mesh):
+    """Mutating a block param outside the fused path (checkpoint load,
+    manual set) must invalidate the persistent stack — not silently train
+    on stale weights."""
+    n_micro = 4
+    x, y = _data(n_micro, seed=8)
+    m_pp = _build(seed=31)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt = paddle.optimizer.SGD(learning_rate=0.0,  # lr 0: loss is pure fwd
+                               parameters=m_pp.parameters())
+    l0 = float(pp_mod.train_batch((x, y), opt).item())
+    l1 = float(pp_mod.train_batch((x, y), opt).item())
+    assert abs(l0 - l1) < 1e-6      # lr=0: nothing moved
+    # zero one block's fc1 weight out-of-band
+    blk = pp_mod._pipe_plan["blocks"][0]
+    blk.fc1.weight.set_value(np.zeros(blk.fc1.weight.shape,
+                                      dtype=np.float32))
+    l2 = float(pp_mod.train_batch((x, y), opt).item())
+    assert abs(l2 - l0) > 1e-4, (l0, l2)
+
+
+def test_stackable_sig_rejects_config_mismatch(mesh):
+    """Same class, same param tree, different parameterless config
+    (dropout rate): must NOT be treated as one homogeneous run."""
+    from paddle_tpu.distributed.pipeline import _stackable_sig
+    a = Block(D, dropout=0.0)
+    b = Block(D, dropout=0.2)
+    assert _stackable_sig("layer", a) != _stackable_sig("layer", b)
+    c = Block(D, dropout=0.0)
+    assert _stackable_sig("layer", a) == _stackable_sig("layer", c)
+
+
+def test_train_batch_warns_when_not_pipelineable(mesh):
+    """A PipelineLayer with no >=pp homogeneous run must WARN (not
+    silently skip pipelining) and still train correctly."""
+    paddle.seed(1)
+    pl = dist.PipelineLayer(
+        [nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2)],
+        num_stages=PP, loss_fn=lambda out, y: F.cross_entropy(out, y))
+    pp_mod = dist.PipelineParallel(pl, strategy=_strategy(2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=pl.parameters())
+    x = paddle.randn([8, 4])
+    y = paddle.randint(0, 2, [8])
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        loss = pp_mod.train_batch((x, y), opt)
+    assert any("no run" in str(w.message) or "SEQUENTIAL" in str(w.message)
+               for w in rec)
+    assert np.isfinite(float(loss.item()))
