@@ -157,8 +157,31 @@ def main():
     loss, n_params, sec_per_step = r["loss"], r["n_params"], r["sec_per_step"]
     peak = _peak_flops(dev.device_kind) if on_tpu else None
 
-    resnet = bench_resnet50(on_tpu, peak)
-    layer13 = bench_gpt1_3b_layer(on_tpu, peak)
+    def phase(fn, *args, **fallback):
+        """One bench phase; a failure yields the fallback keys (zeros)
+        plus an error note instead of killing the whole bench line."""
+        try:
+            return fn(*args)
+        except Exception as e:
+            print(f"# phase {fn.__name__} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            out = dict(fallback)
+            out["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            return out
+
+    resnet = phase(bench_resnet50, on_tpu, peak,
+                   images_per_sec=0.0, mfu=0.0,
+                   pipelined_images_per_sec=0.0,
+                   loader_images_per_sec=0.0)
+    layer13 = phase(bench_gpt1_3b_layer, on_tpu, peak,
+                    tokens_per_sec=0.0, mfu=0.0)
+    full13 = phase(bench_gpt1_3b_full, on_tpu, peak,
+                   tokens_per_sec=0.0, mfu=0.0, n_params=0)
+    decode = phase(bench_decode_wo8, on_tpu,
+                   bf16_tokens_per_sec=0.0, wo8_tokens_per_sec=0.0,
+                   speedup=0.0)
+    bert = phase(bench_bert, on_tpu, tokens_per_sec=0.0)
+    attn16k = phase(bench_attn_16k, on_tpu, ms=0.0, tflops=0.0)
 
     print(json.dumps({
         "metric": "gpt3_125m_train_tokens_per_sec_per_chip",
@@ -167,13 +190,32 @@ def main():
         "vs_baseline": round(mfu / 0.40, 4),
         "resnet50_images_per_sec_per_chip": resnet["images_per_sec"],
         "resnet50_mfu": resnet["mfu"],
+        "resnet50_pipelined_images_per_sec":
+            resnet["pipelined_images_per_sec"],
+        "resnet50_loader_images_per_sec":
+            resnet["loader_images_per_sec"],
         "gpt1_3b_layer_tokens_per_sec": layer13["tokens_per_sec"],
         "gpt1_3b_layer_mfu": layer13["mfu"],
+        "gpt1_3b_full_tokens_per_sec": full13["tokens_per_sec"],
+        "gpt1_3b_full_mfu": full13["mfu"],
+        "gpt1_3b_full_params": full13["n_params"],
+        "decode_bf16_tokens_per_sec": decode["bf16_tokens_per_sec"],
+        "decode_wo8_tokens_per_sec": decode["wo8_tokens_per_sec"],
+        "decode_wo8_speedup": decode["speedup"],
+        "bert_base_train_tokens_per_sec": bert["tokens_per_sec"],
+        "attn_16k_fwd_bwd_ms": attn16k["ms"],
+        "attn_16k_tflops": attn16k["tflops"],
     }))
     print(f"# device={dev.device_kind} loss={loss.item():.4f} "
           f"mfu={mfu:.3f} params={n_params/1e6:.1f}M "
           f"step={sec_per_step*1000:.1f}ms "
-          f"resnet50={resnet['images_per_sec']:.0f}img/s",
+          f"resnet50={resnet['images_per_sec']:.0f}img/s "
+          f"1.3b-full={full13['tokens_per_sec']:.0f}tok/s "
+          f"mfu={full13['mfu']:.3f} "
+          f"decode={decode['bf16_tokens_per_sec']:.0f}/"
+          f"{decode['wo8_tokens_per_sec']:.0f}tok/s "
+          f"bert={bert['tokens_per_sec']:.0f}tok/s "
+          f"attn16k={attn16k['ms']:.1f}ms",
           file=sys.stderr)
 
 
@@ -247,7 +289,95 @@ def bench_resnet50(on_tpu, peak):
     sec_per_step, _ = _time_train_steps(step, (x, y), steps, warmup)
     ips = batch / sec_per_step
     mfu = (ips * 3 * 4.089e9 / peak) if peak else 0.0
-    return {"images_per_sec": round(ips, 1), "mfu": round(mfu, 4)}
+
+    piped, loader_ips = _resnet_pipelined(model, opt, on_tpu, batch,
+                                          steps, warmup)
+    return {"images_per_sec": round(ips, 1), "mfu": round(mfu, 4),
+            "pipelined_images_per_sec": piped,
+            "loader_images_per_sec": loader_ips}
+
+
+def _resnet_pipelined(model, opt, on_tpu, batch, steps, warmup):
+    """images/sec with the HOST INPUT PIPELINE in the measured loop
+    (VERDICT r3: the synthetic number overstates a real epoch): a
+    DataLoader with worker processes runs the per-sample CPU transform
+    (crop + flip on uint8), batches ship to the device as uint8 (4x
+    fewer H2D bytes than f32 — the BufferedReader/ptio recipe), and
+    normalization runs ON DEVICE inside the compiled step."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.io import DataLoader, Dataset
+
+    rs = np.random.RandomState(1)
+    # one epoch must cover the loader-rate probe + warmup + timed steps
+    # + slack, or the timed window pays iterator re-creation (worker
+    # process respawn)
+    n_items = batch * (steps + warmup + 8)
+    raw = rs.randint(0, 256, (n_items, 3, 256, 256), dtype=np.uint8)
+    labels = rs.randint(0, 1000, (n_items,)).astype(np.int32)
+
+    class _Synth(Dataset):
+        def __len__(self):
+            return n_items
+
+        def __getitem__(self, i):
+            img = raw[i]
+            # the representative CPU work: random crop + flip on uint8
+            rr = np.random.RandomState(i)
+            top, left = rr.randint(0, 32), rr.randint(0, 32)
+            img = img[:, top:top + 224, left:left + 224]
+            if rr.rand() < 0.5:
+                img = img[:, :, ::-1]
+            return np.ascontiguousarray(img), labels[i]
+
+    mean = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
+    std = np.array([0.229, 0.224, 0.225], np.float32) * 255.0
+
+    def loss_fn(x8, y):
+        # device-side normalize: uint8 -> f32 -> (x-mean)/std
+        xf = (x8.astype("float32")
+              - paddle.to_tensor(mean.reshape(1, 3, 1, 1))) \
+            / paddle.to_tensor(std.reshape(1, 3, 1, 1))
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return F.cross_entropy(model(xf), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    loader = DataLoader(_Synth(), batch_size=batch, shuffle=False,
+                        num_workers=2 if on_tpu else 0, drop_last=True)
+    it = iter(loader)   # workers spawn ONCE, before any timing
+
+    # host-transform-only rate: how fast the worker pipeline PRODUCES
+    # batches, independent of H2D. Under the dev tunnel the H2D hop is
+    # ~13 MB/s and dominates the end-to-end pipelined number; on real
+    # hardware (local PCIe) the pipeline bound is min(this, compute).
+    t0 = time.perf_counter()
+    k_loader = min(6, steps)
+    for _ in range(k_loader):
+        next(it)
+    loader_ips = round(batch * k_loader /
+                       max(1e-9, time.perf_counter() - t0), 1)
+
+    def run(k):
+        nonlocal it
+        loss = None
+        for _ in range(k):
+            try:
+                bx, by = next(it)
+            except StopIteration:
+                it = iter(loader)
+                bx, by = next(it)
+            loss = step(bx, by)
+        return loss
+
+    loss = run(warmup)
+    float(loss.item())
+    fetch = _fetch_latency(lambda: float(loss.item()))
+    t0 = time.perf_counter()
+    loss = run(steps)
+    float(loss.item())
+    dt = max(1e-9, time.perf_counter() - t0 - fetch)
+    return round(batch * steps / dt, 1), loader_ips
 
 
 def bench_gpt1_3b_layer(on_tpu, peak):
@@ -290,6 +420,221 @@ def bench_gpt1_3b_layer(on_tpu, peak):
     mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
     return {"tokens_per_sec": round(tokens_per_sec, 1),
             "mfu": round(mfu, 4)}
+
+
+def bench_gpt1_3b_full(on_tpu, peak):
+    """FULL GPT-1.3B — 24 layers at TRUE dims (hidden 2048, ffn 8192,
+    vocab 50304) — fwd+bwd+AdamW end-to-end on ONE chip. This is the
+    model-level north-star measurement (BASELINE.md: >=40% MFU), not the
+    single-layer extrapolation: bf16 device params with the f32
+    master+moments in pinned HOST memory (OffloadTrainStep — the
+    reference's optimizer-state CPU offload, sharding/offload_helper.py),
+    per-block remat, fused linear+CE head, flash attention. K micro-steps
+    accumulate grads; the chunked optimizer update streams states
+    through HBM. Timed over full accumulation rounds INCLUDING the
+    update, synced by fetching a last-chunk param element (the updates
+    are the final dispatches on the stream)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.flags import set_flags, get_flag
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    if on_tpu:
+        cfg = GPTConfig.gpt3_1_3b(max_seq_len=2048, dropout=0.0,
+                                  attn_dropout=0.0, remat=True)
+        # micro-batch 16 fits with remat (measured; per-micro MFU 0.585);
+        # K=8 accumulation -> 262k-token global batch (GPT-3 1.3B trains
+        # at ~1M, so this is conservative); warm=2 FULL rounds: round 0
+        # compiles micro+update, round 1 still pays donation rebinding
+        # (measured 59/33/12.4 s for rounds 0/1/2 at K=4 — steady state
+        # from round 2)
+        batch, seq, K, rounds, warm = 16, 2048, 8, 2, 2
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=3,
+                        num_heads=4, max_seq_len=128, dropout=0.0,
+                        use_flash_attention=False, remat=True)
+        batch, seq, K, rounds, warm = 2, 128, 2, 1, 1
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+
+    old_fused = get_flag("use_fused_ce")
+    set_flags({"use_fused_ce": on_tpu})  # never materialize [B*S, V]
+    try:
+        def loss_fn(ids, labels):
+            with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+                return model.loss(ids, labels)
+
+        step = dist.OffloadTrainStep(
+            model, loss_fn, opt, accumulate_steps=K,
+            param_dtype="bfloat16" if on_tpu else None)
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+        lbl = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+
+        def sync():
+            # last dispatch of a round is the FINAL chunk update; fetch
+            # one element of its first param to force the whole stream
+            p = step.params[step._chunks[-1][0]]
+            return float(jnp.asarray(
+                p._value.ravel()[0], jnp.float32))
+
+        for _ in range(warm * K):
+            loss = step(ids, lbl)
+        sync()
+        fetch_latency = _fetch_latency(sync)
+        t0 = time.perf_counter()
+        for _ in range(rounds * K):
+            loss = step(ids, lbl)
+        final_loss = float(loss.item())
+        sync()
+        dt = max(1e-9, time.perf_counter() - t0 - fetch_latency)
+        sec_per_round = dt / rounds
+        tokens_per_sec = K * batch * seq / sec_per_round
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        flops_per_token = (6 * n_params
+                           + 12 * cfg.num_layers * cfg.hidden_size * seq)
+        mfu = (tokens_per_sec * flops_per_token / peak) if peak else 0.0
+        if not np.isfinite(final_loss):
+            return {"tokens_per_sec": 0.0, "mfu": 0.0,
+                    "n_params": n_params, "error": "non-finite loss"}
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "mfu": round(mfu, 4), "n_params": n_params}
+    finally:
+        set_flags({"use_fused_ce": old_fused})
+
+
+def bench_decode_wo8(on_tpu):
+    """GPT-125M greedy KV-cache decode, bf16 baseline then weight-only
+    int8 (W8A16 serving recipe, quant/wo8.py) on the SAME model — the
+    driver-certified form of the bench_extra decode rows (VERDICT r3
+    task 3). Decode is weight-bandwidth bound, so int8 storage is the
+    headline serving lever."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.quant import quantize_weights_int8
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
+        B, prompt_len, new, reps = 8, 128, 128, 3
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0,
+                        use_flash_attention=False)
+        B, prompt_len, new, reps = 2, 16, 16, 1
+    model = GPTForPretraining(cfg)
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rs.randint(0, cfg.vocab_size, (B, prompt_len)), "int32")
+
+    def timed():
+        out, _ = model.generate(ids, max_new_tokens=new)   # compile
+        float(out.sum().item())
+        fetch = _fetch_latency(lambda: float(out.sum().item()))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out, _ = model.generate(ids, max_new_tokens=new)
+        float(out.sum().item())
+        dt = max(1e-9, time.perf_counter() - t0 - fetch)
+        return B * new * reps / dt
+
+    bf16_tps = timed()
+    quantize_weights_int8(model)
+    wo8_tps = timed()
+    return {"bf16_tokens_per_sec": round(bf16_tps, 1),
+            "wo8_tokens_per_sec": round(wo8_tps, 1),
+            "speedup": round(wo8_tps / max(bf16_tps, 1e-9), 3)}
+
+
+def bench_bert(on_tpu):
+    """BERT-base fwd+bwd+AdamW tokens/sec/chip (BASELINE.md config 3's
+    encoder family), driver-certified (VERDICT r3 task 3)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.bert import BertConfig, \
+        BertForSequenceClassification
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig(hidden_dropout=0.0, attn_dropout=0.0)  # 12L/768
+        B, S, steps, warmup = 32, 512, 15, 3
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, hidden_dropout=0.0, attn_dropout=0.0)
+        B, S, steps, warmup = 2, 32, 2, 1
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    opt = optimizer.AdamW(learning_rate=2e-5,
+                          parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (B, S)), "int32")
+    lbl = paddle.to_tensor(rs.randint(0, 2, (B,)), "int32")
+
+    def loss_fn(i, y):
+        with amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            return F.cross_entropy(model(i), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    sec_per_step, _ = _time_train_steps(step, (ids, lbl), steps, warmup)
+    return {"tokens_per_sec": round(B * S / sec_per_step, 1)}
+
+
+def bench_attn_16k(on_tpu):
+    """Causal flash-attention fwd+bwd at 16k sequence on one chip — the
+    long-context single-chip number (ring/Ulysses shard longer sequences
+    across chips), driver-certified (VERDICT r3 task 3). Chains reps
+    inside one program and uses a two-point (t(3K)-t(K)) measurement so
+    tunnel dispatch overhead cancels."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    rs = np.random.RandomState(0)
+    if on_tpu:
+        S, B, H, D, reps, K = 16384, 1, 12, 64, 8, 4
+    else:
+        S, B, H, D, reps, K = 512, 1, 4, 32, 2, 1
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+
+    def f(x):
+        o = scaled_dot_product_attention(x, x, x, is_causal=True)._value
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def multi(qv):
+        def body(i, x):
+            g = jax.grad(f)(x)
+            g32 = g.astype(jnp.float32)
+            n = jax.lax.rsqrt(jnp.mean(g32 * g32) + 1e-9)
+            return (g32 * n).astype(x.dtype)
+        return jax.lax.fori_loop(0, reps, body, qv)
+
+    o = multi(q)
+    float(jnp.sum(o.astype(jnp.float32)).item())
+
+    state = [o]
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state[0] = multi(state[0])
+        float(jnp.sum(state[0].astype(jnp.float32)).item())
+        return time.perf_counter() - t0
+
+    t1 = run(K)
+    t2 = run(3 * K)
+    dt = max(1e-9, (t2 - t1) / (2 * K * reps))
+    flops = 3 * 2 * B * H * S * S * D   # causal train ~ 3x fwd
+    return {"ms": round(dt * 1000, 1),
+            "tflops": round(flops / dt / 1e12, 1)}
 
 
 if __name__ == "__main__":

@@ -23,33 +23,15 @@ def _fetch_latency(sync):
 
 
 def bench_decode():
-    """GPT-125M greedy decode tokens/sec (KV-cache incremental path —
-    the VERDICT round-1 'tokens/sec decode bench' item)."""
-    import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-
-    paddle.seed(0)
-    cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
-    model = GPTForPretraining(cfg)
-    rs = np.random.RandomState(0)
-    B, prompt_len, new = 8, 128, 128
-    ids = paddle.to_tensor(
-        rs.randint(0, cfg.vocab_size, (B, prompt_len)), "int32")
-
-    out, _scores = model.generate(ids, max_new_tokens=new)   # compile
-    _sync(out.sum())
-    fetch = _fetch_latency(lambda: _sync(out.sum()))
-
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out, _scores = model.generate(ids, max_new_tokens=new)
-    _sync(out.sum())
-    dt = max(1e-9, time.perf_counter() - t0 - fetch)
-    tps = B * new * reps / dt
+    """GPT-125M greedy decode, bf16 + W8A16 — now driver-certified in
+    bench.py (bench_decode_wo8); this wrapper keeps the manual tool."""
+    import jax
+    from bench import bench_decode_wo8
+    r = bench_decode_wo8(jax.default_backend() == "tpu")
     return {"metric": "gpt3_125m_greedy_decode_tokens_per_sec",
-            "value": round(tps, 1), "unit": "tokens/sec",
-            "batch": B, "prompt": prompt_len, "new_tokens": new}
+            "value": r["bf16_tokens_per_sec"], "unit": "tokens/sec",
+            "wo8_tokens_per_sec": r["wo8_tokens_per_sec"],
+            "wo8_speedup": r["speedup"]}
 
 
 def bench_gpt350m():
@@ -72,95 +54,23 @@ def bench_gpt350m():
 
 
 def bench_bert():
-    """BERT-base fwd+bwd+AdamW tokens/sec (the round-1 'BERT never
-    timed' gap)."""
-    import paddle_tpu as paddle
-    from paddle_tpu import amp, optimizer
-    from paddle_tpu.models.bert import BertConfig, \
-        BertForSequenceClassification
-
-    paddle.seed(0)
-    # dropout off: same dropout-free basis as the GPT/ResNet rows
-    cfg = BertConfig(hidden_dropout=0.0, attn_dropout=0.0)  # base 12L/768
-    model = BertForSequenceClassification(cfg, num_classes=2)
-    opt = optimizer.AdamW(learning_rate=2e-5,
-                          parameters=model.parameters())
-    B, S = 32, 512
-    rs = np.random.RandomState(0)
-    ids = paddle.to_tensor(
-        rs.randint(0, cfg.vocab_size, (B, S)), "int32")
-    lbl = paddle.to_tensor(rs.randint(0, 2, (B,)), "int32")
-
-    import paddle_tpu.nn.functional as F
-
-    def loss_fn(i, y):
-        with amp.auto_cast(enable=True, dtype="bfloat16"):
-            return F.cross_entropy(model(i), y)
-
-    step = paddle.jit.TrainStep(model, loss_fn, opt)
-    from bench import _time_train_steps
-    sec_per_step, _ = _time_train_steps(step, (ids, lbl), steps=15,
-                                        warmup=3)
+    """BERT-base train step — now driver-certified in bench.py."""
+    import jax
+    from bench import bench_bert as impl
+    r = impl(jax.default_backend() == "tpu")
     return {"metric": "bert_base_train_tokens_per_sec_per_chip",
-            "value": round(B * S / sec_per_step, 1), "unit": "tokens/sec",
-            "batch": B, "seq": S}
+            "value": r["tokens_per_sec"], "unit": "tokens/sec"}
 
 
 def bench_long_context():
-    """Flash-attention fwd+bwd at long sequence lengths — the
-    long-context single-chip story (ring/Ulysses shard this across
-    chips; see tests/test_ring_attention.py for the multi-chip path)."""
+    """Flash-attention fwd+bwd at 16k — now driver-certified in bench.py
+    (bench_attn_16k); ring/Ulysses shard longer sequences across chips
+    (tests/test_ring_attention.py)."""
     import jax
-    import jax.numpy as jnp
-    from paddle_tpu.ops.attention import scaled_dot_product_attention
-
-    rs = np.random.RandomState(0)
-    rows = []
-    reps = 8
-    for S in (4096, 8192, 16384):
-        B, H, D = 1, 12, 64
-        q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
-
-        def f(x):
-            o = scaled_dot_product_attention(x, x, x,
-                                             is_causal=True)._value
-            return jnp.sum(o.astype(jnp.float32) ** 2)
-
-        @jax.jit
-        def multi(qv):
-            # chain reps iterations inside ONE program (per-dispatch
-            # overhead under the tunnel swamps a single fwd+bwd);
-            # renormalize so the chained grads neither vanish nor blow up
-            def body(i, x):
-                g = jax.grad(f)(x)
-                g32 = g.astype(jnp.float32)
-                n = jax.lax.rsqrt(jnp.mean(g32 * g32) + 1e-9)
-                return (g32 * n).astype(x.dtype)
-            return jax.lax.fori_loop(0, reps, body, qv)
-
-        o = multi(q)
-        float(jnp.sum(o.astype(jnp.float32)).item())
-
-        def run(k):
-            nonlocal o
-            t0 = time.perf_counter()
-            for _ in range(k):
-                o = multi(o)
-            float(jnp.sum(o.astype(jnp.float32)).item())
-            return time.perf_counter() - t0
-        # two-point measurement: t(3K) - t(K) cancels the constant
-        # dispatch+fetch overhead of the tunnel, which otherwise swamps
-        # the short-sequence timings
-        K = 4
-        t1 = run(K)
-        t2 = run(3 * K)
-        dt = max(1e-9, (t2 - t1) / (2 * K * reps))
-        # causal attention train flops ~ 3x fwd; fwd = 2*2*B*H*S^2*D/2
-        flops = 3 * 2 * B * H * S * S * D
-        rows.append({"seq": S, "ms": round(dt * 1000, 1),
-                     "tflops": round(flops / dt / 1e12, 1)})
+    from bench import bench_attn_16k
+    r = bench_attn_16k(jax.default_backend() == "tpu")
     return {"metric": "flash_attention_long_context_fwd_bwd",
-            "value": rows[-1]["ms"], "unit": "ms@16k", "rows": rows}
+            "value": r["ms"], "unit": "ms@16k", "tflops": r["tflops"]}
 
 
 def bench_ocr():
@@ -192,49 +102,6 @@ def bench_ocr():
     return {"metric": "crnn_ocr_train_images_per_sec", "unit": "img/s",
             "value": round(batch / dt, 1),
             "step_ms": round(dt * 1000, 2)}
-
-
-def bench_wo8_decode():
-    """GPT-125M greedy decode with weight-only int8 (quant/wo8.py) vs
-    the bf16 baseline: decode re-reads every weight per token, so int8
-    storage halves HBM bytes/step (W8A16 serving recipe)."""
-    import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-    from paddle_tpu.quant import quantize_weights_int8
-
-    paddle.seed(0)
-    cfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
-    model = GPTForPretraining(cfg)
-    rs = np.random.RandomState(0)
-    B, prompt_len, new = 8, 128, 128
-    ids = paddle.to_tensor(
-        rs.randint(0, cfg.vocab_size, (B, prompt_len)), "int32")
-
-    def timed(reps=3):
-        out, _ = model.generate(ids, max_new_tokens=new)   # compile
-        _sync(out.sum())
-        fetch = _fetch_latency(lambda: _sync(out.sum()))
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out, _ = model.generate(ids, max_new_tokens=new)
-        _sync(out.sum())
-        dt = max(1e-9, time.perf_counter() - t0 - fetch)
-        return B * new * reps / dt
-
-    bf16_tps = timed()
-    n = quantize_weights_int8(model)
-    int8_tps = timed()
-    # embeddings=True measured SLOWER than bf16 for the tied head
-    # (10.2k vs 12.0k tok/s): XLA materializes the dequantized [V, H]
-    # copy instead of fusing the int8->bf16 convert into the dot
-    # operand, so the head reads int8 + writes/reads bf16. Linears-only
-    # is the shipped default; a Pallas int8 matvec head is the known
-    # next lever.
-    return {"metric": "wo8_decode_tokens_per_sec", "unit": "tokens/sec",
-            "value": round(int8_tps, 1),
-            "bf16_tokens_per_sec": round(bf16_tps, 1),
-            "speedup": round(int8_tps / max(bf16_tps, 1e-9), 3),
-            "swapped_linears": n}
 
 
 def bench_int8_linear():
@@ -299,8 +166,7 @@ def main():
         sys.exit(1)
     wrapped = None
     for fn in (bench_decode, bench_gpt350m, bench_bert,
-               bench_long_context, bench_ocr,
-               bench_int8_linear, bench_wo8_decode):
+               bench_long_context, bench_ocr, bench_int8_linear):
         try:
             print(json.dumps(fn()))
         except Exception as e:  # keep later phases running

@@ -23,6 +23,7 @@ from .parallel import (  # noqa: F401
     spawn,
 )
 from .sharded_train import ShardedTrainStep, shard_model, shard_batch  # noqa: F401
+from .offload_train import OffloadTrainStep  # noqa: F401
 from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
     ParallelCrossEntropy,

@@ -18,11 +18,19 @@ The rewrite is CONSERVATIVE and semantics-preserving:
 - every rewritten construct dispatches at runtime (`convert_ifelse`,
   `convert_while`): Python-bool conditions run exactly the branch Python
   would, tensor conditions route into control_flow;
-- constructs the functional form cannot express faithfully (return /
-  break / continue inside the branch or loop body, global/nonlocal
-  declarations) are left as plain Python — correct for Python-valued
-  conditions, and producing a *diagnostic* (naming file:line) when a
-  tensor condition reaches them under trace.
+- `return` / `break` / `continue` are rewritten FIRST by the early-exit
+  pass (`_EarlyExit` — the analog of the reference's
+  `return_transformer.py:1` and `break_continue_transformer.py:1`) into
+  boolean flag variables + restructured `if`/`while`, which the main
+  pass then converts like any other control flow: `return e` becomes
+  `ret_flag, ret_val = True, e` with following code folded into the
+  `else` (or guarded by `if not ret_flag`), a return inside a loop adds
+  a `break`, `break`/`continue` become flags that guard the rest of the
+  iteration and (for break) extend the loop test with `not brk_flag`;
+- the remaining inexpressible corners (an exit inside `try`/`with`,
+  `global`/`nonlocal`) are left as plain Python — correct for
+  Python-valued conditions, and producing a *diagnostic* (naming
+  file:line) when a tensor condition reaches them under trace.
 """
 import ast
 import functools
@@ -88,11 +96,82 @@ def _loc(fn_name, lineno, filename):
 # These are the functions the rewritten AST calls. They must preserve
 # plain-Python semantics exactly when no tensor is involved.
 
-def convert_ifelse(pred, true_fn, false_fn, vals, names, loc):
+def _reconcile_retvals(true_fn, false_fn, vals, names, fold):
+    """The early-exit pass initializes its return-value slot to UNDEF;
+    under a tensor condition one branch assigns a tensor while the other
+    passes UNDEF through, which compiled cond cannot join. Probe both
+    branches at trace time (the extra ops are dead-code-eliminated) and
+    zero-fill the valueless side of UNDEF slots: always for GENERATED
+    `__dy2st_retval*` slots, and for ALL one-sided-UNDEF slots when the
+    `if` is a rewrite FOLD (code after an exit moved into a branch —
+    such locals are dead past the exit, so the fill is unobservable;
+    the companion flag guards the retval). The reference's analog is
+    RETURN_NO_VALUE placeholder variables (`return_transformer.py:1`)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    cand_idx = [k for k, n in enumerate(names)
+                if fold or n.startswith("__dy2st_retval")]
+    if not cand_idx:
+        return true_fn, false_fn
+    try:
+        t_out = list(true_fn(*vals))
+        f_out = list(false_fn(*vals))
+    except Exception:
+        return true_fn, false_fn    # diagnostics surface on the real run
+
+    def fill_for(own, other):
+        # only a NEVER-ASSIGNED (UNDEF) slot is fillable: an explicit
+        # `return None` mixed with `return tensor` is a genuine
+        # structure mismatch and must keep its diagnostic
+        fixes = {}
+        for k in cand_idx:
+            if own[k] is not UNDEF:
+                continue
+            if _is_tensorish(other[k]):
+                o = other[k]
+                v = o._value if isinstance(o, Tensor) else o
+                fixes[k] = ("zeros", (tuple(v.shape), v.dtype))
+            elif fold and isinstance(other[k], (bool, int, float)):
+                # dead python scalar: reuse the other side's value so
+                # the join is trivially consistent
+                fixes[k] = ("value", other[k])
+        return fixes
+
+    def wrap(fn, fixes):
+        if not fixes:
+            return fn
+
+        def fixed(*vs):
+            out = list(fn(*vs))
+            for k, (kind, spec) in fixes.items():
+                if out[k] is UNDEF:
+                    if kind == "zeros":
+                        shape, dtype = spec
+                        out[k] = Tensor(jnp.zeros(shape, dtype))
+                    else:
+                        out[k] = spec
+            return tuple(out)
+        return fixed
+
+    return (wrap(true_fn, fill_for(t_out, f_out)),
+            wrap(false_fn, fill_for(f_out, t_out)))
+
+
+def convert_ifelse(pred, true_fn, false_fn, vals, names, loc, fold=False):
     from ..core.tensor import Tensor
     if isinstance(pred, Tensor) or isinstance(pred, jax.Array) \
             or _is_traced(pred):
+        if not _is_traced(pred):
+            # CONCRETE tensor pred (eager): run exactly the branch
+            # Python would — no join exists, UNDEF passthrough keeps
+            # plain-Python unbound-variable semantics, no probe cost
+            return tuple((true_fn if bool(
+                pred._value if isinstance(pred, Tensor) else pred)
+                else false_fn)(*vals))
         from ..static import control_flow
+        # probe cost is trace-time only (the extra ops are DCE'd)
+        true_fn, false_fn = _reconcile_retvals(
+            true_fn, false_fn, vals, names, fold)
 
         def _checked(fn, which):
             # UNDEF may flow IN (var defined inside both branches is the
@@ -110,8 +189,20 @@ def convert_ifelse(pred, true_fn, false_fn, vals, names, loc):
                         "both branches or before the `if`")
                 return out
             return run
-        out = control_flow.cond(pred, _checked(true_fn, "true"),
-                                _checked(false_fn, "false"))
+        try:
+            out = control_flow.cond(pred, _checked(true_fn, "true"),
+                                    _checked(false_fn, "false"))
+        except TypeError as e:
+            msg = str(e)
+            if "structure" in msg or "pytree" in msg or "mismatch" in msg:
+                raise Dy2StaticError(
+                    f"{loc}: the two paths of this tensor-valued `if` "
+                    "produce differently-structured values (e.g. one "
+                    "early `return` yields a tensor while the other path "
+                    "falls through with None); make every path under a "
+                    "tensor condition produce the same structure. XLA "
+                    f"detail: {msg[:300]}") from e
+            raise
         return tuple(out)
     return true_fn(*vals) if pred else false_fn(*vals)
 
@@ -120,8 +211,47 @@ def convert_while(cond_fn, body_fn, vals, names, loc, max_iter=None):
     first = cond_fn(*vals)
     if _is_tensorish(first):
         from ..static import control_flow
+        vals = list(vals)
+        # an INNER loop's generated flags are (re)initialized at the top
+        # of this loop's body before any read, so their entry value is
+        # dead — seed False instead of tripping the UNDEF check
+        for k, n in enumerate(names):
+            if vals[k] is UNDEF and n.startswith(("__dy2st_brk",
+                                                  "__dy2st_cont",
+                                                  "__dy2st_retflag")):
+                vals[k] = False
+        # remaining UNDEF carries (the retval, an inner for's target/
+        # counter/bounds, body-local temps assigned before every read):
+        # probe one body iteration at trace time (DCE'd) and seed each
+        # slot from its probe aval — the seed is dead because the body
+        # (re)assigns the name before reading it; a genuine
+        # use-before-def RAISES during the probe and keeps the
+        # diagnostic below. NOTE: like all code under jax tracing,
+        # PYTHON-level side effects in the probed body fire once more
+        # per trace (tensor ops are DCE'd; prints/appends are not)
+        gen_idx = [k for k, v in enumerate(vals) if v is UNDEF]
+        if gen_idx and not _is_traced(first):
+            # eager concrete bound: the python loop below never joins,
+            # and probing would re-execute the body per call
+            gen_idx = []
+        if gen_idx:
+            try:
+                probe = list(body_fn(*vals))
+            except Exception:
+                probe = None
+            if probe is not None:
+                import jax.numpy as jnp
+                from ..core.tensor import Tensor
+                for k in gen_idx:
+                    p = probe[k]
+                    if _is_tensorish(p):
+                        v = p._value if isinstance(p, Tensor) else p
+                        vals[k] = Tensor(jnp.zeros(tuple(v.shape),
+                                                   v.dtype))
+                    elif isinstance(p, (bool, int, float)):
+                        vals[k] = type(p)()
         for n, v in zip(names, vals):
-            if v is UNDEF:
+            if v is UNDEF and not n.startswith("__dy2st_retval"):
                 raise Dy2StaticError(
                     f"{loc}: variable {n!r} is used by a tensor-valued "
                     "`while` but not defined before the loop")
@@ -175,6 +305,23 @@ def convert_logical_not(x):
         from ..tensor import logical_not
         return logical_not(x)
     return not x
+
+
+def finalize_return(flag, val, can_fall_through, fn_name):
+    """Terminal of the early-return rewrite. Python-bool flag keeps
+    exact semantics (fall-through returns None). A TRACED flag means
+    returnedness is data-dependent: sound only when every path returns
+    (statically proven at rewrite time)."""
+    if not _is_tensorish(flag):
+        return val if flag else None
+    if can_fall_through:
+        raise Dy2StaticError(
+            f"{fn_name}: under a tensor condition this function may "
+            "return a value on one path and fall through (implicit "
+            "None) on another; compiled control flow needs every path "
+            "to produce the same structure — add an explicit `return` "
+            "with a matching value to the fall-through path")
+    return val
 
 
 def range_cond(i, stop, step):
@@ -329,6 +476,306 @@ def _is_generated_fn_name(n):
                          "__dy2st_cond_", "__dy2st_body_"))
 
 
+# ----------------------------------------------------- early-exit pass
+
+class _EarlyExitBail(Exception):
+    """An exit construct sits where the flag rewrite cannot preserve
+    semantics (inside try/with); leave the function for the diagnostic
+    path."""
+
+
+def _not(expr):
+    return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+
+def _convertible_for(node):
+    """True iff visit_For will convert this `for` to a while (single
+    Name target over a plain range(...)). Loops outside this shape keep
+    REAL Python iteration, so their `break`/`continue` must stay plain
+    Python statements — flag-rewriting them would disconnect the flag
+    from any loop test and silently stop the exit from terminating the
+    loop."""
+    if node.orelse or not isinstance(node.target, ast.Name):
+        return False
+    it = node.iter
+    return (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and not it.keywords
+            and 1 <= len(it.args) <= 3)
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+class _EarlyExit:
+    """Flag-based rewrite of `return`/`break`/`continue` (reference
+    `return_transformer.py:1` / `break_continue_transformer.py:1`):
+    runs BEFORE the control-flow transformer, producing plain
+    assignments + `if`/`while` that the main pass converts to
+    `lax.cond`/`while_loop` like any other code. Code following an
+    exit-carrying `if` folds into its other branch when only one side
+    exits (so joined values are assigned on both paths); when both
+    sides may exit, the rest is guarded by `if not flag:`."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _fresh(self, kind):
+        self._uid += 1
+        return f"__dy2st_{kind}{self._uid}"
+
+    # ---- scans (function scope only; never into nested defs) ----------
+    def _scan_returns(self, stmts, under_guard=False, top=True):
+        """(has_any_early_return). Raises _EarlyExitBail for returns
+        under try/with."""
+        found = False
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                if under_guard:
+                    raise _EarlyExitBail()
+                # a trailing top-level return is not "early"
+                if not (top and idx == len(stmts) - 1):
+                    found = True
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Lambda)):
+                continue
+            elif isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(s, field, None) or []
+                    for h in sub:
+                        body = h.body if isinstance(
+                            h, ast.ExceptHandler) else [h]
+                        found |= self._scan_returns(body, True, False)
+            elif isinstance(s, (ast.If, ast.While, ast.For)):
+                found |= self._scan_returns(s.body, under_guard, False)
+                found |= self._scan_returns(s.orelse, under_guard, False)
+        return found
+
+    def _scan_bc(self, stmts, under_guard=False):
+        """(has_break, has_continue) at THIS loop level. Raises
+        _EarlyExitBail for an exit under try/with."""
+        hb = hc = False
+        for s in stmts:
+            if isinstance(s, ast.Break):
+                if under_guard:
+                    raise _EarlyExitBail()
+                hb = True
+            elif isinstance(s, ast.Continue):
+                if under_guard:
+                    raise _EarlyExitBail()
+                hc = True
+            elif isinstance(s, ast.If):
+                b1, c1 = self._scan_bc(s.body, under_guard)
+                b2, c2 = self._scan_bc(s.orelse, under_guard)
+                hb, hc = hb | b1 | b2, hc | c1 | c2
+            elif isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(s, field, None) or []
+                    for h in sub:
+                        body = h.body if isinstance(
+                            h, ast.ExceptHandler) else [h]
+                        b1, c1 = self._scan_bc(body, True)
+                        hb, hc = hb | b1, hc | c1
+            # nested loops own their break/continue; nested defs too
+        return hb, hc
+
+    # ---- return rewrite ------------------------------------------------
+    def _rewrite_returns(self, stmts, rf, rv, in_loop):
+        """Returns (new_stmts, may_return). Consumes trailing statements
+        into branch folds / guards as needed."""
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign(rf, _const(True)))
+                out.append(_assign(
+                    rv, s.value if s.value is not None else _const(None)))
+                if in_loop:
+                    out.append(ast.Break())
+                return out, True        # code after `return` is dead
+            if isinstance(s, ast.If):
+                nb, be = self._rewrite_returns(s.body, rf, rv, in_loop)
+                no, oe = self._rewrite_returns(s.orelse, rf, rv, in_loop)
+                s.body = nb or [ast.Pass()]
+                s.orelse = no
+                if be or oe:
+                    # fold-marked: one-sided locals in the folded rest
+                    # are dead past the exit, so the join may fill them
+                    s._dy2st_fold = True
+                    rest, _ = self._rewrite_returns(
+                        stmts[idx + 1:], rf, rv, in_loop)
+                    if be and not oe:
+                        s.orelse = no + rest
+                    elif oe and not be:
+                        s.body = (nb + rest) or [ast.Pass()]
+                        out.append(s)
+                        return out, True
+                    else:
+                        out.append(s)
+                        if rest:
+                            g = ast.If(test=_not(_name(rf)),
+                                       body=rest, orelse=[])
+                            g._dy2st_fold = True
+                            out.append(g)
+                        return out, True
+                    out.append(s)
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                nb, be = self._rewrite_returns(s.body, rf, rv, True)
+                s.body = nb or [ast.Pass()]
+                if be:
+                    # the return-site Break exits the INNERMOST loop;
+                    # every enclosing loop must also stop — propagate
+                    # with a trailing flag check (the loop pass rewrites
+                    # this Break into the enclosing loop's own flag)
+                    s.body = s.body + [ast.If(test=_name(rf),
+                                              body=[ast.Break()],
+                                              orelse=[])]
+                    # ... and guard everything after the loop
+                    rest, _ = self._rewrite_returns(
+                        stmts[idx + 1:], rf, rv, in_loop)
+                    out.append(s)
+                    if rest:
+                        g = ast.If(test=_not(_name(rf)),
+                                   body=rest, orelse=[])
+                        g._dy2st_fold = True
+                        out.append(g)
+                    return out, True
+                out.append(s)
+                continue
+            out.append(s)
+        return out, False
+
+    # ---- break/continue rewrite ---------------------------------------
+    def _rewrite_bc(self, stmts, bf, cf):
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign(bf, _const(True)))
+                return out, True
+            if isinstance(s, ast.Continue):
+                out.append(_assign(cf, _const(True)))
+                return out, True
+            if isinstance(s, ast.If):
+                nb, be = self._rewrite_bc(s.body, bf, cf)
+                no, oe = self._rewrite_bc(s.orelse, bf, cf)
+                s.body = nb or [ast.Pass()]
+                s.orelse = no
+                if be or oe:
+                    s._dy2st_fold = True
+                    rest, _ = self._rewrite_bc(stmts[idx + 1:], bf, cf)
+                    if be and not oe:
+                        s.orelse = no + rest
+                    elif oe and not be:
+                        s.body = (nb + rest) or [ast.Pass()]
+                    else:
+                        out.append(s)
+                        if rest:
+                            guard = _not(ast.BoolOp(
+                                op=ast.Or(),
+                                values=[_name(bf), _name(cf)]))
+                            g = ast.If(test=guard, body=rest, orelse=[])
+                            g._dy2st_fold = True
+                            out.append(g)
+                        return out, True
+                    out.append(s)
+                    return out, True
+                out.append(s)
+                continue
+            out.append(s)          # nested loops handled bottom-up
+        return out, False
+
+    # ---- drivers -------------------------------------------------------
+    def rewrite_loops(self, stmts):
+        """Bottom-up: rewrite break/continue of every loop in this
+        statement list (recursing through ifs and loop bodies). Returns
+        the new list (loop-flag inits are inserted before loops)."""
+        out = []
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                out.append(s)
+                continue
+            if isinstance(s, ast.If):
+                s.body = self.rewrite_loops(s.body)
+                s.orelse = self.rewrite_loops(s.orelse)
+                out.append(s)
+                continue
+            if isinstance(s, (ast.While, ast.For)) and not s.orelse:
+                s.body = self.rewrite_loops(s.body)   # inner loops first
+                if isinstance(s, ast.For) and not _convertible_for(s):
+                    # real-Python iteration: break/continue stay plain
+                    # statements and already behave correctly
+                    out.append(s)
+                    continue
+                try:
+                    hb, hc = self._scan_bc(s.body)
+                except _EarlyExitBail:
+                    out.append(s)   # diagnostic path handles it
+                    continue
+                if not (hb or hc):
+                    out.append(s)
+                    continue
+                bf = self._fresh("brk")
+                cf = self._fresh("cont")
+                body, _ = self._rewrite_bc(s.body, bf, cf)
+                s.body = [_assign(cf, _const(False))] + body
+                if isinstance(s, ast.While):
+                    s.test = ast.BoolOp(op=ast.And(),
+                                        values=[_not(_name(bf)), s.test])
+                else:
+                    s._dy2st_break_flag = bf   # consumed by visit_For
+                # both flags init BEFORE the loop too: they are loop
+                # carries and must not enter the while as UNDEF
+                out.append(_assign(bf, _const(False)))
+                out.append(_assign(cf, _const(False)))
+                out.append(s)
+                continue
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _always_returns(stmts):
+        """Statically: does every path through this list hit a return?
+        Conservative (loops/try count as fall-through-able)."""
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                return True
+            if isinstance(s, ast.If) and s.orelse:
+                if _EarlyExit._always_returns(s.body) and \
+                        _EarlyExit._always_returns(s.orelse):
+                    return True
+            if isinstance(s, ast.Raise):
+                return True
+        return False
+
+    def rewrite_function(self, fdef, fn_name="<fn>"):
+        """Apply the return pass then the loop pass to a FunctionDef.
+        On bail (exit under try/with) the body is left untouched."""
+        try:
+            early = self._scan_returns(fdef.body)
+        except _EarlyExitBail:
+            return
+        if early:
+            can_fall = not self._always_returns(fdef.body)
+            rf = self._fresh("retflag")
+            rv = self._fresh("retval")
+            body, _ = self._rewrite_returns(fdef.body, rf, rv, False)
+            final = ast.Return(value=ast.Call(
+                func=_helper("finalize_return"),
+                args=[_name(rf), _name(rv), _const(can_fall),
+                      _const(fn_name)],
+                keywords=[]))
+            fdef.body = ([_assign(rf, _const(False)),
+                          _assign(rv, _helper("UNDEF"))]
+                         + body + [final])
+        fdef.body = self.rewrite_loops(fdef.body)
+        # synthesized nodes need locations BEFORE the control-flow
+        # transformer reads .lineno for its diagnostics
+        ast.fix_missing_locations(fdef)
+
+
 # ------------------------------------------------------------ transformer
 
 # runtime-helper namespace symbol; injected into the defining module's
@@ -464,7 +911,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Tuple(elts=[_const(n) for n in names],
                             ctx=ast.Load()),
                   _const(loc)],
-            keywords=[])
+            keywords=[ast.keyword(
+                arg="fold",
+                value=_const(bool(getattr(node, "_dy2st_fold", False))))])
         if names:
             out.append(ast.Assign(
                 targets=[_tuple_of(names, ast.Store())], value=call))
@@ -517,14 +966,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         # only `for <name> in range(...)` is rewritten (to a while); any
         # other iterable keeps Python semantics (static-length iteration
-        # unrolls fine under trace)
-        if node.orelse or not isinstance(node.target, ast.Name):
+        # unrolls fine under trace). MUST stay in sync with the
+        # early-exit pass's flag-rewrite gate — _convertible_for is the
+        # single predicate for both.
+        if not _convertible_for(node):
             return node
         it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3):
-            return node
         a = _assigned(node.body)
         if a.blockers:
             return node
@@ -555,6 +1002,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         test = ast.Call(func=_helper("range_cond"),
                         args=[_name(cnt), _name(vstop), _name(vstep)],
                         keywords=[])
+        bf = getattr(node, "_dy2st_break_flag", None)
+        if bf is not None:
+            # early-exit pass rewrote `break` into this flag: the loop
+            # continues only while the flag is unset
+            test = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_name(bf)),
+                        test])
         body = [ast.Assign(targets=[_name(i, ast.Store())],
                            value=_name(cnt))] + list(node.body)
         body.append(ast.Assign(
@@ -629,6 +1084,7 @@ def convert_dynamic(fn):
         return fn
     fdef.decorator_list = []            # strip @to_static itself
     base = raw_fn.__code__.co_firstlineno
+    _EarlyExit().rewrite_function(fdef, raw_fn.__name__)
     _ControlFlowTransformer(raw_fn.__name__, filename, base).visit(fdef)
     ast.fix_missing_locations(tree)
 
@@ -683,6 +1139,7 @@ class _HelperNS:
     convert_logical_or = staticmethod(convert_logical_or)
     convert_logical_not = staticmethod(convert_logical_not)
     range_cond = staticmethod(range_cond)
+    finalize_return = staticmethod(finalize_return)
     _current_max_iter = staticmethod(_current_max_iter)
 
 

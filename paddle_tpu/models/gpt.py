@@ -34,7 +34,7 @@ class GPTConfig:
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.0, attn_dropout=0.0, initializer_range=0.02,
                  use_flash_attention=True, sequence_parallel=None,
-                 dtype="float32"):
+                 dtype="float32", remat=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,6 +48,9 @@ class GPTConfig:
         # None | "ring" | "ulysses": context parallelism over the sp axis
         self.sequence_parallel = sequence_parallel
         self.dtype = dtype
+        # per-block rematerialization (reference RecomputeOptimizer /
+        # recompute_interval): store only block INPUTS for the backward
+        self.remat = remat
 
     @staticmethod
     def _preset(defaults, kw):
@@ -254,6 +257,16 @@ class GPTModel(Layer):
                 h, nc = block(h, cache=cache, offset=offset)
                 new_caches.append(nc)
             return self.ln_f(h), new_caches
+        if self.config.remat:
+            # jax.checkpoint per block: the backward recomputes the
+            # block from its stored input — O(L) activation memory
+            # (reference `backward.py:749` checkpoint segments /
+            # `fleet/utils/recompute.py:63`)
+            from ..distributed.recompute import recompute
+            for block in self.blocks:
+                h = recompute(block, h)
+                h = _shard_activation(h)
+            return self.ln_f(h)
         for block in self.blocks:
             h = block(h)
             h = _shard_activation(h)

@@ -183,17 +183,274 @@ def test_undefined_var_diagnostic():
         f(paddle.to_tensor(np.ones((2,), np.float32)))
 
 
-def test_early_return_diagnostic_names_fix():
-    """Early return under a tensor condition cannot be functionalized;
-    the raw tracer error must surface as an actionable message."""
+def test_early_return_tensor_cond_converts():
+    """Early `return` under a tensor condition now CONVERTS (reference
+    `return_transformer.py:1`): flag+value rewrite with the fall-through
+    folded into the else branch — both paths produce the return value,
+    so lax.cond joins them."""
     def early(x):
         if x.mean() > 0:
             return x * 2
         return x
 
     f = to_static(early)
-    with pytest.raises(Dy2StaticError, match="control_flow"):
+    pos = paddle.to_tensor(np.ones((2,), np.float32))
+    neg = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(f(pos)), 2.0 * np.ones(2))
+    np.testing.assert_allclose(_np(f(neg)), -np.ones(2))
+    np.testing.assert_allclose(_np(f(pos)), _np(early(pos)))
+
+
+def test_return_in_tensor_loop_converts():
+    """`return` inside a tensor-bound while exits the loop (break flag)
+    and skips the code after it."""
+    def fn(x, bound):
+        i = paddle.zeros([1], dtype="int32")
+        acc = paddle.zeros_like(x)
+        while i < bound:
+            acc = acc + x
+            if acc.mean() > 2.5:
+                return acc * 10.0
+            i = i + 1
+        return acc
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    b = paddle.to_tensor(np.asarray([6], np.int32))
+    # eager reference: acc hits 3.0 at i=2 -> returns 30
+    np.testing.assert_allclose(_np(f(x, b)), _np(fn(x, b)))
+    np.testing.assert_allclose(_np(f(x, b)), 30.0 * np.ones(2))
+    # bound below the trigger: falls through to the plain return
+    b2 = paddle.to_tensor(np.asarray([2], np.int32))
+    np.testing.assert_allclose(_np(f(x, b2)), 2.0 * np.ones(2))
+
+
+def test_break_in_tensor_while_converts():
+    """`break` under a tensor condition inside a tensor while (reference
+    `break_continue_transformer.py:1`): the loop test gains `not brk`."""
+    def fn(x, bound):
+        i = paddle.zeros([1], dtype="int32")
+        acc = paddle.zeros_like(x)
+        while i < bound:
+            if acc.mean() > 1.5:
+                break
+            acc = acc + x
+            i = i + 1
+        return acc, i
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    b = paddle.to_tensor(np.asarray([10], np.int32))
+    acc_c, i_c = f(x, b)
+    acc_e, i_e = fn(x, b)
+    np.testing.assert_allclose(_np(acc_c), _np(acc_e))
+    np.testing.assert_array_equal(_np(i_c), _np(i_e))
+    np.testing.assert_allclose(_np(acc_c), 2.0 * np.ones(2))
+
+
+def test_break_in_converted_for_range():
+    """`break` inside a converted for-range (the VERDICT r3 case): the
+    built while test gains the break flag; post-loop `i` matches the
+    eager trajectory."""
+    def fn(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            acc = acc + x
+            if acc.mean() > 2.5:
+                break
+        return acc
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    n = paddle.to_tensor(np.asarray(8, np.int32))
+    np.testing.assert_allclose(_np(f(x, n)), _np(fn(x, n)))
+    np.testing.assert_allclose(_np(f(x, n)), 3.0 * np.ones(2))
+
+
+def test_continue_in_tensor_for_range_converts():
+    """`continue` under a tensor condition inside a converted for-range:
+    the iteration flag skips the rest of the body, loop keeps going."""
+    def fn(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            # works for python int i (eager) AND Tensor i (converted)
+            if i - i // 2 * 2 == 0:
+                continue
+            acc = acc + x
+        return acc
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    n = paddle.to_tensor(np.asarray(6, np.int32))
+    # i in 0..5; evens skipped -> adds at 1, 3, 5
+    np.testing.assert_allclose(_np(f(x, n)), _np(fn(x, 6)))
+    np.testing.assert_allclose(_np(f(x, n)), 3.0 * np.ones(2))
+
+
+def test_continue_tensor_condition_in_while():
+    def fn(x, bound):
+        i = paddle.zeros([1], dtype="int32")
+        acc = paddle.zeros_like(x)
+        while i < bound:
+            i = i + 1
+            if (i % 2 == 0).all():
+                continue
+            acc = acc + x
+        return acc
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    b = paddle.to_tensor(np.asarray([6], np.int32))
+    np.testing.assert_allclose(_np(f(x, b)), _np(fn(x, b)))
+    np.testing.assert_allclose(_np(f(x, b)), 3.0 * np.ones(2))
+
+
+def test_return_in_nested_loop_exits_all_loops():
+    """A rewritten `return` inside an inner loop must stop the OUTER
+    loop too (trailing `if ret_flag: break` propagation) — both for
+    plain-Python conditions and converted tensor loops."""
+    def fn():
+        k = 0
+        while True:
+            for i in range(3):
+                if i == 1:
+                    return k + i
+            k += 1
+
+    f = to_static(fn)
+    assert f() == 1
+
+    def fn_t(x, n):
+        acc = paddle.zeros_like(x)
+        for outer in range(n):
+            for i in range(n):
+                acc = acc + x
+                if acc.mean() > 2.5:
+                    return acc * 100.0
+        return acc
+
+    g = to_static(fn_t)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    n = paddle.to_tensor(np.asarray(4, np.int32))
+    np.testing.assert_allclose(_np(g(x, n)), _np(fn_t(x, 4)))
+    np.testing.assert_allclose(_np(g(x, n)), 300.0 * np.ones(2))
+
+
+def test_early_return_with_fall_through_locals():
+    """The common shape `if cond: return a` followed by code that
+    assigns fresh locals: the fold reconciliation must fill the
+    one-sided locals instead of raising the misleading both-branches
+    diagnostic (review r4 finding)."""
+    def fn(x):
+        if x.mean() > 0:
+            return x * 2
+        y = x + 1.0
+        z = y * 3.0
+        return z
+
+    f = to_static(fn)
+    pos = paddle.to_tensor(np.ones((2,), np.float32))
+    neg = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(f(pos)), _np(fn(pos)))
+    np.testing.assert_allclose(_np(f(neg)), _np(fn(neg)))
+    np.testing.assert_allclose(_np(f(neg)), 0.0 * np.ones(2))
+
+
+def test_eager_concrete_tensor_cond_single_branch():
+    """With a CONCRETE tensor condition (converted function run OUTSIDE
+    jit), exactly one branch runs — side-effect count proves no double
+    execution (review r4 finding: the reconcile probe must be
+    trace-only)."""
+    from paddle_tpu.jit.dy2static import convert_dynamic
+    calls = {"n": 0}
+
+    def fn(x):
+        if x.mean() > 0:
+            calls["n"] += 1
+            return x * 2
+        return x
+
+    g = convert_dynamic(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    out = g(x)
+    np.testing.assert_allclose(_np(out), 2.0 * np.ones(2))
+    assert calls["n"] == 1
+    calls["n"] = 0
+    neg = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(g(neg)), -np.ones(2))
+    assert calls["n"] == 0
+
+
+def test_break_return_in_non_range_for_keeps_python_semantics():
+    """Loops over real iterables (list/zip/enumerate) are NOT converted
+    to while; their break/continue/return must stay plain Python and
+    terminate the loop exactly as Python does (review r4: flag-rewriting
+    them would silently disconnect the exit from the loop test)."""
+    def fn_break():
+        hits = []
+        for v in [1, 2, 3, 4]:
+            if v == 2:
+                break
+            hits.append(v)
+        return hits
+
+    assert to_static(fn_break)() == [1]
+
+    def fn_return(x):
+        seen = []
+        for v in [1, 2, 3]:
+            seen.append(v)
+            if v == 2:
+                return x * v, seen
+        return x, seen
+
+    f = to_static(fn_return)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    out, seen = f(x)
+    assert seen == [1, 2]
+    np.testing.assert_allclose(_np(out), 2.0 * np.ones(2))
+
+    def fn_continue():
+        acc = 0
+        for i, v in enumerate([10, 20, 30]):
+            if v == 20:
+                continue
+            acc += v
+        return acc
+
+    assert to_static(fn_continue)() == 40
+
+
+def test_mismatched_return_structure_diagnoses():
+    """One path returns a tensor, the other None, under a tensor cond:
+    must produce the actionable structure diagnostic, not a raw XLA
+    pytree error."""
+    def bad(x):
+        if x.mean() > 0:
+            return x * 2
+        # falls through -> implicit None
+
+    f = to_static(bad)
+    with pytest.raises(Dy2StaticError):
         f(paddle.to_tensor(np.ones((2,), np.float32)))
+
+
+def test_exit_under_try_keeps_diagnostic_path():
+    """return inside try/with cannot be flag-rewritten faithfully; the
+    function keeps plain-Python semantics (python conds fine, tensor
+    cond produces the actionable diagnostic)."""
+    def fn(x, flag):
+        try:
+            if flag:
+                return x * 2
+        finally:
+            pass
+        return x
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(f(x, True)), 2 * np.ones(2))
+    np.testing.assert_allclose(_np(f(x, False)), np.ones(2))
 
 
 def test_python_semantics_preserved_side_effects():
