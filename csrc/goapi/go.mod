@@ -1,0 +1,3 @@
+module paddletpu/goapi
+
+go 1.20

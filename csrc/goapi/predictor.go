@@ -1,0 +1,219 @@
+// Package paddletpu — Go serving API over the native predictor C ABI
+// (csrc/predictor.cc ptp_*). Reference analog:
+// paddle/fluid/inference/goapi/lib.go — the reference ships a cgo
+// wrapper over its C inference API; this is the same thin layer over
+// the PJRT-based runner. libptp_predictor.so is dlopen'd at runtime so
+// building this package needs only -ldl, not the library at link time.
+//
+// Usage:
+//
+//	p, err := paddletpu.New("model", "libtpu.so",
+//	                        "build/libptp_predictor.so")
+//	outs, err := p.Run([][]byte{in0, in1})
+//	p.Destroy()
+package paddletpu
+
+/*
+#cgo LDFLAGS: -ldl
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+
+static void* ptp_so = NULL;
+
+static int ptp_open(const char* path) {
+  ptp_so = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  return ptp_so ? 0 : -1;
+}
+
+static const char* ptp_dlerr() { return dlerror(); }
+
+static void* call_create(const char* a, const char* pl, char* e, int el) {
+  void* (*f)(const char*, const char*, char*, int) =
+      (void* (*)(const char*, const char*, char*, int))
+          dlsym(ptp_so, "ptp_create");
+  return f ? f(a, pl, e, el) : NULL;
+}
+
+static void call_destroy(void* h) {
+  void (*f)(void*) = (void (*)(void*))dlsym(ptp_so, "ptp_destroy");
+  if (f) f(h);
+}
+
+static int call_num(void* h, int is_input) {
+  int (*f)(void*) = (int (*)(void*))dlsym(
+      ptp_so, is_input ? "ptp_num_inputs" : "ptp_num_outputs");
+  return f ? f(h) : -1;
+}
+
+static int call_rank(void* h, int is_input, int i) {
+  int (*f)(void*, int, int) =
+      (int (*)(void*, int, int))dlsym(ptp_so, "ptp_io_rank");
+  return f ? f(h, is_input, i) : -1;
+}
+
+static void call_shape(void* h, int is_input, int i, int64_t* dims) {
+  void (*f)(void*, int, int, int64_t*) =
+      (void (*)(void*, int, int, int64_t*))dlsym(ptp_so, "ptp_io_shape");
+  if (f) f(h, is_input, i, dims);
+}
+
+static const char* call_dtype(void* h, int is_input, int i) {
+  const char* (*f)(void*, int, int) =
+      (const char* (*)(void*, int, int))dlsym(ptp_so, "ptp_io_dtype");
+  return f ? f(h, is_input, i) : "";
+}
+
+static int64_t call_bytes(void* h, int is_input, int i) {
+  int64_t (*f)(void*, int, int) =
+      (int64_t (*)(void*, int, int))dlsym(ptp_so, "ptp_io_bytes");
+  return f ? f(h, is_input, i) : -1;
+}
+
+static int call_run(void* h, const void** ins, void** outs, char* e,
+                    int el) {
+  int (*f)(void*, const void**, void**, char*, int) =
+      (int (*)(void*, const void**, void**, char*, int))
+          dlsym(ptp_so, "ptp_run");
+  return f ? f(h, ins, outs, e, el) : -1;
+}
+*/
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"unsafe"
+)
+
+const errLen = 2048
+
+// Predictor wraps one loaded artifact + PJRT plugin (ZeroCopyRun-style
+// contract: the caller owns input and output buffers; inputs may be
+// reused the moment Run returns).
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+// New dlopens libptp (once per process), loads the exported artifact
+// (base path of the .mlir/.sig pair) against the given PJRT plugin.
+func New(artifact, plugin, libptp string) (*Predictor, error) {
+	cl := C.CString(libptp)
+	defer C.free(unsafe.Pointer(cl))
+	if C.ptp_so == nil {
+		if C.ptp_open(cl) != 0 {
+			return nil, fmt.Errorf("dlopen %s: %s", libptp,
+				C.GoString(C.ptp_dlerr()))
+		}
+	}
+	ca := C.CString(artifact)
+	defer C.free(unsafe.Pointer(ca))
+	cp := C.CString(plugin)
+	defer C.free(unsafe.Pointer(cp))
+	ebuf := (*C.char)(C.malloc(errLen))
+	defer C.free(unsafe.Pointer(ebuf))
+	*ebuf = 0
+	h := C.call_create(ca, cp, ebuf, errLen)
+	if h == nil {
+		return nil, errors.New("ptp_create: " + C.GoString(ebuf))
+	}
+	return &Predictor{h: h}, nil
+}
+
+func (p *Predictor) NumInputs() int  { return int(C.call_num(p.h, 1)) }
+func (p *Predictor) NumOutputs() int { return int(C.call_num(p.h, 0)) }
+
+func (p *Predictor) ioShape(isInput, i int) []int64 {
+	rank := int(C.call_rank(p.h, C.int(isInput), C.int(i)))
+	if rank <= 0 {
+		return []int64{}
+	}
+	dims := make([]int64, rank)
+	C.call_shape(p.h, C.int(isInput), C.int(i),
+		(*C.int64_t)(unsafe.Pointer(&dims[0])))
+	return dims
+}
+
+// InputShape / OutputShape return the static dims of io slot i.
+func (p *Predictor) InputShape(i int) []int64  { return p.ioShape(1, i) }
+func (p *Predictor) OutputShape(i int) []int64 { return p.ioShape(0, i) }
+
+// InputDtype / OutputDtype return the dtype token from the artifact
+// signature (f32, s32, bf16, ...).
+func (p *Predictor) InputDtype(i int) string {
+	return C.GoString(C.call_dtype(p.h, 1, C.int(i)))
+}
+
+func (p *Predictor) OutputDtype(i int) string {
+	return C.GoString(C.call_dtype(p.h, 0, C.int(i)))
+}
+
+// InputBytes / OutputBytes return the raw buffer size of io slot i.
+func (p *Predictor) InputBytes(i int) int {
+	return int(C.call_bytes(p.h, 1, C.int(i)))
+}
+
+func (p *Predictor) OutputBytes(i int) int {
+	return int(C.call_bytes(p.h, 0, C.int(i)))
+}
+
+// Run executes one inference. inputs[i] must hold exactly
+// InputBytes(i) raw bytes; the returned slices hold the raw output
+// buffers (caller-owned). Buffers are staged through C memory so no Go
+// pointer ever crosses the cgo boundary inside an array (cgocheck
+// rule); the extra copy is negligible next to the H2D/D2H transfers.
+func (p *Predictor) Run(inputs [][]byte) ([][]byte, error) {
+	ni, no := p.NumInputs(), p.NumOutputs()
+	if len(inputs) != ni {
+		return nil, fmt.Errorf("want %d inputs, got %d", ni,
+			len(inputs))
+	}
+	ptrSize := C.size_t(unsafe.Sizeof(uintptr(0)))
+	cin := C.malloc(C.size_t(ni) * ptrSize)
+	defer C.free(cin)
+	cout := C.malloc(C.size_t(no) * ptrSize)
+	defer C.free(cout)
+	inArr := unsafe.Slice((*unsafe.Pointer)(cin), ni)
+	outArr := unsafe.Slice((*unsafe.Pointer)(cout), no)
+	var cbufs []unsafe.Pointer
+	defer func() {
+		for _, b := range cbufs {
+			C.free(b)
+		}
+	}()
+	for i, b := range inputs {
+		if len(b) != p.InputBytes(i) {
+			return nil, fmt.Errorf("input %d: want %d bytes, got %d",
+				i, p.InputBytes(i), len(b))
+		}
+		cb := C.CBytes(b)
+		cbufs = append(cbufs, cb)
+		inArr[i] = cb
+	}
+	for i := 0; i < no; i++ {
+		ob := C.malloc(C.size_t(p.OutputBytes(i)))
+		cbufs = append(cbufs, ob)
+		outArr[i] = ob
+	}
+	ebuf := (*C.char)(C.malloc(errLen))
+	defer C.free(unsafe.Pointer(ebuf))
+	*ebuf = 0
+	rc := C.call_run(p.h, (*unsafe.Pointer)(cin),
+		(*unsafe.Pointer)(cout), ebuf, errLen)
+	if rc != 0 {
+		return nil, errors.New("ptp_run: " + C.GoString(ebuf))
+	}
+	outs := make([][]byte, no)
+	for i := 0; i < no; i++ {
+		outs[i] = C.GoBytes(outArr[i], C.int(p.OutputBytes(i)))
+	}
+	return outs, nil
+}
+
+// Destroy releases the executable, client, and plugin resources.
+func (p *Predictor) Destroy() {
+	if p.h != nil {
+		C.call_destroy(p.h)
+		p.h = nil
+	}
+}

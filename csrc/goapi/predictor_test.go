@@ -1,0 +1,76 @@
+package paddletpu
+
+// Round-trip against the hermetic mock identity plugin
+// (csrc/pjrt_mock_plugin.cc) — the Go-side analog of
+// tests/test_native_predictor.py::test_mock_identity_roundtrip.
+// Driven by tests/test_native_predictor.py when a go toolchain exists;
+// it exports PTP_ARTIFACT / PTP_PLUGIN / PTP_LIB before `go test`.
+
+import (
+	"bytes"
+	"encoding/binary"
+	"math"
+	"os"
+	"testing"
+)
+
+func f32bytes(vals []float32) []byte {
+	var buf bytes.Buffer
+	for _, v := range vals {
+		var b [4]byte
+		binary.LittleEndian.PutUint32(b[:], math.Float32bits(v))
+		buf.Write(b[:])
+	}
+	return buf.Bytes()
+}
+
+func TestMockIdentityRoundtrip(t *testing.T) {
+	artifact := os.Getenv("PTP_ARTIFACT")
+	plugin := os.Getenv("PTP_PLUGIN")
+	lib := os.Getenv("PTP_LIB")
+	if artifact == "" || plugin == "" || lib == "" {
+		t.Skip("PTP_ARTIFACT/PTP_PLUGIN/PTP_LIB not set " +
+			"(run via tests/test_native_predictor.py)")
+	}
+	p, err := New(artifact, plugin, lib)
+	if err != nil {
+		t.Fatalf("New: %v", err)
+	}
+	defer p.Destroy()
+
+	if p.NumInputs() != 1 || p.NumOutputs() != 1 {
+		t.Fatalf("want 1 in / 1 out, got %d/%d", p.NumInputs(),
+			p.NumOutputs())
+	}
+	if p.InputDtype(0) != "f32" {
+		t.Fatalf("want f32 input, got %q", p.InputDtype(0))
+	}
+	shape := p.InputShape(0)
+	if len(shape) != 2 || shape[0] != 2 || shape[1] != 3 {
+		t.Fatalf("want [2 3], got %v", shape)
+	}
+
+	in := f32bytes([]float32{1, 2, 3, 4.5, -5, 6})
+	outs, err := p.Run([][]byte{in})
+	if err != nil {
+		t.Fatalf("Run: %v", err)
+	}
+	if !bytes.Equal(outs[0], in) {
+		t.Fatalf("identity mismatch: %v vs %v", outs[0], in)
+	}
+
+	// second run with fresh values (ZeroCopy reuse contract)
+	in2 := f32bytes([]float32{7, 8, 9, 10, 11, 12})
+	outs2, err := p.Run([][]byte{in2})
+	if err != nil {
+		t.Fatalf("Run 2: %v", err)
+	}
+	if !bytes.Equal(outs2[0], in2) {
+		t.Fatal("identity mismatch on second run")
+	}
+
+	// wrong input size must error, not crash
+	if _, err := p.Run([][]byte{in[:8]}); err == nil {
+		t.Fatal("short input accepted")
+	}
+}

@@ -68,9 +68,15 @@ struct Table {
   int64_t mem_budget_per_shard = 0;
   std::string spill_dir;
 
-  size_t row_floats() const {
+  // row layout: [value dim][adagrad accum dim?][show, click] — the two
+  // trailing floats are the feature-lifecycle counters (reference
+  // CtrCommonAccessor show/click in distributed/table/
+  // common_sparse_table.h:170 + tensor_table.h:204 decay counters).
+  size_t stats_off() const {
     return opt == Opt::ADAGRAD ? 2 * (size_t)dim : (size_t)dim;
   }
+
+  size_t row_floats() const { return stats_off() + 2; }
 
   Shard& shard_of(int64_t key) {
     return shards[(uint64_t)key % kShards];
@@ -196,13 +202,94 @@ struct Table {
       }
     }
   }
+
+  // ---- feature lifecycle (reference common_sparse_table.h:170 shrink
+  // hook + CtrCommonAccessor show/click semantics) ------------------------
+
+  // accumulate per-feature show/click counts from the batch's samples
+  // (the reference feeds these from the data feed's label slots).
+  void record(const int64_t* keys, int64_t n, const float* shows,
+              const float* clicks) {
+    size_t so = stats_off();
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& sh = shard_of(keys[i]);
+      std::lock_guard<std::mutex> lk(sh.mu);
+      auto& row = lookup_init(keys[i], sh);
+      row[so] += shows ? shows[i] : 1.0f;
+      row[so + 1] += clicks ? clicks[i] : 0.0f;
+    }
+  }
+
+  // decay every feature's counters by `decay` and EVICT features whose
+  // score (show*show_coeff + click*click_coeff) fell below `threshold` —
+  // the reference's periodic shrink() pass that keeps a long-running CTR
+  // job's table bounded. Covers spilled rows too (their counters live in
+  // the spilled payload). Returns the number of evicted features.
+  int64_t shrink(float decay, float threshold, float show_coeff,
+                 float click_coeff) {
+    size_t so = stats_off();
+    size_t rf = row_floats();
+    int64_t evicted = 0;
+    std::vector<float> tmp(rf);
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> lk(sh.mu);
+      for (auto it = sh.rows.begin(); it != sh.rows.end();) {
+        auto& row = it->second;
+        row[so] *= decay;
+        row[so + 1] *= decay;
+        float score = row[so] * show_coeff + row[so + 1] * click_coeff;
+        if (score < threshold) {
+          it = sh.rows.erase(it);
+          size.fetch_sub(1);
+          ++evicted;
+        } else {
+          ++it;
+        }
+      }
+      if (sh.spill_fd >= 0) {
+        size_t bytes = rf * sizeof(float);
+        for (auto it = sh.disk_slot.begin(); it != sh.disk_slot.end();) {
+          ssize_t r = ::pread(sh.spill_fd, tmp.data(), bytes,
+                              (off_t)it->second * bytes);
+          if (r != (ssize_t)bytes) {  // unreadable: keep, don't corrupt
+            ++it;
+            continue;
+          }
+          tmp[so] *= decay;
+          tmp[so + 1] *= decay;
+          float score = tmp[so] * show_coeff + tmp[so + 1] * click_coeff;
+          if (score < threshold) {
+            sh.free_slots.push_back(it->second);
+            it = sh.disk_slot.erase(it);
+            size.fetch_sub(1);
+            ++evicted;
+          } else {
+            ssize_t w = ::pwrite(sh.spill_fd, tmp.data(), bytes,
+                                 (off_t)it->second * bytes);
+            if (w != (ssize_t)bytes) {
+              // disk-full/EIO: the counters stayed undecayed — report,
+              // or a cold spilled feature silently never expires
+              std::fprintf(stderr,
+                           "pskv: shrink write-back failed for key %lld\n",
+                           (long long)it->first);
+            }
+            ++it;
+          }
+        }
+      }
+    }
+    return evicted;
+  }
 };
 
 // ---------------- TCP service ----------------
-// frame: u32 op (1=pull, 2=push, 3=stop, 4=dim-handshake) | u32 n |
-//        n*i64 keys | [push: n*dim f32 grads]; reply to pull: n*dim f32;
+// frame: u32 op (1=pull, 2=push, 3=stop, 4=dim-handshake, 5=record,
+//        6=shrink) | u32 n | n*i64 keys | [push: n*dim f32 grads]
+//        [record: n*2 f32 show/click pairs]; reply to pull: n*dim f32;
 //        reply to op 4: u32 dim (n ignored) — lets clients validate the
-//        row width instead of deadlocking on a mismatched read size.
+//        row width instead of deadlocking on a mismatched read size;
+//        op 6 carries 4 f32 (decay, threshold, show_coeff, click_coeff)
+//        instead of keys (n ignored), reply: i64 evicted count.
 
 constexpr uint32_t kMaxFrameKeys = 1u << 24;  // 16M keys per frame
 
@@ -259,6 +346,14 @@ struct Server {
         if (!write_all(fd, &d, sizeof(d))) break;
         continue;
       }
+      if (op == 6) {  // shrink: 4 f32 args, no keys
+        float args[4];
+        if (!read_all(fd, args, sizeof(args))) break;
+        int64_t evicted =
+            table->shrink(args[0], args[1], args[2], args[3]);
+        if (!write_all(fd, &evicted, sizeof(evicted))) break;
+        continue;
+      }
       if (n > kMaxFrameKeys) break;  // malformed/hostile frame
       keys.resize(n);
       if (!read_all(fd, keys.data(), n * sizeof(int64_t))) break;
@@ -270,6 +365,17 @@ struct Server {
         vals.resize((size_t)n * table->dim);
         if (!read_all(fd, vals.data(), vals.size() * sizeof(float))) break;
         table->push(keys.data(), n, vals.data());
+        uint32_t ok = 0;
+        if (!write_all(fd, &ok, sizeof(ok))) break;
+      } else if (op == 5) {  // record show/click pairs
+        vals.resize((size_t)n * 2);
+        if (!read_all(fd, vals.data(), vals.size() * sizeof(float))) break;
+        std::vector<float> shows(n), clicks(n);
+        for (uint32_t i = 0; i < n; ++i) {
+          shows[i] = vals[2 * i];
+          clicks[i] = vals[2 * i + 1];
+        }
+        table->record(keys.data(), n, shows.data(), clicks.data());
         uint32_t ok = 0;
         if (!write_all(fd, &ok, sizeof(ok))) break;
       }
@@ -376,6 +482,18 @@ void pskv_push(void* tp, const int64_t* keys, int64_t n, const float* g) {
 
 void pskv_set_lr(void* tp, float lr) { static_cast<Table*>(tp)->lr = lr; }
 
+// ---- feature lifecycle ----
+void pskv_record(void* tp, const int64_t* keys, int64_t n,
+                 const float* shows, const float* clicks) {
+  static_cast<Table*>(tp)->record(keys, n, shows, clicks);
+}
+
+int64_t pskv_shrink(void* tp, float decay, float threshold,
+                    float show_coeff, float click_coeff) {
+  return static_cast<Table*>(tp)->shrink(decay, threshold, show_coeff,
+                                         click_coeff);
+}
+
 int64_t pskv_save(void* tp, const char* path) {
   auto* t = static_cast<Table*>(tp);
   // write-to-tmp + rename: a failed spill pread must never leave a
@@ -390,6 +508,11 @@ int64_t pskv_save(void* tp, const char* path) {
   std::fwrite(&t->dim, sizeof(int32_t), 1, f);
   int32_t opt = (int32_t)t->opt;
   std::fwrite(&opt, sizeof(int32_t), 1, f);
+  // row width in the header: a checkpoint from a build with a different
+  // row layout (e.g. pre-lifecycle, no show/click floats) must fail
+  // LOUDLY at load instead of misparsing keys as floats
+  int32_t rf32 = (int32_t)rf;
+  std::fwrite(&rf32, sizeof(int32_t), 1, f);
   std::vector<float> tmp(rf);
   for (auto& sh : t->shards) {
     std::lock_guard<std::mutex> lk(sh.mu);
@@ -433,10 +556,12 @@ int64_t pskv_load(void* tp, const char* path) {
   auto* t = static_cast<Table*>(tp);
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
-  int32_t dim = 0, opt = 0;
+  int32_t dim = 0, opt = 0, rf32 = 0;
   if (std::fread(&dim, sizeof(int32_t), 1, f) != 1 ||
       std::fread(&opt, sizeof(int32_t), 1, f) != 1 ||
-      dim != t->dim || opt != (int32_t)t->opt) {
+      std::fread(&rf32, sizeof(int32_t), 1, f) != 1 ||
+      dim != t->dim || opt != (int32_t)t->opt ||
+      rf32 != (int32_t)t->row_floats()) {
     std::fclose(f);
     return -1;
   }
@@ -536,6 +661,38 @@ int32_t pskv_client_push(void* cp, const int64_t* keys, int64_t n,
   uint32_t ok;
   if (!read_all(c->fd, &ok, sizeof(ok))) return -1;
   return (int32_t)ok;
+}
+
+int32_t pskv_client_record(void* cp, const int64_t* keys, int64_t n,
+                           const float* shows, const float* clicks) {
+  auto* c = static_cast<Client*>(cp);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t hdr[2] = {5, (uint32_t)n};
+  if (!write_all(c->fd, hdr, sizeof(hdr))) return -1;
+  if (!write_all(c->fd, keys, n * sizeof(int64_t))) return -1;
+  std::vector<float> pairs((size_t)n * 2);
+  for (int64_t i = 0; i < n; ++i) {
+    pairs[2 * i] = shows ? shows[i] : 1.0f;
+    pairs[2 * i + 1] = clicks ? clicks[i] : 0.0f;
+  }
+  if (!write_all(c->fd, pairs.data(), pairs.size() * sizeof(float)))
+    return -1;
+  uint32_t ok;
+  if (!read_all(c->fd, &ok, sizeof(ok))) return -1;
+  return (int32_t)ok;
+}
+
+int64_t pskv_client_shrink(void* cp, float decay, float threshold,
+                           float show_coeff, float click_coeff) {
+  auto* c = static_cast<Client*>(cp);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t hdr[2] = {6, 0};
+  if (!write_all(c->fd, hdr, sizeof(hdr))) return -1;
+  float args[4] = {decay, threshold, show_coeff, click_coeff};
+  if (!write_all(c->fd, args, sizeof(args))) return -1;
+  int64_t evicted = -1;
+  if (!read_all(c->fd, &evicted, sizeof(evicted))) return -1;
+  return evicted;
 }
 
 void pskv_client_close(void* cp) {

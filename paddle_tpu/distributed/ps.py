@@ -76,6 +76,19 @@ def _load():
         lib.pskv_client_close.argtypes = [ctypes.c_void_p]
         lib.pskv_client_remote_dim.restype = ctypes.c_int32
         lib.pskv_client_remote_dim.argtypes = [ctypes.c_void_p]
+        lib.pskv_record.argtypes = [ctypes.c_void_p, _i64p,
+                                    ctypes.c_int64, _f32p, _f32p]
+        lib.pskv_shrink.restype = ctypes.c_int64
+        lib.pskv_shrink.argtypes = [ctypes.c_void_p, ctypes.c_float,
+                                    ctypes.c_float, ctypes.c_float,
+                                    ctypes.c_float]
+        lib.pskv_client_record.restype = ctypes.c_int32
+        lib.pskv_client_record.argtypes = [ctypes.c_void_p, _i64p,
+                                           ctypes.c_int64, _f32p, _f32p]
+        lib.pskv_client_shrink.restype = ctypes.c_int64
+        lib.pskv_client_shrink.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_float, ctypes.c_float,
+                                           ctypes.c_float, ctypes.c_float]
         _lib = lib
         return lib
 
@@ -132,6 +145,40 @@ class SparseTable:
 
     def set_lr(self, lr):
         self._lib.pskv_set_lr(self._h, float(lr))
+
+    # ---- feature lifecycle (reference common_sparse_table.h:170
+    # shrink() + CtrCommonAccessor show/click counters) ------------------
+    def record(self, keys, shows=None, clicks=None):
+        """Accumulate per-feature show/click counts from a batch's
+        samples (shows defaults to 1 per occurrence, clicks to 0)."""
+        k, kp = _keys_arr(keys)
+        sp = cp = None
+        if shows is not None:
+            s = np.ascontiguousarray(
+                np.asarray(shows, np.float32).ravel())
+            if s.size != k.size:       # a stripped assert would let the
+                raise ValueError(      # native read run past the buffer
+                    f"shows has {s.size} entries for {k.size} keys")
+            sp = s.ctypes.data_as(_f32p)
+        if clicks is not None:
+            c = np.ascontiguousarray(
+                np.asarray(clicks, np.float32).ravel())
+            if c.size != k.size:
+                raise ValueError(
+                    f"clicks has {c.size} entries for {k.size} keys")
+            cp = c.ctypes.data_as(_f32p)
+        self._lib.pskv_record(self._h, kp, k.size, sp, cp)
+
+    def shrink(self, decay=0.98, threshold=1.0, show_coeff=1.0,
+               click_coeff=10.0):
+        """Decay every feature's show/click counters and EVICT features
+        whose score (show*show_coeff + click*click_coeff) fell below
+        `threshold` — the periodic pass that keeps a long-running CTR
+        job's table bounded (reference shrink + decay rate). Covers
+        SSD-spilled rows. Returns the evicted-feature count."""
+        return int(self._lib.pskv_shrink(
+            self._h, float(decay), float(threshold), float(show_coeff),
+            float(click_coeff)))
 
     def __len__(self):
         return int(self._lib.pskv_table_size(self._h))
@@ -242,6 +289,44 @@ class PSClient:
                 gb.ctypes.data_as(_f32p))
             if rc != 0:
                 raise OSError("push RPC failed")
+
+    def record(self, keys, shows=None, clicks=None):
+        """Remote show/click accumulation (routed like pull/push)."""
+        k, owner = self._route(keys)
+        s = (np.ascontiguousarray(np.asarray(shows, np.float32).ravel())
+             if shows is not None else np.ones(k.size, np.float32))
+        c = (np.ascontiguousarray(np.asarray(clicks, np.float32).ravel())
+             if clicks is not None else np.zeros(k.size, np.float32))
+        if s.size != k.size or c.size != k.size:
+            raise ValueError(
+                f"record: {k.size} keys but {s.size} shows / "
+                f"{c.size} clicks")
+        for sv, conn in enumerate(self._conns):
+            idx = np.nonzero(owner == sv)[0]
+            if idx.size == 0:
+                continue
+            sub = np.ascontiguousarray(k[idx])
+            ss = np.ascontiguousarray(s[idx])
+            cc = np.ascontiguousarray(c[idx])
+            rc = self._lib.pskv_client_record(
+                conn, sub.ctypes.data_as(_i64p), sub.size,
+                ss.ctypes.data_as(_f32p), cc.ctypes.data_as(_f32p))
+            if rc != 0:
+                raise OSError("record RPC failed")
+
+    def shrink(self, decay=0.98, threshold=1.0, show_coeff=1.0,
+               click_coeff=10.0):
+        """Run the lifecycle eviction pass on every server; returns the
+        total evicted count."""
+        total = 0
+        for conn in self._conns:
+            n = int(self._lib.pskv_client_shrink(
+                conn, float(decay), float(threshold), float(show_coeff),
+                float(click_coeff)))
+            if n < 0:
+                raise OSError("shrink RPC failed")
+            total += n
+        return total
 
     def close(self):
         for c in self._conns:

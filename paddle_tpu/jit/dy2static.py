@@ -702,6 +702,25 @@ class _EarlyExit:
                 s.orelse = self.rewrite_loops(s.orelse)
                 out.append(s)
                 continue
+            if isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                # loops WHOLLY inside a try/with convert normally (only
+                # exits that would cross the try/with boundary bail)
+                s.body = self.rewrite_loops(s.body)
+                for field in ("orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if sub:
+                        setattr(s, field, self.rewrite_loops(sub))
+                for h in getattr(s, "handlers", []) or []:
+                    h.body = self.rewrite_loops(h.body)
+                out.append(s)
+                continue
+            if isinstance(s, (ast.While, ast.For)) and s.orelse:
+                # loop/else: the loop itself stays plain Python, but
+                # loops nested in its bodies still convert
+                s.body = self.rewrite_loops(s.body)
+                s.orelse = self.rewrite_loops(s.orelse)
+                out.append(s)
+                continue
             if isinstance(s, (ast.While, ast.For)) and not s.orelse:
                 s.body = self.rewrite_loops(s.body)   # inner loops first
                 if isinstance(s, ast.For) and not _convertible_for(s):

@@ -691,3 +691,26 @@ def test_elif_chain_and_containers():
     np.testing.assert_allclose(_np(g(x)), 4.0)      # (x+0)+(x+2)
     xm = paddle.to_tensor(-np.ones((2,), np.float32))
     np.testing.assert_allclose(_np(g(xm)), 0.0)     # x+1
+
+
+def test_break_in_loop_inside_with_converts():
+    """A tensor loop WHOLLY inside a with-block still converts (only
+    exits crossing the try/with boundary bail — review r4)."""
+    import contextlib
+
+    def fn(x, bound):
+        acc = paddle.zeros_like(x)
+        with contextlib.nullcontext():
+            i = paddle.zeros([1], dtype="int32")
+            while i < bound:
+                if acc.mean() > 1.5:
+                    break
+                acc = acc + x
+                i = i + 1
+        return acc
+
+    f = to_static(fn)
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    b = paddle.to_tensor(np.asarray([10], np.int32))
+    np.testing.assert_allclose(_np(f(x, b)), _np(fn(x, b)))
+    np.testing.assert_allclose(_np(f(x, b)), 2.0 * np.ones(2))

@@ -146,3 +146,28 @@ def test_real_plugin_matches_python_predictor(tmp_path):
     (out,) = pred.run([x])
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
     pred.close()
+
+
+def test_go_api_roundtrip(tmp_path):
+    """Go serving wrapper (csrc/goapi/, reference goapi/lib.go analog):
+    build the mock plugin + libptp + an identity artifact, then drive
+    the cgo wrapper's own round-trip test. Gated on a go toolchain."""
+    import shutil
+    import subprocess
+    go = shutil.which("go")
+    if go is None:
+        pytest.skip("go toolchain not installed")
+    base = str(tmp_path / "m")
+    _write_artifact(base, ["input x0 f32 2,3", "output out0 f32 2,3"])
+    plugin = _mock_plugin()
+    libptp = native_lib_path("ptpredictor", source="predictor.cc",
+                             extra_flags=["-ldl"])
+    import pathlib
+    goapi = str(pathlib.Path(__file__).resolve().parent.parent
+                / "csrc" / "goapi")
+    env = dict(os.environ, PTP_ARTIFACT=base, PTP_PLUGIN=plugin,
+               PTP_LIB=libptp)
+    r = subprocess.run([go, "test", "-count=1", "./..."], cwd=goapi,
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
