@@ -448,12 +448,13 @@ def bench_gpt1_3b_full(on_tpu, peak):
         cfg = GPTConfig.gpt3_1_3b(max_seq_len=2048, dropout=0.0,
                                   attn_dropout=0.0, remat=True)
         # micro-batch 16 fits with remat (measured; per-micro MFU 0.585);
-        # K=8 accumulation -> 262k-token global batch (GPT-3 1.3B trains
-        # at ~1M, so this is conservative); warm=2 FULL rounds: round 0
-        # compiles micro+update, round 1 still pays donation rebinding
-        # (measured 59/33/12.4 s for rounds 0/1/2 at K=4 — steady state
-        # from round 2)
-        batch, seq, K, rounds, warm = 16, 2048, 8, 2, 2
+        # K=16 accumulation -> 524k-token global batch (GPT-3 1.3B trains
+        # at ~1M, so still conservative); K sweep at B=16: K=4 -> MFU
+        # .488, K=8 -> .536, K=16 -> .560 (update amortization). warm=2
+        # FULL rounds: round 0 compiles micro+update, round 1 still pays
+        # donation rebinding (measured 92/67/43.3 s for rounds 0/1/2 at
+        # K=16 — steady state from round 2)
+        batch, seq, K, rounds, warm = 16, 2048, 16, 2, 2
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=3,
                         num_heads=4, max_seq_len=128, dropout=0.0,

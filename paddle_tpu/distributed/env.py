@@ -85,21 +85,31 @@ def get_local_rank():
     return 0
 
 
-def param_sharding(param, mesh=None, extra_axis=None):
-    """NamedSharding for a parameter from its `mesh_axes` tag (set by
-    TP/MoE layers); `extra_axis` optionally adds ZeRO-style sharding over a
-    data axis on the first free divisible dim."""
-    mesh = mesh or _MESH
+def normalize_param_axes(param, mesh):
+    """The single tag->axes rule: pad/trim the param's `mesh_axes` tag
+    to its rank and drop axes that are absent from the mesh or don't
+    divide the dim (safety for tiny tests). Shared by `param_sharding`
+    and the pipeline's stacked-leaf shardings so the rules cannot
+    drift."""
     axes = list(getattr(param, "mesh_axes", None) or ())
     shape = tuple(param._value.shape)
     while len(axes) < len(shape):
         axes.append(None)
     axes = axes[:len(shape)]
-    # drop axes whose mesh size doesn't divide the dim (safety for tiny tests)
     for i, a in enumerate(axes):
         if a is not None and (a not in mesh.axis_names or
                               shape[i] % mesh.shape[a] != 0):
             axes[i] = None
+    return axes
+
+
+def param_sharding(param, mesh=None, extra_axis=None):
+    """NamedSharding for a parameter from its `mesh_axes` tag (set by
+    TP/MoE layers); `extra_axis` optionally adds ZeRO-style sharding over a
+    data axis on the first free divisible dim."""
+    mesh = mesh or _MESH
+    axes = normalize_param_axes(param, mesh)
+    shape = tuple(param._value.shape)
     if extra_axis is not None and extra_axis in mesh.axis_names and \
             mesh.shape[extra_axis] > 1 and extra_axis not in axes:
         for i, a in enumerate(axes):

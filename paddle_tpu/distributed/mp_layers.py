@@ -33,7 +33,31 @@ def _constrain(t, *axes):
         if a is not None and t._value.shape[i] % mesh.shape[a] != 0:
             axes[i] = None
     sh = NamedSharding(mesh, PartitionSpec(*axes))
-    return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), t)
+
+    def constrain(v):
+        if _in_manual_region():
+            # inside a shard_map manual region (e.g. the pipelined 1F1B
+            # executor, manual over pp): a full-mesh constraint cannot
+            # be applied to a manual-axis-varying value — drop the HINT;
+            # GSPMD still propagates the layers' param shardings through
+            # the auto axes
+            return v
+        return jax.lax.with_sharding_constraint(v, sh)
+    return apply(constrain, t)
+
+
+def _in_manual_region():
+    """Structural check for a surrounding shard_map manual region (not
+    error-message matching): the current abstract mesh carries per-axis
+    types, Manual meaning we are under manual collectives."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        manual = getattr(jax.sharding.AxisType, "Manual", None)
+        if manual is None or am is None:
+            return False
+        return any(t == manual for t in getattr(am, "axis_types", ()))
+    except Exception:
+        return False
 
 
 class VocabParallelEmbedding(Layer):

@@ -631,6 +631,29 @@ def _config_sig(layer, prefix=""):
     return tuple(out)
 
 
+def _stacked_sharding(p, mesh):
+    """NamedSharding for a BLOCK-STACKED leaf: leading block axis over
+    `pp`, remaining dims from the param's `mesh_axes` tag (TP layers tag
+    e.g. (None, "mp")) — so pp and mp compose: per-device block bytes =
+    total / (pp * mp). The tag->axes rules live in ONE place
+    (env.normalize_param_axes)."""
+    axes = env.normalize_param_axes(p, mesh)
+    while axes and axes[-1] is None:
+        axes.pop()
+    return NamedSharding(mesh, P("pp", *axes))
+
+
+def _stacked_state_sharding(stacked_leaf_shape, tp, stks_j, mesh):
+    """Sharding for one STACKED optimizer-state leaf: param-shaped
+    states ([L] + param shape, e.g. Adam moments) follow the param's
+    stacked sharding; anything else (stacked scalars -> [L]) shards the
+    block axis only. One rule for both the device_put in
+    _ensure_stacked and the jit in/out shardings."""
+    full = tuple(stacked_leaf_shape) == \
+        ((stacked_leaf_shape[0],) + tuple(tp._value.shape))
+    return stks_j if full else NamedSharding(mesh, P("pp"))
+
+
 def _stackable_sig(kind, item):
     """Homogeneity signature for run detection: type identity + the
     ordered (name, shape, dtype) parameter tree + the recursive scalar
@@ -804,8 +827,12 @@ class PipelineParallel(Layer):
                 return loss_fn(out, Tensor(y_mb))._value
 
         rep = NamedSharding(mesh, P())
-        stk = NamedSharding(mesh, P("pp"))
-        n_stack = len(template_params)
+        # per-leaf stacked shardings: pp over the block axis composes
+        # with the params' own mp tags; front/tail params keep their
+        # tag-derived (TP) shardings instead of full replication
+        stks = [_stacked_sharding(tp, mesh) for tp in template_params]
+        fr_sh = [env.param_sharding(p, mesh) for p in front_params]
+        tl_sh = [env.param_sharding(p, mesh) for p in tail_params]
 
         def pipelined_grads(front_vals, stack_vals, tail_vals, xv, yv, rng):
             key_cell[0] = rng
@@ -817,10 +844,8 @@ class PipelineParallel(Layer):
             return loss, gfront, pg, hg
 
         if optimizer is None:
-            in_sh = ([rep] * len(front_params), [stk] * n_stack,
-                     [rep] * len(tail_params), rep, rep, rep)
-            out_sh = (rep, [rep] * len(front_params), [stk] * n_stack,
-                      [rep] * len(tail_params))
+            in_sh = (fr_sh, stks, tl_sh, rep, rep, rep)
+            out_sh = (rep, fr_sh, stks, tl_sh)
             return jax.jit(pipelined_grads, in_shardings=in_sh,
                            out_shardings=out_sh)
 
@@ -846,12 +871,13 @@ class PipelineParallel(Layer):
                     new_states.append(ns)
             return loss, gfront, hg, new_vals, new_states
 
-        state_sh = [jax.tree_util.tree_map(lambda _: stk, st)
-                    for st in plan["stack_state_tmpl"]]
-        in_sh = ([rep] * len(front_params), [stk] * n_stack, state_sh,
-                 [rep] * len(tail_params), rep, rep, rep, rep)
-        out_sh = (rep, [rep] * len(front_params),
-                  [rep] * len(tail_params), [stk] * n_stack, state_sh)
+        state_sh = [
+            jax.tree_util.tree_map(
+                lambda v, j=j: _stacked_state_sharding(
+                    np.shape(v), template_params[j], stks[j], mesh), st)
+            for j, st in enumerate(plan["stack_state_tmpl"])]
+        in_sh = (fr_sh, stks, state_sh, tl_sh, rep, rep, rep, rep)
+        out_sh = (rep, fr_sh, tl_sh, stks, state_sh)
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=(1, 2))
 
@@ -863,7 +889,7 @@ class PipelineParallel(Layer):
         the views scattered after the last fused step."""
         rows = plan["block_param_rows"]
         tps = plan["template_params"]
-        stk = NamedSharding(mesh, P("pp"))
+        stks = [_stacked_sharding(tp, mesh) for tp in tps]
         cache = self._pipe_stack
         views = cache.get("views") if cache else None
         fresh = (
@@ -876,16 +902,19 @@ class PipelineParallel(Layer):
                    for i, r in enumerate(rows) for j in range(len(tps))))
         if not fresh:
             return cache
-        vals = [jax.device_put(jnp.stack([r[j]._value for r in rows]), stk)
+        vals = [jax.device_put(jnp.stack([r[j]._value for r in rows]),
+                               stks[j])
                 for j in range(len(tps))]
         states = []
         for j in range(len(tps)):
             per = [optimizer._get_state(r[j]) for r in rows]
             keys = list(per[0].keys())
-            states.append({
-                k: jax.device_put(
-                    jnp.stack([jnp.asarray(s[k]) for s in per]), stk)
-                for k in keys})
+
+            def put(k, j=j):
+                v = jnp.stack([jnp.asarray(s[k]) for s in per])
+                return jax.device_put(v, _stacked_state_sharding(
+                    v.shape, tps[j], stks[j], mesh))
+            states.append({k: put(k) for k in keys})
         plan["stack_state_tmpl"] = states
         cache = {"vals": vals, "states": states, "mesh": mesh,
                  "opt": optimizer, "views": None, "state_views": None}

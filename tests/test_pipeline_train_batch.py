@@ -354,6 +354,76 @@ def test_stackable_sig_rejects_config_mismatch(mesh):
     assert _stackable_sig("layer", a) == _stackable_sig("layer", c)
 
 
+def test_train_batch_pp_mp_composition():
+    """pp x mp on one mesh: blocks built from Column/Row-parallel
+    linears keep their mp tags in the STACKED leaves (leading pp axis +
+    tag axes), so per-device block bytes ~ total/(pp*mp) — and the loss
+    still matches the no-mesh sequential trajectory."""
+    from paddle_tpu.distributed.mp_layers import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+
+    PPX, MPX = 2, 2
+    D2 = 32
+
+    class MpBlock(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.ln = nn.LayerNorm(d)
+            self.fc1 = ColumnParallelLinear(d, 2 * d, gather_output=False)
+            self.fc2 = RowParallelLinear(2 * d, d, input_is_parallel=True)
+
+        def forward(self, x):
+            return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+    def build():
+        paddle.seed(17)
+        descs = ([LayerDesc(Embed, V, D2)]
+                 + [LayerDesc(MpBlock, D2) for _ in range(PPX * 2)]
+                 + [LayerDesc(Head, D2, V)])
+        return dist.PipelineLayer(descs, num_stages=PPX, loss_fn=_ce)
+
+    n_micro = 2
+    x, y = _data(n_micro, mb=2, seed=9)
+
+    dist_env.clear_mesh()
+    m_ref = build()
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m_ref.parameters())
+    loss_ref = pp_ref.train_batch((x, y), opt_ref)
+
+    m2 = dist.build_mesh(pp=PPX, mp=MPX, devices=jax.devices()[:PPX * MPX])
+    try:
+        m_pp = build()
+        pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+        opt_pp = paddle.optimizer.SGD(learning_rate=0.1,
+                                      parameters=m_pp.parameters())
+        loss_pp = pp_mod.train_batch((x, y), opt_pp)
+        assert pp_mod._pipe_plan != "none"
+        assert np.allclose(float(loss_pp.item()), float(loss_ref.item()),
+                           rtol=1e-4), (loss_pp.item(), loss_ref.item())
+        # the stacked fc weights must be pp AND mp sharded
+        from jax.sharding import PartitionSpec as P
+        cache = pp_mod._pipe_stack
+        tps = pp_mod._pipe_plan["template_params"]
+        fc_specs = [v.sharding.spec for v, tp in zip(cache["vals"], tps)
+                    if tuple(tp.shape) in ((D2, 2 * D2), (2 * D2, D2))]
+        assert fc_specs, "fc weights not found in the stack"
+        assert any("mp" in (s or ()) for spec in fc_specs
+                   for s in [tuple(spec)]), fc_specs
+        for v, tp in zip(cache["vals"], tps):
+            if tuple(tp.shape) == (D2, 2 * D2):      # column-parallel
+                shard_b = v.addressable_shards[0].data.nbytes
+                total_b = v.nbytes
+                assert shard_b * PPX * MPX == total_b, (shard_b, total_b)
+        for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(),
+                                      m_pp.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                       atol=3e-5, err_msg=n1)
+    finally:
+        dist_env.clear_mesh()
+
+
 def test_train_batch_warns_when_not_pipelineable(mesh):
     """A PipelineLayer with no >=pp homogeneous run must WARN (not
     silently skip pipelining) and still train correctly."""
