@@ -312,10 +312,10 @@ def _resnet_pipelined(model, opt, on_tpu, batch, steps, warmup):
     from paddle_tpu.io import DataLoader, Dataset
 
     rs = np.random.RandomState(1)
-    # one epoch must cover the loader-rate probe + warmup + timed steps
-    # + slack, or the timed window pays iterator re-creation (worker
-    # process respawn)
-    n_items = batch * (steps + warmup + 8)
+    # one epoch must cover the warm batches (2) + loader-rate probe (6)
+    # + warmup + timed steps + real slack, or the timed window pays
+    # iterator re-creation (worker process respawn)
+    n_items = batch * (steps + warmup + 12)
     raw = rs.randint(0, 256, (n_items, 3, 256, 256), dtype=np.uint8)
     labels = rs.randint(0, 1000, (n_items,)).astype(np.int32)
 
@@ -350,9 +350,14 @@ def _resnet_pipelined(model, opt, on_tpu, batch, steps, warmup):
     it = iter(loader)   # workers spawn ONCE, before any timing
 
     # host-transform-only rate: how fast the worker pipeline PRODUCES
-    # batches, independent of H2D. Under the dev tunnel the H2D hop is
-    # ~13 MB/s and dominates the end-to-end pipelined number; on real
-    # hardware (local PCIe) the pipeline bound is min(this, compute).
+    # batches, independent of H2D. Under the dev tunnel the H2D hop
+    # dominates the end-to-end pipelined number; on real hardware
+    # (local PCIe) the pipeline bound is min(this, compute). Warm TWO
+    # batches first — measuring from the very first next() charges
+    # worker spawn + first-fill to the steady-state rate (observed 84
+    # vs ~2000 img/s).
+    for _ in range(2):
+        next(it)
     t0 = time.perf_counter()
     k_loader = min(6, steps)
     for _ in range(k_loader):
