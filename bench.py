@@ -354,8 +354,9 @@ def _resnet_pipelined(model, opt, on_tpu, batch, steps, warmup):
     # dominates the end-to-end pipelined number; on real hardware
     # (local PCIe) the pipeline bound is min(this, compute). Warm TWO
     # batches first — measuring from the very first next() charges
-    # worker spawn + first-fill to the steady-state rate (observed 84
-    # vs ~2000 img/s).
+    # worker spawn + first-fill to the steady-state rate (measured 84
+    # cold vs ~560 warm img/s with 2 workers on the dev host,
+    # ROUND4_NOTES.md).
     for _ in range(2):
         next(it)
     t0 = time.perf_counter()

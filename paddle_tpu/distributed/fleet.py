@@ -60,14 +60,22 @@ def get_hybrid_communicate_group():
 
 
 def distributed_model(model):
-    """Place the model on the mesh per its parallel tags (reference wraps in
-    DataParallel/TensorParallel/PipelineParallel by topology; here placement
-    covers all of them)."""
+    """Place the model on the mesh per its parallel tags (reference
+    `fleet_base.py:881` wraps by topology: DataParallel/TensorParallel
+    dissolve into GSPMD placement here, but a PipelineLayer under a
+    pp>1 topology gets the PipelineParallel wrapper whose train_batch
+    runs the 1F1B pp-sharded executor)."""
     mesh = env.current_mesh()
     if mesh is None:
         init()
         mesh = env.current_mesh()
-    return shard_model(model, mesh)
+    model = shard_model(model, mesh)
+    from .pipeline import PipelineLayer, PipelineParallel
+    if isinstance(model, PipelineLayer) and mesh is not None \
+            and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+        return PipelineParallel(model, hcg=_state.hcg,
+                                strategy=_state.strategy)
+    return model
 
 
 class _DistributedOptimizer:

@@ -442,3 +442,35 @@ def test_train_batch_warns_when_not_pipelineable(mesh):
     assert any("no run" in str(w.message) or "SEQUENTIAL" in str(w.message)
                for w in rec)
     assert np.isfinite(float(loss.item()))
+
+
+def test_fleet_distributed_model_wraps_pipeline_layer():
+    """fleet.distributed_model under a pp topology returns the
+    PipelineParallel wrapper (reference fleet_base.py:881 topology
+    routing) and its train_batch engages the 1F1B executor."""
+    from paddle_tpu.distributed import fleet
+
+    dist_env.clear_mesh()
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": PP, "dp_degree": 1,
+                               "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        mesh = dist_env.current_mesh()
+        assert mesh is not None and mesh.shape["pp"] == PP
+        pl = _build(seed=23)
+        wrapped = fleet.distributed_model(pl)
+        assert isinstance(wrapped, dist.PipelineParallel)
+        assert wrapped._num_micro == 2
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=pl.parameters())
+        x, y = _data(n_micro=2, mb=2, seed=12)
+        loss = wrapped.train_batch((x, y), opt)
+        assert wrapped._pipe_plan != "none"
+        assert np.isfinite(float(loss.item()))
+        # plain (non-PipelineLayer) models keep GSPMD placement only
+        plain = Block(D)
+        assert fleet.distributed_model(plain) is plain
+    finally:
+        dist_env.clear_mesh()
