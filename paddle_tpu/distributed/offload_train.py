@@ -186,6 +186,21 @@ class OffloadTrainStep:
         return fn
 
     # ---- driver ---------------------------------------------------------
+    def _repin(self, st):
+        """States mutated OUT-OF-BAND (set_state_dict on checkpoint
+        restore) arrive as plain arrays; the jitted chunk update
+        declares pinned_host in_shardings, so re-pin anything that lost
+        the host memory kind."""
+        if not self._offload:
+            return st
+        out = {}
+        for k, v in st.items():
+            mk = getattr(getattr(v, "sharding", None), "memory_kind",
+                         None)
+            out[k] = v if mk == "pinned_host" else \
+                jax.device_put(jnp.asarray(v), self._host_sh)
+        return out
+
     def _apply_update(self):
         opt = self.optimizer
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -193,7 +208,8 @@ class OffloadTrainStep:
             fn = self._chunk_update_fn(idxs)
             pvals = [self.params[i]._value for i in idxs]
             accs = [self._acc[i] for i in idxs]
-            states = [opt._states[id(self.params[i])] for i in idxs]
+            states = [self._repin(opt._states[id(self.params[i])])
+                      for i in idxs]
             new_vals, new_states, zeroed = fn(pvals, accs, states, lr)
             for i, v, a, st in zip(idxs, new_vals, zeroed, new_states):
                 self.params[i]._value = v
