@@ -711,6 +711,8 @@ class PipelineParallel(Layer):
         self._pipe_step = None
         self._pipe_step_key = None
         self._pipe_stack = None
+        self._eval_fn = None
+        self._eval_key = None
 
     def forward(self, x):
         return self._layers(x)
@@ -766,13 +768,59 @@ class PipelineParallel(Layer):
             front_params=self._collect_params(front),
             tail_params=self._collect_params(tail))
 
-    def _build_pipelined_step(self, plan, mesh, n_micro, optimizer=None):
-        """Jit the whole pipelined step. With `optimizer` (fused mode —
-        no scaler/clip), the block-parameter optimizer update runs
-        IN-JIT on the pp-sharded stacked leaves (vmapped over the block
-        axis), so the full block weight set never round-trips through
-        per-layer tensors between steps; front/tail grads return for the
-        eager optimizer. Without, all grads return raw."""
+    def _resolve_plan(self, pp, mesh):
+        """Resolve (and cache) the pipeline plan for this mesh; warns
+        ONCE when no homogeneous run exists — whichever of
+        train_batch/eval_batch resolves first."""
+        if self._pipe_plan is None or self._pipe_pp != (pp, mesh):
+            self._pipe_plan = self._plan_pipeline(pp) or "none"
+            self._pipe_pp = (pp, mesh)
+            if self._pipe_plan == "none":
+                warnings.warn(
+                    f"PipelineParallel: mesh has pp={pp} but the "
+                    "PipelineLayer has no run of >= pp consecutive "
+                    "identical-architecture layers to pipeline; "
+                    "train_batch/eval_batch run SEQUENTIALLY on every "
+                    "device (no pipeline parallelism)")
+        return None if self._pipe_plan == "none" else self._pipe_plan
+
+    @staticmethod
+    def _param_tree_sig(plan):
+        return tuple(
+            (tuple(p.shape), str(p.dtype))
+            for p in (plan["front_params"] + plan["template_params"]
+                      + plan["tail_params"]))
+
+    def _stack_is_fresh(self, plan, mesh, optimizer=None):
+        """Identity check: the persistent stacked cache matches the live
+        per-layer tensors (and, when `optimizer` is given, its states).
+        One predicate for _ensure_stacked and eval_batch."""
+        cache = self._pipe_stack
+        if cache is None or cache.get("mesh") is not mesh or \
+                cache.get("views") is None:
+            return False
+        rows = plan["block_param_rows"]
+        n = len(plan["template_params"])
+        views = cache["views"]
+        if any(r[j]._value is not views[i][j]
+               for i, r in enumerate(rows) for j in range(n)):
+            return False
+        if optimizer is not None:
+            if cache.get("opt") is not optimizer:
+                return False
+            sviews = cache["state_views"]
+            if sviews is None or any(
+                    optimizer._states.get(id(r[j])) is not sviews[i][j]
+                    for i, r in enumerate(rows) for j in range(n)):
+                return False
+        return True
+
+    def _section_closures(self, plan):
+        """Pure-jax closures over the plan's three sections, shared by
+        the train-step builder and the eval builder. Returns
+        (front_fn, stage_fn, head_loss_fn, tail_out_fn, key_cell) —
+        key_cell[0] must be set to the per-call PRNG key inside the jit
+        trace before any section runs."""
         from ..core import autograd
         from ..core.random import rng_guard
         from ..jit import bind_tensors
@@ -819,12 +867,37 @@ class PipelineParallel(Layer):
             out, _ = jax.lax.scan(body, h, (list(stack_vals), idx))
             return out
 
-        def head_loss_fn(tail_vals, h, y_mb):
+        def tail_apply(tail_vals, h, fn):
+            # ONE tail-execution context shared by the train loss and the
+            # eval output so the two can never desync
             with autograd.fresh_tape(), autograd.no_grad(), \
                     bind_tensors(tail_params, tail_vals), \
                     rng_guard(jax.random.fold_in(key_cell[0], 2 ** 20 + 1)):
-                out = run_items(tail, Tensor(h))
-                return loss_fn(out, Tensor(y_mb))._value
+                return fn(run_items(tail, Tensor(h)))
+
+        def head_loss_fn(tail_vals, h, y_mb):
+            return tail_apply(tail_vals, h,
+                              lambda o: loss_fn(o, Tensor(y_mb))._value)
+
+        def tail_out_fn(tail_vals, h):
+            return tail_apply(tail_vals, h, lambda o: o._value)
+
+        return front_fn, stage_fn, head_loss_fn, tail_out_fn, key_cell
+
+    def _build_pipelined_step(self, plan, mesh, n_micro, optimizer=None):
+        """Jit the whole pipelined step. With `optimizer` (fused mode —
+        no scaler/clip), the block-parameter optimizer update runs
+        IN-JIT on the pp-sharded stacked leaves (vmapped over the block
+        axis), so the full block weight set never round-trips through
+        per-layer tensors between steps; front/tail grads return for the
+        eager optimizer. Without, all grads return raw."""
+        from ..core import autograd
+
+        front_params = plan["front_params"]
+        tail_params = plan["tail_params"]
+        template_params = plan["template_params"]
+        front_fn, stage_fn, head_loss_fn, _, key_cell = \
+            self._section_closures(plan)
 
         rep = NamedSharding(mesh, P())
         # per-leaf stacked shardings: pp over the block axis composes
@@ -891,16 +964,7 @@ class PipelineParallel(Layer):
         tps = plan["template_params"]
         stks = [_stacked_sharding(tp, mesh) for tp in tps]
         cache = self._pipe_stack
-        views = cache.get("views") if cache else None
-        fresh = (
-            cache is None or cache.get("mesh") is not mesh
-            or cache.get("opt") is not optimizer
-            or any(r[j]._value is not views[i][j]
-                   for i, r in enumerate(rows) for j in range(len(tps)))
-            or any(optimizer._states.get(id(r[j])) is not
-                   cache["state_views"][i][j]
-                   for i, r in enumerate(rows) for j in range(len(tps))))
-        if not fresh:
+        if self._stack_is_fresh(plan, mesh, optimizer):
             return cache
         vals = [jax.device_put(jnp.stack([r[j]._value for r in rows]),
                                stks[j])
@@ -955,12 +1019,9 @@ class PipelineParallel(Layer):
             optimizer._grad_clip is None
         if scaler is not None and not scaler.is_enable():
             scaler = None
-        tree_sig = tuple(
-            (tuple(p.shape), str(p.dtype))
-            for p in (plan["front_params"] + plan["template_params"]
-                      + plan["tail_params"]))
         key = (xv.shape, str(xv.dtype), yv.shape, str(yv.dtype), n_micro,
-               tree_sig, fused, mesh, id(optimizer) if fused else None)
+               self._param_tree_sig(plan), fused, mesh,
+               id(optimizer) if fused else None)
         rng = default_generator().split()
 
         grads = {}
@@ -1007,8 +1068,13 @@ class PipelineParallel(Layer):
         front_vals = [p._value for p in plan["front_params"]]
         tail_vals = [p._value for p in plan["tail_params"]]
         rows = plan["block_param_rows"]
-        stack_vals = [jnp.stack([r[j]._value for r in rows])
-                      for j in range(len(plan["template_params"]))]
+        # explicit placement: rows may mix committed view slices (from a
+        # previous fused step) with fresh arrays, and committed args must
+        # match the jit's declared stacked shardings
+        stack_vals = [
+            jax.device_put(jnp.stack([r[j]._value for r in rows]),
+                           _stacked_sharding(tp, mesh))
+            for j, tp in enumerate(plan["template_params"])]
         loss, gfront, gstack, gtail = self._pipe_step(
             front_vals, stack_vals, tail_vals, xv, yv, rng)
         for p, g in zip(plan["front_params"], gfront):
@@ -1034,12 +1100,107 @@ class PipelineParallel(Layer):
             lr_scheduler.step()
         return Tensor(loss)
 
+    def _build_eval_fn(self, plan, mesh, n_micro):
+        """Forward-only pipelined pass: front -> GPipe pipeline over the
+        stacked blocks -> tail, jitted with the same shardings as the
+        train step."""
+        front_fn, stage_fn, _, tail_out_fn, key_cell = \
+            self._section_closures(plan)
+        rep = NamedSharding(mesh, P())
+        stks = [_stacked_sharding(tp, mesh)
+                for tp in plan["template_params"]]
+        fr_sh = [env.param_sharding(p, mesh)
+                 for p in plan["front_params"]]
+        tl_sh = [env.param_sharding(p, mesh)
+                 for p in plan["tail_params"]]
+
+        def fwd(front_vals, stack_vals, tail_vals, xv, rng):
+            key_cell[0] = rng
+            h = front_fn(front_vals, xv)
+            out = pipeline_apply(stage_fn, stack_vals, h, n_micro,
+                                 mesh=mesh)
+            return tail_out_fn(tail_vals, out)
+
+        return jax.jit(fwd, in_shardings=(fr_sh, stks, tl_sh, rep, rep),
+                       out_shardings=rep)
+
+    def eval_batch(self, data, compute_loss=False):
+        """Forward-only microbatched pass (reference
+        `pipeline_parallel.py:170` eval_batch): puts the layers in eval
+        mode; returns the batch loss when `compute_loss` (mean of the
+        equal-sized microbatch losses == full-batch mean) else the
+        concatenated outputs. On a pp>1 mesh with a pipelineable plan
+        the stacked run rides the GPipe pipeline executor."""
+        from ..core import autograd
+        self._layers.eval()
+        if isinstance(data, (tuple, list)):
+            x = data[0]
+            y = data[1] if len(data) > 1 else None
+        else:
+            x, y = data, None
+        if compute_loss and y is None:
+            raise ValueError("eval_batch(compute_loss=True) needs (x, y)")
+        n_micro = max(1, self._num_micro)
+        bsz = x.shape[0]
+        if bsz % n_micro != 0:
+            raise ValueError(f"batch size {bsz} not divisible by "
+                             f"accumulate_steps {n_micro}")
+        mesh = env.current_mesh()
+        pp = (mesh.shape["pp"]
+              if mesh is not None and "pp" in mesh.axis_names else 1)
+        plan = self._resolve_plan(pp, mesh) if pp > 1 else None
+        with autograd.no_grad():
+            if plan is not None:
+                xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                key = (xv.shape, str(xv.dtype), n_micro, mesh,
+                       self._param_tree_sig(plan))
+                if self._eval_fn is None or self._eval_key != key:
+                    self._eval_fn = self._build_eval_fn(plan, mesh,
+                                                        n_micro)
+                    self._eval_key = key
+                rows = plan["block_param_rows"]
+                self._eval_used_cache = self._stack_is_fresh(plan, mesh)
+                if self._eval_used_cache:
+                    stack_vals = self._pipe_stack["vals"]
+                else:
+                    # explicit placement: rows may mix committed view
+                    # slices (from a previous fused step) with fresh
+                    # arrays, and committed args must match the jit's
+                    # declared stacked shardings
+                    stack_vals = [
+                        jax.device_put(
+                            jnp.stack([r[j]._value for r in rows]),
+                            _stacked_sharding(tp, mesh))
+                        for j, tp in enumerate(plan["template_params"])]
+                # constant key: eval-mode dropout consumes no randomness,
+                # and drawing from the global generator here would shift
+                # subsequent TRAIN dropout masks (trajectory must not
+                # depend on interleaved evals)
+                out = Tensor(self._eval_fn(
+                    [p._value for p in plan["front_params"]], stack_vals,
+                    [p._value for p in plan["tail_params"]], xv,
+                    jax.random.PRNGKey(0)))
+            else:
+                mb = bsz // n_micro
+                outs = [self._layers(x[i * mb:(i + 1) * mb])
+                        for i in range(n_micro)]
+                out = Tensor(jnp.concatenate([o._value for o in outs], 0))
+            if compute_loss:
+                loss_fn = self._layers._loss_fn
+                if loss_fn is None:
+                    raise ValueError(
+                        "eval_batch(compute_loss=True) requires the "
+                        "PipelineLayer to be built with loss_fn=...")
+                return loss_fn(out, y)
+            return out
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Gradient-accumulated microbatch step (reference
         `pipeline_parallel.py:80` train_batch semantics: the global batch is
         split into `accumulate_steps` microbatches, grads accumulate across
         them, one optimizer step at the end). On a pp>1 mesh the step runs
         the 1F1B pp-sharded executor (see class docstring)."""
+        self._layers.train()   # reference train_batch:81 resets the mode
         x, y = data
         loss_fn = self._layers._loss_fn
         if loss_fn is None:
@@ -1055,19 +1216,10 @@ class PipelineParallel(Layer):
         pp = (mesh.shape["pp"]
               if mesh is not None and "pp" in mesh.axis_names else 1)
         if pp > 1:
-            if self._pipe_plan is None or self._pipe_pp != (pp, mesh):
-                self._pipe_plan = self._plan_pipeline(pp) or "none"
-                self._pipe_pp = (pp, mesh)
-                if self._pipe_plan == "none":
-                    warnings.warn(
-                        f"PipelineParallel: mesh has pp={pp} but the "
-                        "PipelineLayer has no run of >= pp consecutive "
-                        "identical-architecture layers to pipeline; "
-                        "train_batch runs SEQUENTIAL gradient accumulation "
-                        "on every device (no pipeline parallelism)")
-            if self._pipe_plan != "none":
+            plan = self._resolve_plan(pp, mesh)
+            if plan is not None:
                 return self._train_batch_1f1b(
-                    self._pipe_plan, mesh, x, y, n_micro, optimizer,
+                    plan, mesh, x, y, n_micro, optimizer,
                     lr_scheduler, scaler)
         mb = bsz // n_micro
         total = None

@@ -474,3 +474,125 @@ def test_fleet_distributed_model_wraps_pipeline_layer():
         assert fleet.distributed_model(plain) is plain
     finally:
         dist_env.clear_mesh()
+
+
+def test_eval_batch_pipelined_matches_sequential(mesh):
+    """eval_batch (reference pipeline_parallel.py:170): forward-only
+    pipelined pass must match the no-mesh sequential forward, both as
+    raw outputs and as compute_loss=True."""
+    n_micro = 4
+    x, y = _data(n_micro, seed=14)
+
+    dist_env.clear_mesh()
+    m_ref = _build(seed=41)
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    out_ref = pp_ref.eval_batch((x,))
+    loss_ref = pp_ref.eval_batch((x, y), compute_loss=True)
+
+    dist_env.set_mesh(mesh)
+    m_pp = _build(seed=41)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    out_pp = pp_mod.eval_batch((x,))
+    assert pp_mod._pipe_plan != "none"
+    np.testing.assert_allclose(out_pp.numpy(), out_ref.numpy(),
+                               rtol=2e-4, atol=2e-5)
+    loss_pp = pp_mod.eval_batch((x, y), compute_loss=True)
+    assert np.allclose(float(loss_pp.item()), float(loss_ref.item()),
+                       rtol=1e-4)
+    # train_batch must still work after eval (mode reset, caches intact)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m_pp.parameters())
+    tl = pp_mod.train_batch((x, y), opt)
+    assert np.isfinite(float(tl.item()))
+    assert m_pp.training
+
+
+def test_eval_batch_uses_persistent_stack_after_training(mesh):
+    """After fused train steps, eval_batch must read the PERSISTENT
+    pp-sharded stack (not a stale restack of the view tensors)."""
+    n_micro = 2
+    x, y = _data(n_micro, seed=15)
+    m_pp = _build(seed=43)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m_pp.parameters())
+    pp_mod.train_batch((x, y), opt)
+    out1 = pp_mod.eval_batch((x,))
+    # the fused train step left a FRESH persistent stack: eval must read
+    # it directly (identity, not just numerics)
+    assert pp_mod._eval_used_cache is True
+    # out-of-band mutation invalidates the cache -> eval restacks
+    blk = pp_mod._pipe_plan["blocks"][0]
+    blk.fc1.weight.set_value(blk.fc1.weight.numpy() * 1.0)
+    pp_mod.eval_batch((x,))
+    assert pp_mod._eval_used_cache is False
+    # sequential reference after identical training trajectory
+    dist_env.clear_mesh()
+    m_ref = _build(seed=43)
+    pp_ref = dist.PipelineParallel(m_ref, strategy=_strategy(n_micro))
+    opt_r = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m_ref.parameters())
+    pp_ref.train_batch((x, y), opt_r)
+    out_ref = pp_ref.eval_batch((x,))
+    np.testing.assert_allclose(out1.numpy(), out_ref.numpy(),
+                               rtol=2e-4, atol=3e-5)
+    dist_env.set_mesh(mesh)
+
+
+def test_eval_batch_does_not_consume_train_rng(mesh):
+    """Interleaving eval_batch between train steps must not shift the
+    training trajectory (eval uses a constant PRNG key — review r4):
+    with dropout>0, losses with and without an interleaved eval match."""
+    n_micro = 2
+    x, y = _data(n_micro, seed=16)
+
+    def run(with_eval):
+        paddle.seed(99)
+        m = _build(seed=47, dropout=0.2)
+        pp_mod = dist.PipelineParallel(m, strategy=_strategy(n_micro))
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        l1 = float(pp_mod.train_batch((x, y), opt).item())
+        if with_eval:
+            pp_mod.eval_batch((x,))
+        l2 = float(pp_mod.train_batch((x, y), opt).item())
+        return l1, l2
+
+    a = run(False)
+    b = run(True)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_eval_first_still_warns_when_not_pipelineable(mesh):
+    """Resolving the plan from eval_batch first must not swallow the
+    no-pipeline warning (review r4)."""
+    paddle.seed(2)
+    pl = dist.PipelineLayer(
+        [nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2)],
+        num_stages=PP, loss_fn=lambda out, y: F.cross_entropy(out, y))
+    pp_mod = dist.PipelineParallel(pl, strategy=_strategy(2))
+    x = paddle.randn([8, 4])
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pp_mod.eval_batch((x,))
+    assert any("SEQUENTIAL" in str(w.message) for w in rec)
+
+
+def test_scaler_step_after_fused_step(mesh):
+    """Switching from the fused path to the scaler (non-fused) path
+    mid-training: the restack must handle committed view slices from
+    the fused step (explicit placement — review r4)."""
+    n_micro = 2
+    x, y = _data(n_micro, seed=18)
+    m_pp = _build(seed=51)
+    pp_mod = dist.PipelineParallel(m_pp, strategy=_strategy(n_micro))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m_pp.parameters())
+    pp_mod.train_batch((x, y), opt)                      # fused
+    loss = pp_mod.train_batch((x, y), opt,               # non-fused
+                              scaler=paddle.amp.GradScaler(
+                                  init_loss_scaling=256.0))
+    assert np.isfinite(float(loss.item()))
+    # and back to fused (stack rebuilt after the eager optimizer step)
+    loss2 = pp_mod.train_batch((x, y), opt)
+    assert np.isfinite(float(loss2.item()))
