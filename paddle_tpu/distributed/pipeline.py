@@ -713,6 +713,7 @@ class PipelineParallel(Layer):
         self._pipe_stack = None
         self._eval_fn = None
         self._eval_key = None
+        self._eval_used_cache = False
 
     def forward(self, x):
         return self._layers(x)
