@@ -1,0 +1,86 @@
+"""13B-on-one-chip serving proof (run manually: python tools/serve_13b_w8a16.py).
+
+Demonstrates BASELINE config 5's model scale on the SERVING side with a
+single 16 GB v5e chip: the TRUE gpt3-13B dims (hidden 5120, ffn 20480,
+40 layers, 40 heads, vocab 50304 — 12.844B params) decode greedily under
+W8A16 (quant/wo8.py weight-only int8 linears, bf16 activations).
+
+Recipe (the part that matters — reference analog is the int8 deploy
+pipeline, `contrib/slim/quantization/post_training_quantization.py`,
+re-shaped for a host-RAM-bounded single chip):
+ 1. Build the f32 model ON THE HOST CPU DEVICE (`jax.default_device`):
+    52 GB f32 never touches the 16 GB chip.
+ 2. quantize_weights_int8 on host (per-output-channel symmetric int8).
+ 3. Move only the SERVING SET to the chip: int8 tables as-is, float
+    params cast bf16 first — 12.21 GiB on-chip.
+ 4. model.generate compiles the whole decode (prefill + while_loop)
+    into one XLA program; w_scale casts to bf16 in-trace.
+
+Measured (v5e-1, r4): build 802 s (host f32 init), quantize 218 s,
+H2D 61 s, decode compile 18 s, then 64 greedy tokens in 1.34 s =
+47.8 tok/s at batch 1 (decode is weight-bandwidth-bound:
+12.2 GiB/step-sweep at ~0.9 TB/s HBM -> ~75 tok/s roofline; measured
+sits at 64% of it). max_seq_len bounds the bf16 KV cache (256 here ->
+0.52 GiB).
+"""
+import time
+
+import numpy as np
+
+
+def main():
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.quant import quantize_weights_int8
+
+    cpu = jax.devices("cpu")[0]
+    tpu = jax.devices()[0]
+    cfg = GPTConfig.gpt3_13b(max_seq_len=256, dropout=0.0,
+                             dtype="bfloat16")
+    paddle.seed(0)
+    with jax.default_device(cpu):
+        print("building 13B f32 on host cpu (~13 min)...", flush=True)
+        model = GPTForPretraining(cfg)
+        n = sum(int(np.prod(p.shape)) for p in model.parameters())
+        print(f"params: {n / 1e9:.3f}B ({time.time() - t0:.0f}s)",
+              flush=True)
+        t1 = time.time()
+        k = quantize_weights_int8(model)
+        print(f"quantized {k} linears ({time.time() - t1:.0f}s)",
+              flush=True)
+
+    t2 = time.time()
+    moved = 0
+    for p in model.parameters():
+        v = p._value
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(jnp.bfloat16)
+        p._value = jax.device_put(v, tpu)
+        moved += p._value.nbytes
+    for b in model.buffers():
+        b._value = jax.device_put(b._value, tpu)
+        moved += b._value.nbytes
+    jax.block_until_ready(model.parameters()[0]._value)
+    print(f"moved {moved / 2 ** 30:.2f} GiB to chip "
+          f"({time.time() - t2:.0f}s)", flush=True)
+
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (1, 64)),
+                           "int32")
+    t3 = time.time()
+    out, _ = model.generate(ids, max_new_tokens=64)
+    float(out.sum().item())
+    print(f"first decode (incl. compile): {time.time() - t3:.0f}s",
+          flush=True)
+    t4 = time.time()
+    out, _ = model.generate(ids, max_new_tokens=64)
+    float(out.sum().item())
+    dt = time.time() - t4
+    print(f"13B W8A16 decode: {64 / dt:.1f} tok/s (B1)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
