@@ -65,6 +65,14 @@ _register(
     "(tools/tpu_microbench.py attn:128,256,512): XLA wins at <=256, "
     "Pallas 1.77x at 512, 2.6x at 1024, 3.0x at 2048.")
 _register(
+    "use_pallas_decode_attention", True, bool,
+    "Use the fused Pallas decode-attention kernel (ops/pallas_decode.py)"
+    " for q_len==1 KV-cache attention when shapes qualify (TPU, cache "
+    "len %8==0, n_heads*head_dim %128==0). One kernel per layer instead "
+    "of the einsum+mask+softmax+einsum chain; measured 91 vs 117 us per "
+    "call at B=64/L=256 and end-to-end decode tok/s recorded in "
+    "ROUND4_NOTES.")
+_register(
     "use_fused_ce", False, bool,
     "Use the chunked fused projection+cross-entropy for LM losses "
     "(ops/fused_ce.py): the full-vocab logits tensor is never "

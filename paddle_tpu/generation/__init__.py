@@ -302,10 +302,12 @@ def run_generate(model, input_ids, max_new_tokens=32,
     # old trace's order and scramble weights silently. The sig tuple
     # itself is the key component (a hash could collide -> scramble).
     tree_sig = tuple((n, tuple(p.shape), str(p.dtype)) for n, p in named)
+    from ..flags import get_flag
     key = (b, s0, int(max_new_tokens), decode_strategy, int(top_k),
            float(top_p), float(temperature), int(num_beams),
            float(length_penalty), eos_token_id, int(pad_token_id),
-           str(dtype), tree_sig)
+           str(dtype), bool(get_flag("use_pallas_decode_attention")),
+           tree_sig)
     cache = model.__dict__.setdefault("_generate_cache", {})
     # evict traces built against a DIFFERENT tree: their closures pin
     # the replaced parameter set (e.g. the pre-quantize bf16 weights)

@@ -118,12 +118,15 @@ class GPTAttention(Layer):
                 mesh.shape["sp"] > 1)
 
     def forward(self, x, cache=None, offset=None):
-        """cache: optional (k_buf, v_buf) Tensors of FIXED shape
-        [b, max_len, n, h]; offset: scalar int Tensor/int — how many cache
-        positions are already filled. Fixed-size buffers +
-        `lax.dynamic_update_slice` keep decode shapes static so XLA compiles
-        the step once (the TPU answer to the reference's growing-concat
-        decode caches, `fluid/layers/rnn.py:1583` dynamic_decode)."""
+        """cache: optional (k_buf, v_buf) Tensors of FIXED shape —
+        FLAT [b, max_len, n*h] on the fused pallas decode path, 4-D
+        [b, max_len, n, h] on the composed path (build them with
+        GPTModel.init_cache, which owns the layout decision); offset:
+        scalar int Tensor/int — how many cache positions are already
+        filled. Fixed-size buffers + `lax.dynamic_update_slice` keep
+        decode shapes static so XLA compiles the step once (the TPU
+        answer to the reference's growing-concat decode caches,
+        `fluid/layers/rnn.py:1583` dynamic_decode)."""
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
@@ -153,22 +156,46 @@ class GPTAttention(Layer):
 def _cached_attention(q, k_new, v_new, k_buf, v_buf, off):
     """Incremental-decode attention on raw values: write k/v at `off`, attend
     q (s tokens at positions off..off+s) over the valid prefix via masking.
-    O(max_len) per step — the standard KV-cache decode cost."""
+    O(max_len) per step — the standard KV-cache decode cost. The cache
+    layout (see init_cache) picks the path: FLAT [b, L, n*h] buffers run
+    the fused pallas kernel for q_len==1 steps; 4-D buffers run the
+    composed einsums. Neither path reshapes the carried buffers."""
     import jax
     b, s, n, h = q.shape
     L = k_buf.shape[1]
-    k_buf = jax.lax.dynamic_update_slice(
-        k_buf, k_new.astype(k_buf.dtype), (0, off, 0, 0))
-    v_buf = jax.lax.dynamic_update_slice(
-        v_buf, v_new.astype(v_buf.dtype), (0, off, 0, 0))
+    if k_buf.ndim == 3:
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, k_new.reshape(b, s, n * h).astype(k_buf.dtype),
+            (0, off, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, v_new.reshape(b, s, n * h).astype(v_buf.dtype),
+            (0, off, 0))
+        if s == 1:
+            # one fused kernel for the whole per-layer decode attention
+            # (ops/pallas_decode.py): the einsum+mask+softmax+einsum
+            # chain is the kernel-count bottleneck at serving batches
+            from ..ops.pallas_decode import decode_attention
+            out = decode_attention(q.reshape(b, 1, n * h), k_buf, v_buf,
+                                   off, n).astype(q.dtype)
+            return out.reshape(b, 1, n, h), k_buf, v_buf
+        # prefill (s > 1) happens once per sequence: the reshape cost is
+        # paid once, not per generated token
+        k4 = k_buf.reshape(b, L, n, h)
+        v4 = v_buf.reshape(b, L, n, h)
+    else:
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, k_new.astype(k_buf.dtype), (0, off, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, v_new.astype(v_buf.dtype), (0, off, 0, 0))
+        k4, v4 = k_buf, v_buf
     scale = 1.0 / math.sqrt(h)
-    logits = jnp.einsum("bqnh,bknh->bnqk", q, k_buf.astype(q.dtype),
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k4.astype(q.dtype),
                         preferred_element_type=jnp.float32) * scale
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
     q_pos = (off + jnp.arange(s, dtype=jnp.int32))[None, None, :, None]
     logits = jnp.where(key_pos <= q_pos, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bnqk,bknh->bqnh", probs, v_buf.astype(q.dtype))
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v4.astype(q.dtype))
     return out, k_buf, v_buf
 
 
@@ -229,11 +256,29 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(c.hidden_size)
 
     def init_cache(self, batch_size, max_len, dtype=None):
-        """Fixed-shape KV buffers, one (k, v) pair per block."""
+        """Fixed-shape KV buffers, one (k, v) pair per block. Layout
+        follows the decode-attention path: FLAT [b, max_len, n*h] when
+        the fused pallas kernel will run (it needs reshape-free access
+        to the loop-carried buffers — a reshaped view fed to
+        pallas_call copies the whole cache per layer per step), 4-D
+        [b, max_len, n, h] for the composed einsum path (which equally
+        must not reshape per step). _cached_attention branches on
+        ndim."""
+        import jax as _jax
+        from ..flags import get_flag
+        from ..ops.pallas_decode import decode_attention_supported
         c = self.config
         dt = dtype or c.dtype
-        shape = (batch_size, max_len, c.num_heads,
-                 c.hidden_size // c.num_heads)
+        flat = (get_flag("use_pallas_decode_attention")
+                and _jax.default_backend() == "tpu"
+                and decode_attention_supported(
+                    max_len, c.hidden_size, c.num_heads,
+                    jnp.dtype(dt).itemsize))
+        if flat:
+            shape = (batch_size, max_len, c.hidden_size)
+        else:
+            shape = (batch_size, max_len, c.num_heads,
+                     c.hidden_size // c.num_heads)
         return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
                 for _ in self.blocks]
 

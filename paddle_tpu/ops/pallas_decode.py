@@ -1,0 +1,142 @@
+"""Pallas fused decode-attention (q_len == 1) over the KV cache.
+
+The profiled decode bottleneck at serving batch sizes is kernel COUNT,
+not bandwidth (ROUND4_NOTES: ~100 skinny fused kernels per token at
+B=64 — per-layer QK einsum, mask, softmax, AV einsum over the cache).
+This kernel computes the whole masked attention for ALL heads of one
+batch row in ONE program: the cache streams through VMEM once and the
+logits/probs never visit HBM.
+
+Shape trick (TPU tiling wants >=128 lanes; head_dim is 64): work in the
+[L, N*H] layout. Per-head contractions become two constant 0/1
+matmuls —
+    logits[l, n] = sum_h K[l, n*H+h] * q[n*H+h]   = K @ (S * q_col)
+    pexp[l, nh]  = probs[l, head_of(nh)]          = probs @ E
+with S [NH, 128] selecting each head's lanes into a column and
+E [128, NH] expanding a head column back over its lanes. All tiles are
+(multiple-of-8, multiple-of-128); the padded columns N..127 are never
+read back.
+
+Inference-only (no vjp) — training uses the flash-attention kernel.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_COLS = 128   # head-column padding (N <= 128 heads)
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# conservative VMEM budget for one grid program (v5e has ~16 MiB/core;
+# leave headroom for double-buffering and the compiler's own temps)
+_VMEM_BUDGET = 10 * 2 ** 20
+
+
+def decode_attention_supported(max_len, hidden, n_heads, itemsize=2):
+    """Single source of truth for when the fused kernel may run —
+    callers that pick the cache LAYOUT (GPTModel.init_cache) must use
+    this so layout and kernel eligibility can never drift. Covers the
+    tiling constraints AND an approximate per-program VMEM budget:
+    K+V blocks plus their f32 casts plus the S/E constants and [L, NH]
+    intermediates are ~(2*(itemsize+4) + 8) bytes per cache element —
+    an un-gated default-on kernel would hard-fail Mosaic compilation
+    for long caches / big hidden sizes (review r4). Tiling L inside
+    the kernel is the recorded follow-up for longer contexts."""
+    if max_len % 8 or hidden % 128 or n_heads > _COLS:
+        return False
+    approx = max_len * hidden * (2 * (itemsize + 4) + 8) \
+        + 2 * hidden * _COLS * 4
+    return approx <= _VMEM_BUDGET
+
+
+@functools.lru_cache(maxsize=8)
+def _seg_mats_np(n_heads, head_dim):
+    # cache NUMPY constants: caching jnp arrays would capture a tracer
+    # when first called under a trace and leak it into later traces
+    nh = n_heads * head_dim
+    s = np.zeros((nh, _COLS), np.float32)
+    e = np.zeros((_COLS, nh), np.float32)
+    for n in range(n_heads):
+        s[n * head_dim:(n + 1) * head_dim, n] = 1.0
+        e[n, n * head_dim:(n + 1) * head_dim] = 1.0
+    return s, e
+
+
+def _seg_mats(n_heads, head_dim):
+    s, e = _seg_mats_np(n_heads, head_dim)
+    return jnp.asarray(s), jnp.asarray(e)
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, s_ref, e_ref, out_ref, *,
+            scale):
+    # refs are 4-D blocks of the ORIGINAL [B, L, N, H] buffers (no
+    # pre-reshape outside: a reshaped view fed to pallas_call inside the
+    # decode while_loop forced a fresh copy of the whole cache per layer
+    # per step — measured 16.8k -> 4.2k tok/s); the [L, N*H] collapse of
+    # minor dims is layout-free in-kernel
+    q = q_ref[0].astype(jnp.float32)                # [1, NH]
+    k = k_ref[0].astype(jnp.float32)                # [L, NH]
+    v = v_ref[0].astype(jnp.float32)                # [L, NH]
+    s = s_ref[...]                                  # [NH, COLS]
+    e = e_ref[...]                                  # [COLS, NH]
+    # q into head columns: qs[nh, c] = q[nh] * S[nh, c]
+    qs = s * q.T                                    # [NH, COLS]
+    logits = jax.lax.dot_general(
+        k, qs, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [L, COLS]
+    logits = logits + mask_ref[...]                 # [L, COLS] additive
+    m = jnp.max(logits, axis=0, keepdims=True)      # [1, COLS]
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=0, keepdims=True)       # [1, COLS]
+    probs = p / denom                               # [L, COLS]
+    pexp = jax.lax.dot_general(
+        probs, e, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [L, NH]
+    wv = pexp * v                                   # [L, NH]
+    out = jnp.sum(wv, axis=0, keepdims=True)        # [1, NH]
+    out_ref[0] = out.reshape(out_ref.shape[1:])
+
+
+def decode_attention(q, k_buf, v_buf, off, n_heads):
+    """q [B, 1, N*H]; k_buf/v_buf FLAT [B, L, N*H] (L multiple of 8,
+    N*H multiple of 128, N <= 128); off scalar int32 — q's position
+    (keys 0..off are valid). Returns [B, 1, N*H] f32 attention output;
+    does NOT write the cache (callers update it first). The cache must
+    be STORED flat: any reshape between the decode loop's carried
+    buffer and pallas_call forces a full cache copy per layer per step
+    (measured 16.8k -> 4.2k tok/s), and Mosaic cannot collapse 4-D
+    blocks in-kernel."""
+    from jax.experimental import pallas as pl
+
+    B, one, nh = q.shape
+    if one != 1:
+        raise ValueError("decode_attention is q_len==1 only")
+    N = n_heads
+    H = nh // N
+    L = k_buf.shape[1]
+    scale = 1.0 / float(np.sqrt(H))
+    sm, em = _seg_mats(N, H)
+    key_pos = jnp.arange(L, dtype=jnp.int32)
+    mask = jnp.where(key_pos <= off, 0.0, -1e30).astype(jnp.float32)
+    mask = jnp.broadcast_to(mask[:, None], (L, _COLS))
+
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, 1, nh), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, L, nh), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, L, nh), lambda b: (b, 0, 0)),
+            pl.BlockSpec((L, _COLS), lambda b: (0, 0)),
+            pl.BlockSpec((nh, _COLS), lambda b: (0, 0)),
+            pl.BlockSpec((_COLS, nh), lambda b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nh), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1, nh), jnp.float32),
+        interpret=_interpret(),
+    )(q, k_buf, v_buf, mask, sm, em)
