@@ -109,8 +109,13 @@ def _reconcile_retvals(true_fn, false_fn, vals, names, fold):
     RETURN_NO_VALUE placeholder variables (`return_transformer.py:1`)."""
     import jax.numpy as jnp
     from ..core.tensor import Tensor
+    # fold is True (all one-sided locals fillable: rest was folded into
+    # a branch, locals are dead past the exit), False (plain if), or a
+    # tuple of names proven dead at the join by the reads-after pass
+    # (conditional-exit guard shape — fill only those)
     cand_idx = [k for k, n in enumerate(names)
-                if fold or n.startswith("__dy2st_retval")]
+                if fold is True or n.startswith("__dy2st_retval")
+                or (not isinstance(fold, bool) and n in fold)]
     if not cand_idx:
         return true_fn, false_fn
     try:
@@ -131,9 +136,11 @@ def _reconcile_retvals(true_fn, false_fn, vals, names, fold):
                 o = other[k]
                 v = o._value if isinstance(o, Tensor) else o
                 fixes[k] = ("zeros", (tuple(v.shape), v.dtype))
-            elif fold and isinstance(other[k], (bool, int, float)):
+            elif isinstance(other[k], (bool, int, float)):
                 # dead python scalar: reuse the other side's value so
-                # the join is trivially consistent
+                # the join is trivially consistent (cand_idx already
+                # established this slot is fillable — retval slots are
+                # flag-guarded, fold/dead slots are dead at the join)
                 fixes[k] = ("value", other[k])
         return fixes
 
@@ -460,6 +467,12 @@ class _LoadedNames(ast.NodeVisitor):
             self.names.add(node.id)
         self.generic_visit(node)
 
+    def visit_AugAssign(self, node):
+        # `x += 1` reads x even though the target ctx is Store
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
 
 def _loaded(nodes):
     v = _LoadedNames()
@@ -593,23 +606,42 @@ class _EarlyExit:
                     out.append(ast.Break())
                 return out, True        # code after `return` is dead
             if isinstance(s, ast.If):
+                # must-exit has to be decided on the ORIGINAL bodies:
+                # the rewrite below replaces Return nodes with flag
+                # assignments, after which nothing "exits" statically
+                ba = self._always_exits(s.body, (ast.Return,))
+                oa = self._always_exits(s.orelse, (ast.Return,))
                 nb, be = self._rewrite_returns(s.body, rf, rv, in_loop)
                 no, oe = self._rewrite_returns(s.orelse, rf, rv, in_loop)
                 s.body = nb or [ast.Pass()]
                 s.orelse = no
                 if be or oe:
-                    # fold-marked: one-sided locals in the folded rest
-                    # are dead past the exit, so the join may fill them
-                    s._dy2st_fold = True
                     rest, _ = self._rewrite_returns(
                         stmts[idx + 1:], rf, rv, in_loop)
-                    if be and not oe:
+                    # Folding `rest` into the non-exiting branch is only
+                    # sound when the exiting branch ALWAYS exits — a
+                    # conditional exit falls through and must still run
+                    # `rest`. Fold-marked: one-sided locals in the
+                    # folded rest are dead past the exit, so the join
+                    # may fill them.
+                    if be and not oe and ba:
+                        s._dy2st_fold = True
                         s.orelse = no + rest
-                    elif oe and not be:
+                    elif oe and not be and oa:
+                        s._dy2st_fold = True
                         s.body = (nb + rest) or [ast.Pass()]
-                        out.append(s)
-                        return out, True
                     else:
+                        # conditional exit (either side) or both sides
+                        # may exit: keep `rest` after the if, guarded on
+                        # the flag so exiting paths skip it
+                        if ba and oa:
+                            # every path exits: locals one-sided in the
+                            # if are dead afterwards, join may fill
+                            s._dy2st_fold = True
+                        else:
+                            # the reads-after pass decides which
+                            # one-sided locals are dead at this join
+                            s._dy2st_condexit = True
                         out.append(s)
                         if rest:
                             g = ast.If(test=_not(_name(rf)),
@@ -658,18 +690,30 @@ class _EarlyExit:
                 out.append(_assign(cf, _const(True)))
                 return out, True
             if isinstance(s, ast.If):
+                # see _rewrite_returns: decide must-exit on the ORIGINAL
+                # bodies, and fold only when the exit is unconditional.
+                # Return also exits the iteration (it carries a Break
+                # when rewritten inside a loop), so it counts here.
+                kinds = (ast.Break, ast.Continue, ast.Return)
+                ba = self._always_exits(s.body, kinds)
+                oa = self._always_exits(s.orelse, kinds)
                 nb, be = self._rewrite_bc(s.body, bf, cf)
                 no, oe = self._rewrite_bc(s.orelse, bf, cf)
                 s.body = nb or [ast.Pass()]
                 s.orelse = no
                 if be or oe:
-                    s._dy2st_fold = True
                     rest, _ = self._rewrite_bc(stmts[idx + 1:], bf, cf)
-                    if be and not oe:
+                    if be and not oe and ba:
+                        s._dy2st_fold = True
                         s.orelse = no + rest
-                    elif oe and not be:
+                    elif oe and not be and oa:
+                        s._dy2st_fold = True
                         s.body = (nb + rest) or [ast.Pass()]
                     else:
+                        if ba and oa:
+                            s._dy2st_fold = True
+                        else:
+                            s._dy2st_condexit = True
                         out.append(s)
                         if rest:
                             guard = _not(ast.BoolOp(
@@ -755,19 +799,66 @@ class _EarlyExit:
         return out
 
     @staticmethod
+    def _always_exits(stmts, kinds):
+        """Statically: does every path through this list hit one of
+        `kinds` (or raise)? Conservative — loops/try/with count as
+        fall-through-able, so False means "may fall through"."""
+        for s in stmts:
+            if isinstance(s, kinds) or isinstance(s, ast.Raise):
+                return True
+            if isinstance(s, ast.If) and s.orelse:
+                if _EarlyExit._always_exits(s.body, kinds) and \
+                        _EarlyExit._always_exits(s.orelse, kinds):
+                    return True
+        return False
+
+    @staticmethod
     def _always_returns(stmts):
         """Statically: does every path through this list hit a return?
         Conservative (loops/try count as fall-through-able)."""
-        for s in stmts:
-            if isinstance(s, ast.Return):
-                return True
-            if isinstance(s, ast.If) and s.orelse:
-                if _EarlyExit._always_returns(s.body) and \
-                        _EarlyExit._always_returns(s.orelse):
-                    return True
-            if isinstance(s, ast.Raise):
-                return True
-        return False
+        return _EarlyExit._always_exits(stmts, (ast.Return,))
+
+    # ---- reads-after analysis (conditional-exit join fills) ------------
+    @staticmethod
+    def _loads(node):
+        return _loaded([node])
+
+    def _mark_reads_after(self, stmts, after):
+        """Walk a statement list in reverse, attaching to every
+        conditional-exit `if` (marked by the rewrites above) the set of
+        names READ anywhere after it. A one-sided local NOT in that set
+        is dead at the join, so the runtime reconciler may fill it —
+        restoring compilability for the common `if c: return; tmp=...`
+        shape without silently zero-filling a live name.
+        Over-approximates reads (loop bodies count as self-following,
+        try/with blocks count whole-subtree), which only withholds
+        fills — never unsound."""
+        reads = set(after)
+        for s in reversed(stmts):
+            if isinstance(s, (ast.While, ast.For)):
+                loop_loads = self._loads(s)
+                self._mark_reads_after(s.body, reads | loop_loads)
+                if s.orelse:
+                    self._mark_reads_after(s.orelse, reads)
+                reads |= loop_loads
+            elif isinstance(s, ast.If):
+                if getattr(s, "_dy2st_condexit", False):
+                    s._dy2st_reads_after = frozenset(reads)
+                self._mark_reads_after(s.body, reads)
+                self._mark_reads_after(s.orelse, reads)
+                reads |= self._loads(s)
+            elif isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                sub_loads = self._loads(s)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if sub:
+                        self._mark_reads_after(sub, reads | sub_loads)
+                for h in getattr(s, "handlers", []) or []:
+                    self._mark_reads_after(h.body, reads | sub_loads)
+                reads |= sub_loads
+            else:
+                reads |= self._loads(s)
+        return reads
 
     def rewrite_function(self, fdef, fn_name="<fn>"):
         """Apply the return pass then the loop pass to a FunctionDef.
@@ -790,6 +881,7 @@ class _EarlyExit:
                           _assign(rv, _helper("UNDEF"))]
                          + body + [final])
         fdef.body = self.rewrite_loops(fdef.body)
+        self._mark_reads_after(fdef.body, set())
         # synthesized nodes need locations BEFORE the control-flow
         # transformer reads .lineno for its diagnostics
         ast.fix_missing_locations(fdef)
@@ -923,6 +1015,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             out.append(_undef_guard(n))
         out.append(_branch_fn(tname, names, node.body, names))
         out.append(_branch_fn(fname, names, node.orelse, names))
+        if getattr(node, "_dy2st_fold", False):
+            fold_val = _const(True)
+        elif getattr(node, "_dy2st_condexit", False):
+            ra = getattr(node, "_dy2st_reads_after", None)
+            fillable = (tuple(n for n in names if n not in ra)
+                        if ra is not None else ())
+            fold_val = ast.Tuple(elts=[_const(n) for n in fillable],
+                                 ctx=ast.Load())
+        else:
+            fold_val = _const(False)
         call = ast.Call(
             func=_helper("convert_ifelse"),
             args=[node.test, _name(tname), _name(fname),
@@ -930,9 +1032,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Tuple(elts=[_const(n) for n in names],
                             ctx=ast.Load()),
                   _const(loc)],
-            keywords=[ast.keyword(
-                arg="fold",
-                value=_const(bool(getattr(node, "_dy2st_fold", False))))])
+            keywords=[ast.keyword(arg="fold", value=fold_val)])
         if names:
             out.append(ast.Assign(
                 targets=[_tuple_of(names, ast.Store())], value=call))

@@ -714,3 +714,193 @@ def test_break_in_loop_inside_with_converts():
     b = paddle.to_tensor(np.asarray([10], np.int32))
     np.testing.assert_allclose(_np(f(x, b)), _np(fn(x, b)))
     np.testing.assert_allclose(_np(f(x, b)), 2.0 * np.ones(2))
+
+
+# ------------------------------------------------ conditional-exit folds
+# Advisor r4 (high): folding trailing code into the other branch is only
+# sound when the exiting branch ALWAYS exits. A branch that exits
+# conditionally falls through and must still run the trailing code
+# (reference return_transformer.py handles this with the same
+# flag-guard shape).
+
+def test_conditional_return_in_branch_runs_trailing_code():
+    def fn(a, c2):
+        if a:
+            if c2:
+                return 1
+            x = 5
+        y = 2
+        return y
+
+    f = to_static(fn)
+    for a in (True, False):
+        for c2 in (True, False):
+            got = f(a, c2)
+            got = got.item() if hasattr(got, "item") else got
+            assert got == fn(a, c2), (a, c2, got)
+
+
+def test_conditional_continue_runs_trailing_code():
+    def fn():
+        total = 0
+        for i in range(4):
+            if i % 2 == 0:
+                if i == 0:
+                    continue
+                total = total + 10
+            total = total + i
+        return total
+
+    f = to_static(fn)
+    got = f()
+    got = got.item() if hasattr(got, "item") else got
+    assert got == fn() == 16
+
+
+def test_conditional_break_runs_trailing_code():
+    def fn(n):
+        total = 0
+        for i in range(10):
+            if i > 2:
+                if i == n:
+                    break
+                total = total + 100
+            total = total + i
+        return total
+
+    f = to_static(fn)
+    for n in (5, 99):
+        got = f(n)
+        got = got.item() if hasattr(got, "item") else got
+        assert got == fn(n), (n, got)
+
+
+def test_both_branches_conditionally_exit():
+    def fn(a, b):
+        if a:
+            if b:
+                return 1
+            x = 10
+        else:
+            if not b:
+                return 2
+            x = 20
+        return x + 5
+
+    f = to_static(fn)
+    for a in (True, False):
+        for b in (True, False):
+            got = f(a, b)
+            got = got.item() if hasattr(got, "item") else got
+            assert got == fn(a, b), (a, b, got)
+
+
+def test_conditional_return_tensor_cond():
+    """Same shape but with TENSOR conditions so the guard becomes a
+    compiled cond: fall-through must run the trailing code."""
+    def fn(x):
+        if x.mean() > 0:
+            if x.sum() > 10:
+                return x * 2
+            x = x + 5.0
+        y = x - 1.0
+        return y
+
+    f = to_static(fn)
+    big = paddle.to_tensor(np.full((8,), 2.0, np.float32))    # sum 16
+    small = paddle.to_tensor(np.full((8,), 0.5, np.float32))  # sum 4
+    neg = paddle.to_tensor(np.full((8,), -1.0, np.float32))
+    for t in (big, small, neg):
+        np.testing.assert_allclose(_np(f(t)), _np(fn(t)))
+
+
+def test_unconditional_fold_still_applies():
+    """When the exiting branch ALWAYS exits, trailing code still folds
+    into the other branch (one-sided locals stay fillable)."""
+    def fn(x):
+        if x.mean() > 0:
+            return x * 2
+        z = x - 1.0
+        return z
+
+    f = to_static(fn)
+    pos = paddle.to_tensor(np.ones((2,), np.float32))
+    neg = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(f(pos)), 2.0 * np.ones(2))
+    np.testing.assert_allclose(_np(f(neg)), -2.0 * np.ones(2))
+
+
+def test_conditional_return_with_dead_branch_local():
+    """A branch that conditionally exits may bind a local that is DEAD
+    at the join; the reads-after pass must let the join fill it so the
+    function still compiles (review r5 finding)."""
+    def fn(x):
+        if x.mean() > 0:
+            if x.sum() > 10:
+                return x * 2
+            tmp = x * 3.0
+            x = x + tmp
+        return x - 1.0
+
+    f = to_static(fn)
+    big = paddle.to_tensor(np.full((8,), 2.0, np.float32))
+    small = paddle.to_tensor(np.full((8,), 0.5, np.float32))
+    neg = paddle.to_tensor(np.full((8,), -1.0, np.float32))
+    for t in (big, small, neg):
+        np.testing.assert_allclose(_np(f(t)), _np(fn(t)), rtol=1e-6)
+
+
+def test_conditional_return_with_live_branch_local_errors():
+    """A one-sided local READ after the if would be unbound on the
+    fall-through path in eager Python (NameError); the compiled join
+    must refuse it rather than silently zero-fill."""
+    def fn(x):
+        if x.mean() > 0:
+            if x.sum() > 10:
+                return x * 2
+            tmp = x * 3.0
+        return tmp - 1.0
+
+    f = to_static(fn)
+    small = paddle.to_tensor(np.full((8,), 0.5, np.float32))
+    with pytest.raises(Exception):
+        f(small)
+
+
+def test_augassign_counts_as_read_after():
+    """`tmp += 1` reads tmp: the reads-after pass must treat AugAssign
+    targets as live, so the one-sided local errors instead of being
+    silently zero-filled (eager raises UnboundLocalError)."""
+    def fn(x):
+        if x.mean() > 0:
+            if x.sum() > 10:
+                return x * 2
+            tmp = x * 3.0
+        tmp += 1.0
+        return x - 1.0
+
+    f = to_static(fn)
+    small = paddle.to_tensor(np.full((8,), 0.5, np.float32))
+    with pytest.raises(Exception):
+        f(small)
+
+
+def test_scalar_retval_fill_with_empty_fillable_tuple():
+    """Retval-slot fills must not depend on unrelated locals: a branch
+    returning a python scalar under a traced condition compiles even
+    when the fillable-locals tuple is empty (every branch-assigned name
+    is read afterwards)."""
+    def fn(x):
+        if x.mean() > 0:
+            if x.sum() > 10:
+                return 1.0
+            x = x + 5.0
+        n = len(x.shape)        # reads x: nothing is dead at the join
+        return 2.0 + 0.0 * n
+
+    f = to_static(fn)
+    big = paddle.to_tensor(np.full((8,), 2.0, np.float32))
+    small = paddle.to_tensor(np.full((8,), 0.5, np.float32))
+    neg = paddle.to_tensor(np.full((8,), -1.0, np.float32))
+    for t in (big, small, neg):
+        assert float(f(t)) == fn(t), t
