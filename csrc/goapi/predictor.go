@@ -83,6 +83,8 @@ import "C"
 import (
 	"errors"
 	"fmt"
+	"path/filepath"
+	"sync"
 	"unsafe"
 )
 
@@ -95,17 +97,47 @@ type Predictor struct {
 	h unsafe.Pointer
 }
 
+var (
+	libMu        sync.Mutex // guards the dlopen and loadedLibptp
+	loadedLibptp string     // canonical path of the one-per-process dlopen
+)
+
+// canonicalize resolves a path to its absolute, symlink-free form so
+// equivalent spellings compare equal; falls back to the raw string.
+func canonicalize(p string) string {
+	if a, err := filepath.Abs(p); err == nil {
+		p = a
+	}
+	if r, err := filepath.EvalSymlinks(p); err == nil {
+		p = r
+	}
+	return p
+}
+
 // New dlopens libptp (once per process), loads the exported artifact
 // (base path of the .mlir/.sig pair) against the given PJRT plugin.
+// A later call with a DIFFERENT libptp path is an explicit error —
+// the first library stays loaded for the process lifetime.
 func New(artifact, plugin, libptp string) (*Predictor, error) {
 	cl := C.CString(libptp)
 	defer C.free(unsafe.Pointer(cl))
+	libMu.Lock()
 	if C.ptp_so == nil {
 		if C.ptp_open(cl) != 0 {
-			return nil, fmt.Errorf("dlopen %s: %s", libptp,
+			err := fmt.Errorf("dlopen %s: %s", libptp,
 				C.GoString(C.ptp_dlerr()))
+			libMu.Unlock()
+			return nil, err
 		}
+		loadedLibptp = canonicalize(libptp)
+	} else if canonicalize(libptp) != loadedLibptp {
+		err := fmt.Errorf(
+			"libptp already loaded from %q; cannot load %q in the same process",
+			loadedLibptp, libptp)
+		libMu.Unlock()
+		return nil, err
 	}
+	libMu.Unlock()
 	ca := C.CString(artifact)
 	defer C.free(unsafe.Pointer(ca))
 	cp := C.CString(plugin)

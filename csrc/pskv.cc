@@ -552,6 +552,9 @@ int64_t pskv_save(void* tp, const char* path) {
   return count;
 }
 
+// rc convention: >=0 rows loaded; -1 missing/unreadable/truncated header;
+// -2 header present but incompatible with this table's config (dim /
+// optimizer / row width — e.g. a pre-lifecycle-format checkpoint).
 int64_t pskv_load(void* tp, const char* path) {
   auto* t = static_cast<Table*>(tp);
   FILE* f = std::fopen(path, "rb");
@@ -559,11 +562,19 @@ int64_t pskv_load(void* tp, const char* path) {
   int32_t dim = 0, opt = 0, rf32 = 0;
   if (std::fread(&dim, sizeof(int32_t), 1, f) != 1 ||
       std::fread(&opt, sizeof(int32_t), 1, f) != 1 ||
-      std::fread(&rf32, sizeof(int32_t), 1, f) != 1 ||
-      dim != t->dim || opt != (int32_t)t->opt ||
-      rf32 != (int32_t)t->row_floats()) {
+      std::fread(&rf32, sizeof(int32_t), 1, f) != 1) {
     std::fclose(f);
     return -1;
+  }
+  if (dim != t->dim || opt != (int32_t)t->opt ||
+      rf32 != (int32_t)t->row_floats()) {
+    std::fprintf(stderr,
+                 "pskv_load %s: header mismatch (file dim=%d opt=%d "
+                 "row_floats=%d; table dim=%d opt=%d row_floats=%d)\n",
+                 path, dim, opt, rf32, t->dim, (int32_t)t->opt,
+                 (int32_t)t->row_floats());
+    std::fclose(f);
+    return -2;
   }
   size_t rf = t->row_floats();
   int64_t count = 0;

@@ -608,23 +608,63 @@ class PipelineLayer(Layer):
         return self._num_stages - 1
 
 
+_UNREP = object()           # sentinel: config value the sig can't represent
+
+
+def _sig_value(v, depth=0):
+    """Hashable representation of a scalar / recursively-scalar
+    container config value, or _UNREP when any element cannot be
+    represented (depth-capped for pathological nesting)."""
+    if isinstance(v, (int, float, bool, str, type(None))):
+        return v
+    if depth > 8:
+        return _UNREP
+    if isinstance(v, (tuple, list)):
+        parts = tuple(_sig_value(e, depth + 1) for e in v)
+        if any(p is _UNREP for p in parts):
+            return _UNREP
+        return ("seq", type(v).__name__, parts)
+    if isinstance(v, dict):
+        items = []
+        for k in sorted(v, key=repr):
+            kv, vv = _sig_value(k, depth + 1), _sig_value(v[k], depth + 1)
+            if kv is _UNREP or vv is _UNREP:
+                return _UNREP
+            items.append((kv, vv))
+        return ("map", tuple(items))
+    return _UNREP
+
+
 def _config_sig(layer, prefix=""):
-    """Recursive scalar-config fingerprint: every int/float/bool/str/None/
-    scalar-tuple attribute of the layer and its sublayers (dropout rate,
-    norm epsilon, activation name, ...). Two same-class blocks whose
-    forwards differ through parameterless config must NOT be stacked and
-    run through one template's forward."""
+    """Recursive scalar-config fingerprint: every int/float/bool/str/None
+    and recursively-scalar tuple/list/dict attribute of the layer and
+    its sublayers (dropout rate, norm epsilon, per-block size lists,
+    ...). Two same-class blocks whose forwards differ through
+    parameterless config must NOT be stacked and run through one
+    template's forward. A container holding values the signature cannot
+    represent contributes a per-instance unique entry, so such layers
+    conservatively never compare homogeneous (advisor r4)."""
     out = []
     for k in sorted(vars(layer)):
         if k == "_full_name":        # unique per instance by construction
             continue
+        if k in ("_parameters", "_buffers", "_sub_layers"):
+            # covered by the param-tree signature / sublayer recursion /
+            # the buffers check in _stackable_sig — not config
+            continue
         v = vars(layer)[k]
         if isinstance(v, (int, float, bool, str, type(None))):
             out.append((prefix + k, v))
-        elif isinstance(v, tuple) and all(
-                isinstance(e, (int, float, bool, str, type(None)))
-                for e in v):
-            out.append((prefix + k, v))
+        elif isinstance(v, (tuple, list, dict)):
+            sv = _sig_value(v)
+            if sv is _UNREP:
+                # identity-keyed: blocks sharing the literally same
+                # config object still stack; distinct unrepresentable
+                # configs refuse stacking rather than risk running two
+                # configs through one template
+                out.append((prefix + k, ("unrep", id(v))))
+            else:
+                out.append((prefix + k, sv))
     for n, sub in layer._sub_layers.items():
         if sub is not None:
             out.extend(_config_sig(sub, prefix + n + "."))

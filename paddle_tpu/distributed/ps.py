@@ -191,8 +191,14 @@ class SparseTable:
 
     def load(self, path):
         n = self._lib.pskv_load(self._h, path.encode())
+        if n == -2:
+            raise OSError(
+                f"checkpoint format mismatch: {path} was written with a "
+                "different table config (dim/optimizer/row width — e.g. "
+                "a pre-lifecycle-format file; see MIGRATION.md); widths "
+                "are printed on stderr")
         if n < 0:
-            raise OSError(f"load failed or incompatible: {path}")
+            raise OSError(f"load failed (missing or corrupt): {path}")
         return n
 
     def serve(self, port=0):

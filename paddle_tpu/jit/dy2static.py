@@ -106,7 +106,13 @@ def _reconcile_retvals(true_fn, false_fn, vals, names, fold):
     `if` is a rewrite FOLD (code after an exit moved into a branch —
     such locals are dead past the exit, so the fill is unobservable;
     the companion flag guards the retval). The reference's analog is
-    RETURN_NO_VALUE placeholder variables (`return_transformer.py:1`)."""
+    RETURN_NO_VALUE placeholder variables (`return_transformer.py:1`).
+
+    NOTE: like the convert_while body probe, this probe executes BOTH
+    branch closures once at trace time before control_flow.cond traces
+    them again — Python-level side effects in branch bodies (prints,
+    list.append, counters) fire an extra time per trace. The probe is
+    skipped entirely when no candidate slot exists (cand_idx empty)."""
     import jax.numpy as jnp
     from ..core.tensor import Tensor
     # fold is True (all one-sided locals fillable: rest was folded into

@@ -202,3 +202,54 @@ def test_lstm_under_autocast_carry_dtype():
         ref0 = ref[0] if isinstance(ref, (tuple, list)) else ref
         np.testing.assert_allclose(out0.numpy().astype(np.float32),
                                    ref0.numpy(), atol=0.1, rtol=0.15)
+
+
+def test_config_sig_sees_list_and_dict_config():
+    """Advisor r4: two same-class blocks with identical param trees but
+    different LIST config must not be judged homogeneous (stacking would
+    run both through one template's forward). Dicts of scalars count
+    too; containers the signature cannot represent refuse stacking."""
+    from paddle_tpu.distributed.pipeline import _config_sig
+
+    class Block(nn.Layer):
+        def __init__(self, skips):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.skips = skips          # list config drives forward
+
+        def forward(self, x):
+            h = self.fc(x)
+            for i in self.skips:
+                h = h + x * float(i)
+            return h
+
+    a, b = Block([1, 2]), Block([1, 3])
+    assert _config_sig(a) != _config_sig(b)
+    c, d = Block([1, 2]), Block([1, 2])
+    assert _config_sig(c) == _config_sig(d)
+
+    class DictBlock(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.cfg = cfg
+
+        def forward(self, x):
+            return self.fc(x) * self.cfg.get("scale", 1.0)
+
+    assert _config_sig(DictBlock({"scale": 2.0})) != \
+        _config_sig(DictBlock({"scale": 3.0}))
+    assert _config_sig(DictBlock({"scale": 2.0})) == \
+        _config_sig(DictBlock({"scale": 2.0}))
+
+    class Weird(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.cfg = [object()]       # unrepresentable content
+
+        def forward(self, x):
+            return self.fc(x)
+
+    # conservatively unique per instance: refuses stacking
+    assert _config_sig(Weird()) != _config_sig(Weird())
