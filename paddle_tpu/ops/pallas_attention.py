@@ -25,17 +25,17 @@ DEFAULT_BLOCK_Q = None   # None -> per-shape policy (_resolve_blocks)
 DEFAULT_BLOCK_K = None
 
 
-def _resolve_blocks(sq, block_q, block_k):
-    """Measured block policy (v5e sweep, tools/tpu_microbench.py +
-    ROUND3_NOTES): bk=1024 wins at every shape tested (512..16384,
-    D 64/128); bq=1024 wins while the merged-backward VMEM working set
-    fits, 512 beyond (1024 fails to compile at QUERY length 16384 — the
-    constraint is governed by sq, not sk). Explicit block args
-    override."""
+def _resolve_blocks(sq, block_q, block_k, for_bwd=False):
+    """Measured block policy (v5e sweeps, tools/tpu_microbench.py +
+    tools/attn_tune.py, ROUND3/ROUND5 notes): bk=1024 wins at every shape
+    tested (512..16384, D 64/128). The backward's whole-slice dq VMEM
+    accumulator caps bq at 512 beyond sq=8192 (the constraint is governed
+    by sq, not sk); the forward has no such working set and keeps bq=1024
+    everywhere. Explicit block args override."""
     if block_k is None:
         block_k = 1024
     if block_q is None:
-        block_q = 1024 if sq <= 8192 else 512
+        block_q = 512 if (for_bwd and sq > 8192) else 1024
     return block_q, block_k
 _LANES = 128  # stats buffers padded to a full lane register
 _SUB = 8     # row-stats (lse/delta) replicated over 8 sublanes so their
@@ -57,11 +57,44 @@ def _fit_block(block, dim):
 
 
 # ---------------------------------------------------------------------------
+# triangle grids: for causal self-attention (offset == 0) the grid
+# enumerates ONLY the lower-triangular live tiles through a 1D flat index,
+# so dead tiles cost neither a grid step nor their block DMA (the
+# rectangular grid's pl.when skip saves compute but still fetches blocks).
+# Decodes are float-sqrt seeded and integer-corrected, so they are exact.
+# ---------------------------------------------------------------------------
+
+def _tri_fwd_decode(t):
+    """Flat lower-triangle index -> (qi, ki) for bq == bk: row qi holds
+    qi+1 tiles, cumulative C(q) = q(q+1)/2."""
+    tf = t.astype(jnp.float32)
+    qi = ((jnp.sqrt(8.0 * tf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    qi = jnp.where((qi + 1) * (qi + 2) // 2 <= t, qi + 1, qi)
+    qi = jnp.where(qi * (qi + 1) // 2 > t, qi - 1, qi)
+    ki = t - qi * (qi + 1) // 2
+    return qi, ki
+
+
+def _tri_bwd_decode(t, nq, r):
+    """Flat index -> (ki, qj), column-major: column ki holds nq - r*ki
+    q-tiles starting at qj = r*ki (r = bk // bq)."""
+    def C(x):
+        return x * nq - r * x * (x - 1) // 2
+    tf = t.astype(jnp.float32)
+    a = nq + 0.5 * r
+    ki = ((a - jnp.sqrt(a * a - 2.0 * r * tf)) / r).astype(jnp.int32)
+    ki = jnp.where(C(ki + 1) <= t, ki + 1, ki)
+    ki = jnp.where(C(ki) > t, ki - 1, ki)
+    qj = r * ki + (t - C(ki))
+    return ki, qj
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *, scale, causal, bq, bk, nk, offset):
+                acc_sc, m_sc, l_sc, *, causal, bq, bk, nk, offset):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -72,14 +105,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     qi = pl.program_id(1)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0]                               # [bq, H] input dtype
         k = k_ref[0]                               # [bk, H]
         # bf16 inputs feed the MXU directly; accumulation stays f32
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk] f32
-        if causal:
+            preferred_element_type=jnp.float32)           # [bq, bk] f32
+        if masked:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             s = jnp.where(rows + offset >= cols, s, _NEG_INF)
@@ -98,12 +131,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
 
     if causal:
-        # skip tiles strictly above the diagonal band
-        @pl.when(ki * bk <= (qi + 1) * bq - 1 + offset)
+        # three tile classes: above the band (skip entirely), crossing the
+        # diagonal (mask), fully inside (no iota/compare/select VPU work)
+        live = ki * bk <= (qi + 1) * bq - 1 + offset
+        diag = (ki + 1) * bk - 1 > qi * bq + offset
+
+        @pl.when(jnp.logical_and(live, diag))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(jnp.logical_and(live, jnp.logical_not(diag)))
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -114,6 +155,108 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (_SUB, lse.shape[0]))
 
 
+def _fwd_kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                    acc_sc, m_sc, l_sc, *, bq, bk):
+    """Triangle-grid causal forward (offset == 0, bq == bk): grid step t
+    enumerates live tiles only; the diagonal tile (ki == qi) is the only
+    one needing the mask, and it is also the row's finalize step."""
+    t = pl.program_id(1)
+    qi, ki = _tri_fwd_decode(t)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    def compute(masked):
+        q = q_ref[0]                               # [bq, H] input dtype
+        k = k_ref[0]                               # [bk, H]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk] f32
+        if masked:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_sc[:, :1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [bq, bk] f32
+        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0]                               # [bk, H]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, H]
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(ki == qi)
+    def _():
+        compute(True)
+
+    @pl.when(ki < qi)
+    def _():
+        compute(False)
+
+    @pl.when(ki == qi)
+    def _finalize():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse = (m_sc[:, :1] + jnp.log(l_safe))[:, 0]          # [bq]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (_SUB, lse.shape[0]))
+
+
+def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
+    bn, sq, h = qr.shape
+    T = nq * (nq + 1) // 2
+
+    def qmap(bn_, t):
+        return (bn_, _tri_fwd_decode(t)[0], 0)
+
+    def kmap(bn_, t):
+        return (bn_, _tri_fwd_decode(t)[1], 0)
+
+    def omap(bn_, t):
+        return (bn_, _tri_fwd_decode(t)[0], 0)
+
+    def lmap(bn_, t):
+        return (bn_, 0, _tri_fwd_decode(t)[0])
+
+    kernel = functools.partial(_fwd_kernel_tri, bq=bq, bk=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bn, T),
+        in_specs=[
+            pl.BlockSpec((1, bq, h), qmap),
+            pl.BlockSpec((1, bk, h), kmap),
+            pl.BlockSpec((1, bk, h), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, h), omap),
+            pl.BlockSpec((1, _SUB, bq), lmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, h), qr.dtype),
+            jax.ShapeDtypeStruct((bn, _SUB, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, h), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * bn * sq * sq * h,
+            bytes_accessed=(qr.size * 2 + kr.size + vr.size) * qr.dtype.itemsize,
+            transcendentals=bn * sq * sq // 2),
+        interpret=_interpret(),
+    )(qr, kr, vr)
+    return out, lse
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]
@@ -122,12 +265,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
 
-    qr = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    # scale folded into q once here instead of a [bq, bk] VPU pass per
+    # tile inside the kernel (dq is un-scaled correspondingly in the vjp)
+    qr = (q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)) * scale
     kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
     vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
 
+    if causal and offset == 0 and bq == bk and nq > 1:
+        return _flash_fwd_tri(qr, kr, vr, bq, bk, nq)
+
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        _fwd_kernel, causal=causal, bq=bq, bk=bk, nk=nk,
         offset=offset)
     out, lse = pl.pallas_call(
         kernel,
@@ -165,7 +313,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_sc, dv_sc,
-                *, scale, causal, bq, bk, nq, offset):
+                *, causal, bq, bk, nq, offset):
     qi = pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -175,7 +323,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     ki = pl.program_id(1)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0]                               # [bq, H] input dtype
         k = k_ref[0]                               # [bk, H]
         v = v_ref[0]
@@ -184,9 +332,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][0][:, None]           # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            preferred_element_type=jnp.float32)           # [bq, bk]
         p = jnp.exp(s - lse)
-        if causal:
+        if masked:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             p = jnp.where(rows + offset >= cols, p, 0.0)
@@ -197,17 +345,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when((qi + 1) * bq - 1 + offset >= ki * bk)
+        live = (qi + 1) * bq - 1 + offset >= ki * bk
+        diag = (ki + 1) * bk - 1 > qi * bq + offset
+
+        @pl.when(jnp.logical_and(live, diag))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(jnp.logical_and(live, jnp.logical_not(diag)))
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -216,7 +371,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_sc, *, scale, causal, bq, bk, nk, offset):
+               dq_ref, dq_sc, *, causal, bq, bk, nk, offset):
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -225,7 +380,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     qi = pl.program_id(1)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -234,26 +389,33 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
         p = jnp.exp(s - lse)
-        if causal:
+        if masked:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             p = jnp.where(rows + offset >= cols, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * bk <= (qi + 1) * bq - 1 + offset)
+        live = ki * bk <= (qi + 1) * bq - 1 + offset
+        diag = (ki + 1) * bk - 1 > qi * bq + offset
+
+        @pl.when(jnp.logical_and(live, diag))
         def _():
-            compute()
+            compute(True)
+
+        @pl.when(jnp.logical_and(live, jnp.logical_not(diag)))
+        def _():
+            compute(False)
     else:
-        compute()
+        compute(False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -263,7 +425,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                        delta_ref, dq_ref, dk_ref, dv_ref,
                        dk_sc, dv_sc, dq_sc,
-                       *, scale, causal, bq, bk, nq, nk, offset):
+                       *, causal, bq, bk, nq, nk, offset):
     """One pass over (k-tile outer, q-tile inner) producing all three
     gradients, so the s/p recomputation and the dp dot are shared —
     5 MXU dots per tile instead of the 7 the split dkv+dq kernels cost.
@@ -281,7 +443,7 @@ def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init_dq():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0]                               # [bq, H]
         k = k_ref[0]                               # [bk, H]
         v = v_ref[0]
@@ -290,9 +452,9 @@ def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         delta = delta_ref[0][0][:, None]           # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            preferred_element_type=jnp.float32)           # [bq, bk]
         p = jnp.exp(s - lse)
-        if causal:
+        if masked:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             p = jnp.where(rows + offset >= cols, p, 0.0)
@@ -302,7 +464,7 @@ def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [bq, bk]
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -314,9 +476,9 @@ def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     if causal:
         @pl.when((qi + 1) * bq - 1 + offset >= ki * bk)
         def _():
-            compute()
+            compute(True)
     else:
-        compute()
+        compute(False)
 
     @pl.when(qi == nq - 1)
     def _finalize_kv():
@@ -330,9 +492,136 @@ def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     dq_ref[0] = dq_sc[pl.ds(qi * bq, bq), :].astype(dq_ref.dtype)
 
 
+def _bwd_merged_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dq_ref, dk_ref, dv_ref,
+                           dk_sc, dv_sc, dq_sc,
+                           *, bq, bk, nq, r):
+    """Triangle-grid causal merged backward (offset == 0, bk % bq == 0):
+    column-major over live tiles only. Same 5-dot body and whole-slice dq
+    accumulator as _bwd_merged_kernel; the mask is applied only on the r
+    diagonal-crossing tiles per column (qj // r == ki)."""
+    t = pl.program_id(1)
+    ki, qj = _tri_bwd_decode(t, nq, r)
+
+    @pl.when(qj == r * ki)
+    def _init_kv():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    @pl.when(t == 0)
+    def _init_dq():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def compute(masked):
+        q = q_ref[0]                               # [bq, H]
+        k = k_ref[0]                               # [bk, H]
+        v = v_ref[0]
+        do = do_ref[0]                             # [bq, H]
+        lse = lse_ref[0][0][:, None]               # [bq, 1]
+        delta = delta_ref[0][0][:, None]           # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        p = jnp.exp(s - lse)
+        if masked:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qj * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            p = jnp.where(rows >= cols, p, 0.0)
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bk]
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows_sl = pl.ds(qj * bq, bq)
+        dq_sc[rows_sl, :] = dq_sc[rows_sl, :] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qj // r == ki)
+    def _():
+        compute(True)
+
+    @pl.when(qj // r > ki)
+    def _():
+        compute(False)
+
+    @pl.when(qj == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+    # dq windows are revisited across columns and flushed on every step;
+    # only the LAST flush of a window must be the complete value, and the
+    # final visit of window qj is in its diagonal column ki == qj // r
+    # (the largest ki that visits qj). Intermediate flushes may carry
+    # whatever is in the output buffer — they are overwritten in order.
+    @pl.when(ki == qj // r)
+    def _flush_dq():
+        dq_ref[0] = dq_sc[pl.ds(qj * bq, bq), :].astype(dq_ref.dtype)
+
+
+def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
+    bn, sq, h = qr.shape
+    r = bk // bq
+    nk = sq // bk
+    T = nk * nq - r * nk * (nk - 1) // 2
+
+    def qmap(bn_, t):
+        return (bn_, _tri_bwd_decode(t, nq, r)[1], 0)
+
+    def kmap(bn_, t):
+        return (bn_, _tri_bwd_decode(t, nq, r)[0], 0)
+
+    def smap(bn_, t):
+        return (bn_, 0, _tri_bwd_decode(t, nq, r)[1])
+
+    kernel = functools.partial(
+        _bwd_merged_kernel_tri, bq=bq, bk=bk, nq=nq, r=r)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(bn, T),
+        in_specs=[
+            pl.BlockSpec((1, bq, h), qmap),   # q
+            pl.BlockSpec((1, bk, h), kmap),   # k
+            pl.BlockSpec((1, bk, h), kmap),   # v
+            pl.BlockSpec((1, bq, h), qmap),   # do
+            pl.BlockSpec((1, _SUB, bq), smap),  # lse
+            pl.BlockSpec((1, _SUB, bq), smap),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, h), qmap),
+            pl.BlockSpec((1, bk, h), kmap),
+            pl.BlockSpec((1, bk, h), kmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, h), qr.dtype),
+            jax.ShapeDtypeStruct((bn, sq, h), kr.dtype),
+            jax.ShapeDtypeStruct((bn, sq, h), vr.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, h), jnp.float32),
+            pltpu.VMEM((bk, h), jnp.float32),
+            pltpu.VMEM((sq, h), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=5 * bn * sq * sq * h,
+            bytes_accessed=(qr.size * 4 + kr.size * 4) * qr.dtype.itemsize,
+            transcendentals=bn * sq * sq // 2),
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse, delta)
+    return dq, dk, dv
+
+
 # above ~this scratch footprint the whole-slice dq accumulator stops
-# fitting comfortably next to the tile buffers; fall back to split kernels
+# fitting comfortably next to the tile buffers; shrink bq first, then
+# fall back to the split kernels
 _MERGED_BWD_DQ_SCRATCH_LIMIT = 6 * 1024 * 1024
+_MERGED_BWD_DQ_SCRATCH_LIMIT_SMALL_BQ = 9 * 1024 * 1024
 
 
 def _flash_bwd_merged(q, k, v, out, lse, g, causal, scale, block_q, block_k):
@@ -343,15 +632,25 @@ def _flash_bwd_merged(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
 
-    qr = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    qr = (q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)) * scale
     kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
     vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
     gr = g.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
     delta = jnp.sum(gr.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (b * n, _SUB, sq))
 
+    if causal and offset == 0 and bk % bq == 0 and nk > 1:
+        dq, dk, dv = _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta,
+                                           bq, bk, nq)
+        dq = dq * scale
+
+        def unflatten_tri(x, s):
+            return x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
+        return (unflatten_tri(dq, sq), unflatten_tri(dk, sk),
+                unflatten_tri(dv, sk))
+
     kernel = functools.partial(
-        _bwd_merged_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        _bwd_merged_kernel, causal=causal, bq=bq, bk=bk,
         nq=nq, nk=nk, offset=offset)
     dq, dk, dv = pl.pallas_call(
         kernel,
@@ -381,6 +680,7 @@ def _flash_bwd_merged(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qr, kr, vr, gr, lse, delta)
+    dq = dq * scale
 
     def unflatten(x, s):
         return x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
@@ -395,7 +695,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
 
-    qr = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    qr = (q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)) * scale
     kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
     vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
     gr = g.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
@@ -413,7 +713,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         pl.BlockSpec((1, _SUB, bq), lambda bn, i, j: (bn, 0, j)),  # delta
     ]
     dkv_kernel = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+        _dkv_kernel, causal=causal, bq=bq, bk=bk, nq=nq,
         offset=offset)
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -435,7 +735,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     )(qr, kr, vr, gr, lse, delta)
 
     dq_kernel = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        _dq_kernel, causal=causal, bq=bq, bk=bk, nk=nk,
         offset=offset)
     dq = pl.pallas_call(
         dq_kernel,
@@ -453,6 +753,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         scratch_shapes=[pltpu.VMEM((bq, h), jnp.float32)],
         interpret=_interpret(),
     )(qr, kr, vr, gr, lse, delta)
+    dq = dq * scale
 
     def unflatten(x, s):
         return x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
@@ -490,10 +791,20 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     sq, h = q.shape[1], q.shape[3]
-    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
-    if sq * h * 4 <= _MERGED_BWD_DQ_SCRATCH_LIMIT:
+    explicit_bq = block_q is not None
+    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k,
+                                       for_bwd=True)
+    dq_scratch = sq * h * 4
+    if dq_scratch <= _MERGED_BWD_DQ_SCRATCH_LIMIT:
         dq, dk, dv = _flash_bwd_merged(q, k, v, out, lse, g, causal, scale,
                                        block_q, block_k)
+    elif dq_scratch <= _MERGED_BWD_DQ_SCRATCH_LIMIT_SMALL_BQ:
+        # a [sq, 128] f32 dq accumulator (8 MB at 16k) still fits VMEM if
+        # the [bq, bk] f32 tile temporaries shrink with it (measured r5);
+        # an explicitly passed block_q overrides this clamp per contract
+        bq_small = block_q if explicit_bq else min(block_q, 256)
+        dq, dk, dv = _flash_bwd_merged(q, k, v, out, lse, g, causal, scale,
+                                       bq_small, block_k)
     else:
         dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, scale,
                                 block_q, block_k)

@@ -1,0 +1,144 @@
+"""Round-5 attention tuning harness: fwd-only and fwd+bwd timings at the
+16k bench shapes, block sweeps, and a comparison against jax's bundled
+TPU flash attention as a practical ceiling reference.
+
+Measurement discipline matches bench.py: reps chained inside one jitted
+fori_loop (output normalized and fed back as input, so the axon tunnel
+cannot dedupe dispatches), two-point t(3K)-t(K) outer timing.
+
+Usage: python tools/attn_tune.py [--sweep] [--d128]
+"""
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import pallas_attention as pa
+
+
+def _sync(x):
+    # block_until_ready is a no-op through the axon tunnel; only a host
+    # transfer actually waits on the remote execution (bench.py discipline)
+    float(jnp.sum(x.astype(jnp.float32)).item())
+
+
+def timeit_chained(step, q, r1=8, r2=24, rounds=2):
+    """step: x -> x (same shape/dtype). Returns sec per step call.
+
+    Times single calls of jitted fori_loop chains at two inner rep counts
+    and differences them, so the ~±25 ms axon per-dispatch jitter divides
+    by (r2 - r1) instead of polluting a per-call average."""
+
+    def chain(reps):
+        @jax.jit
+        def multi(x):
+            return jax.lax.fori_loop(0, reps, lambda i, v: step(v), x)
+        return multi
+
+    m1, m2 = chain(r1), chain(r2)
+    state = m2(m1(q))
+    _sync(state)  # both compiled + warm
+
+    samples = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        state = m1(state)
+        _sync(state)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        state = m2(state)
+        _sync(state)
+        t2 = time.perf_counter() - t0
+        samples.append((t2 - t1) / (r2 - r1))
+    return max(1e-9, min(samples))
+
+
+def _norm(g):
+    g32 = g.astype(jnp.float32)
+    n = jax.lax.rsqrt(jnp.mean(g32 * g32) + 1e-9)
+    return (g32 * n).astype(g.dtype)
+
+
+def bench_point(S, B, H, D, bq=None, bk=None, label=""):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+
+    def fwd_step(x):
+        o = pa.flash_attention_fwd(x, x, x, True, None, bq, bk)
+        return _norm(o)
+
+    def loss(x):
+        o = pa.flash_attention_fwd(x, x, x, True, None, bq, bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def fwdbwd_step(x):
+        return _norm(jax.grad(loss)(x))
+
+    causal_mm = B * H * S * S * D  # one causal [S,S]x[S,D]-class dot pair
+    try:
+        tf = timeit_chained(fwd_step, q)
+    except Exception as e:
+        print(f"{label} bq={bq} bk={bk} FWD FAIL: {type(e).__name__}: {str(e)[:140]}")
+        return
+    fwd_tf = 2 * causal_mm / tf / 1e12
+    try:
+        tb = timeit_chained(fwdbwd_step, q)
+    except Exception as e:
+        print(f"{label} bq={bq} bk={bk} fwd {tf*1e3:7.2f}ms {fwd_tf:6.1f}TF | BWD FAIL: {type(e).__name__}: {str(e)[:140]}")
+        return
+    tot_tf = 6 * causal_mm / tb / 1e12   # bench.py accounting: train = 3x fwd
+    bwd_ms = (tb - tf) * 1e3
+    bwd_tf = 4 * causal_mm / max(tb - tf, 1e-9) / 1e12
+    print(f"{label} bq={bq} bk={bk} fwd {tf*1e3:7.2f}ms {fwd_tf:6.1f}TF | "
+          f"bwd {bwd_ms:7.2f}ms {bwd_tf:6.1f}TF | fwd+bwd {tb*1e3:7.2f}ms {tot_tf:6.1f}TF")
+
+
+def bench_jax_reference(S, B, H, D):
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+    except Exception as e:
+        print(f"jax ref import failed: {e}")
+        return
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)  # ref layout BHSD
+
+    def fwd_step(x):
+        return _norm(flash_attention(x, x, x, causal=True))
+
+    def loss(x):
+        return jnp.sum(flash_attention(x, x, x, causal=True).astype(jnp.float32) ** 2)
+
+    def fwdbwd_step(x):
+        return _norm(jax.grad(loss)(x))
+
+    causal_mm = B * H * S * S * D
+    tf = timeit_chained(fwd_step, q)
+    tb = timeit_chained(fwdbwd_step, q)
+    print(f"JAXREF S={S} D={D}: fwd {tf*1e3:7.2f}ms {2*causal_mm/tf/1e12:6.1f}TF | "
+          f"fwd+bwd {tb*1e3:7.2f}ms {6*causal_mm/tb/1e12:6.1f}TF")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--d128", action="store_true")
+    ap.add_argument("--skip-base", action="store_true")
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()} dev={jax.devices()[0].device_kind}")
+    if not args.skip_base:
+        bench_point(16384, 1, 12, 64, label="cur S=16k D=64 ")
+        bench_jax_reference(16384, 1, 12, 64)
+    if args.d128:
+        bench_point(16384, 1, 16, 128, label="cur S=16k D=128")
+        bench_jax_reference(16384, 1, 16, 128)
+    if args.sweep:
+        for bq in (256, 512, 1024, 2048):
+            for bk in (512, 1024, 2048):
+                bench_point(16384, 1, 12, 64, bq, bk, label="sweep D=64 ")
+
+
+if __name__ == "__main__":
+    main()
