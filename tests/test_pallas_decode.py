@@ -100,14 +100,47 @@ def test_generate_cache_key_includes_decode_flag():
         set_flags({"use_pallas_decode_attention": old})
 
 
-def test_supported_predicate_gates_vmem():
+def test_supported_predicate_gates_tiling():
     from paddle_tpu.ops.pallas_decode import decode_attention_supported
     assert decode_attention_supported(256, 768, 12, 2)       # 125M decode
     assert decode_attention_supported(512, 768, 12, 2)
     assert not decode_attention_supported(255, 768, 12, 2)   # L % 8
     assert not decode_attention_supported(256, 760, 12, 2)   # nh % 128
     assert not decode_attention_supported(256, 768, 200, 2)  # heads cap
-    # long caches / big hidden must fall back (VMEM budget): gpt3-13B
-    # dims and a 4k-context 1.3B both exceed one program's VMEM
-    assert not decode_attention_supported(256, 5120, 40, 2)
-    assert not decode_attention_supported(4096, 2048, 16, 2)
+    # the kernel tiles L with online softmax (r5), so 13B dims and a
+    # 4k-context 1.3B run fused now — the old whole-L VMEM gate is gone
+    assert decode_attention_supported(256, 5120, 40, 2)
+    assert decode_attention_supported(4096, 2048, 16, 2)
+    assert decode_attention_supported(16384, 2048, 16, 2)
+
+
+def test_decode_attention_tiled_long_cache():
+    """Caches long enough to force nl > 1 L-tiles must match the dense
+    reference (online-softmax accumulation across tiles), including when
+    `off` leaves whole tail tiles fully masked."""
+    from paddle_tpu.ops import pallas_decode as pd
+    rs = np.random.RandomState(3)
+    B, L, N, H = 2, 1024, 4, 64
+    nh = N * H
+    bl = pd._pick_bl(L, nh, 2)
+    # shrink the budget so this shape genuinely tiles in interpret mode
+    old = pd._VMEM_BUDGET
+    pd._VMEM_BUDGET = pd._per_row_bytes(nh, 4) * 128
+    pd._pick_bl.cache_clear()
+    try:
+        assert pd._pick_bl(L, nh, 4) < L   # really exercising tiling
+        for off in (1023, 517, 40):        # full, mid-tile, first-tile
+            q4 = rs.randn(B, 1, N, H).astype(np.float32)
+            k4 = rs.randn(B, L, N, H).astype(np.float32)
+            v4 = rs.randn(B, L, N, H).astype(np.float32)
+            out = pd.decode_attention(
+                jnp.asarray(q4.reshape(B, 1, nh)),
+                jnp.asarray(k4.reshape(B, L, nh)),
+                jnp.asarray(v4.reshape(B, L, nh)),
+                jnp.asarray(off, jnp.int32), N)
+            ref = _ref(q4, k4, v4, off).reshape(B, 1, nh)
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-5,
+                                       atol=3e-5)
+    finally:
+        pd._VMEM_BUDGET = old
+        pd._pick_bl.cache_clear()
