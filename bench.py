@@ -14,20 +14,11 @@ import time
 import numpy as np
 
 
-# bf16 peak FLOP/s per chip by device kind
-_PEAK = {
-    "v2": 45e12, "v3": 123e12, "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-}
-
-
 def _peak_flops(kind):
-    kind = kind.lower()
-    for key, val in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return val
-    return None
+    """bf16 peak FLOP/s by device kind — one table for bench + training
+    telemetry (paddle_tpu.telemetry.mfu owns it)."""
+    from paddle_tpu.telemetry.mfu import device_peak_flops
+    return device_peak_flops(kind)
 
 
 def _fetch_latency(sync):
@@ -171,6 +162,23 @@ def main():
             out["error"] = f"{type(e).__name__}: {str(e)[:200]}"
             return out
 
+    # every phase result also goes through the telemetry sink (one
+    # schema for bench lines AND training-run logs; tools/trace_check.py
+    # validates it). --telemetry PATH overrides the default file.
+    from paddle_tpu import telemetry
+    tpath = "bench_telemetry.jsonl"
+    if "--telemetry" in sys.argv[1:-1]:   # flag needs a following value
+        tpath = sys.argv[sys.argv.index("--telemetry") + 1]
+    tsink = telemetry.JsonlSink(tpath)
+    tsink.write(telemetry.make_phase_record("gpt3_125m_train", {
+        "tokens_per_sec": round(tokens_per_sec, 1), "mfu": round(mfu, 4),
+        "sec_per_step": sec_per_step, "n_params": n_params,
+        "device": dev.device_kind}))
+
+    def phase_logged(name, result):
+        tsink.write(telemetry.make_phase_record(name, result))
+        return result
+
     resnet = phase(bench_resnet50, on_tpu, peak,
                    images_per_sec=0.0, mfu=0.0,
                    pipelined_images_per_sec=0.0,
@@ -188,6 +196,12 @@ def main():
     attn16k = phase(bench_attn_16k, on_tpu, fwd_ms=0.0, bwd_ms=0.0,
                     ms=0.0, tflops=0.0, d64_fwd_ms=0.0, d64_bwd_ms=0.0,
                     d64_ms=0.0, d64_tflops=0.0)
+    for name, result in (("resnet50", resnet), ("gpt1_3b_layer", layer13),
+                         ("gpt1_3b_full", full13),
+                         ("gpt1_3b_full_4k", full13_4k),
+                         ("decode_wo8", decode), ("bert_base", bert),
+                         ("attn_16k", attn16k)):
+        phase_logged(name, result)
 
     print(json.dumps({
         "metric": "gpt3_125m_train_tokens_per_sec_per_chip",

@@ -18,9 +18,28 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import monitor
 from ..core.tensor import Tensor, apply
 from ..tensor._helpers import ensure_tensor
 from . import env
+
+
+def _comm_span(name):
+    """Telemetry hook shared by every collective: a host span tagged
+    cat='collective' (so TelemetryRecorder attributes per-step comm time
+    and the Chrome trace shows it per rank) plus a `comm.<name>` monitor
+    counter. For the shard_map primitives the span covers trace time and
+    the named_scope inside `_traced_collective` labels the op in the
+    XPlane device trace, where its real run time lives."""
+    from .. import telemetry
+    monitor.incr(f"comm.{name}")
+    return telemetry.span(f"collective.{name}", cat="collective")
+
+
+def _traced_collective(name, fn, t):
+    with _comm_span(name):
+        return apply(lambda v: jax.named_scope(f"collective.{name}")(fn)(v),
+                     t)
 
 
 class ReduceOp:
@@ -86,7 +105,8 @@ def get_rank(group=None):
 
 
 def barrier(group=None):
-    jnp.zeros(()).block_until_ready()
+    with _comm_span("barrier"):
+        jnp.zeros(()).block_until_ready()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
@@ -107,18 +127,20 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
     Under single-controller XLA every collective is synchronous in
     program order (no comm streams exist to toggle), so both carry no
     behavioral weight; neither is silently dropped from the signature."""
-    t = ensure_tensor(tensor)
-    mesh = env.current_mesh()
-    if mesh is not None:
-        sh = env.replicated(mesh)
-        t._value = jax.device_put(t._value, sh) if not _is_traced(t) else \
-            jax.lax.with_sharding_constraint(t._value, sh)
-    return t
+    with _comm_span("all_reduce"):
+        t = ensure_tensor(tensor)
+        mesh = env.current_mesh()
+        if mesh is not None:
+            sh = env.replicated(mesh)
+            t._value = jax.device_put(t._value, sh) if not _is_traced(t) \
+                else jax.lax.with_sharding_constraint(t._value, sh)
+        return t
 
 
 def broadcast(tensor, src=0, group=None, use_calc_stream=True,
               sync_op=None):
-    return ensure_tensor(tensor)
+    with _comm_span("broadcast"):
+        return ensure_tensor(tensor)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None,  # noqa: A001
@@ -128,11 +150,12 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None,  # noqa: A001
 
 def all_gather(tensor_list, tensor, group=None, use_calc_stream=True,
                sync_op=None):
-    t = ensure_tensor(tensor)
-    n = (group or _world()).nranks
-    for _ in range(max(n, 1)):
-        tensor_list.append(t)
-    return tensor_list
+    with _comm_span("all_gather"):
+        t = ensure_tensor(tensor)
+        n = (group or _world()).nranks
+        for _ in range(max(n, 1)):
+            tensor_list.append(t)
+        return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -149,11 +172,12 @@ def scatter(tensor, tensor_list=None, src=0, group=None,
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None,
              use_calc_stream=True, sync_op=None):
-    outs = [ensure_tensor(t) for t in in_tensor_list]
-    if out_tensor_list is not None:
-        out_tensor_list.extend(outs)
-        return out_tensor_list
-    return outs
+    with _comm_span("alltoall"):
+        outs = [ensure_tensor(t) for t in in_tensor_list]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return out_tensor_list
+        return outs
 
 
 def send(tensor, dst=0, group=None, use_calc_stream=True, sync_op=None):
@@ -171,43 +195,49 @@ def _is_traced(t):
 # ---- shard_map-region primitives (lax collectives) ------------------------
 
 def psum(tensor, axis_name):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.psum(v, axis_name), t)
+    return _traced_collective(
+        "psum", lambda v: jax.lax.psum(v, axis_name),
+        ensure_tensor(tensor))
 
 
 def pmean(tensor, axis_name):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.pmean(v, axis_name), t)
+    return _traced_collective(
+        "pmean", lambda v: jax.lax.pmean(v, axis_name),
+        ensure_tensor(tensor))
 
 
 def pmax(tensor, axis_name):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.pmax(v, axis_name), t)
+    return _traced_collective(
+        "pmax", lambda v: jax.lax.pmax(v, axis_name),
+        ensure_tensor(tensor))
 
 
 def all_gather_axis(tensor, axis_name, axis=0, tiled=True):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.all_gather(v, axis_name, axis=axis,
-                                              tiled=tiled), t)
+    return _traced_collective(
+        "all_gather", lambda v: jax.lax.all_gather(
+            v, axis_name, axis=axis, tiled=tiled),
+        ensure_tensor(tensor))
 
 
 def reduce_scatter_axis(tensor, axis_name, axis=0):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.psum_scatter(v, axis_name,
-                                                scatter_dimension=axis,
-                                                tiled=True), t)
+    return _traced_collective(
+        "reduce_scatter", lambda v: jax.lax.psum_scatter(
+            v, axis_name, scatter_dimension=axis, tiled=True),
+        ensure_tensor(tensor))
 
 
 def ppermute(tensor, axis_name, perm):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.ppermute(v, axis_name, perm), t)
+    return _traced_collective(
+        "ppermute", lambda v: jax.lax.ppermute(v, axis_name, perm),
+        ensure_tensor(tensor))
 
 
 def all_to_all_axis(tensor, axis_name, split_axis, concat_axis):
-    t = ensure_tensor(tensor)
-    return apply(lambda v: jax.lax.all_to_all(
-        v, axis_name, split_axis=split_axis, concat_axis=concat_axis,
-        tiled=True), t)
+    return _traced_collective(
+        "all_to_all", lambda v: jax.lax.all_to_all(
+            v, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            tiled=True),
+        ensure_tensor(tensor))
 
 
 # ---- model-parallel split op (reference collective.py:1233) ---------------

@@ -905,7 +905,10 @@ class PipelineParallel(Layer):
                         rng_guard(jax.random.fold_in(key_cell[0],
                                                      base + li)):
                     return template(Tensor(carry))._value, None
-            out, _ = jax.lax.scan(body, h, (list(stack_vals), idx))
+            # telemetry tag: pipeline-stage work shows up as one named
+            # region per stage in the XPlane device trace
+            with jax.named_scope("pipeline.stage"):
+                out, _ = jax.lax.scan(body, h, (list(stack_vals), idx))
             return out
 
         def tail_apply(tail_vals, h, fn):
@@ -1075,18 +1078,22 @@ class PipelineParallel(Layer):
             else:
                 grads[id(p)] = (p, g)
 
+        from .. import telemetry
         if fused:
-            cache = self._ensure_stacked(plan, mesh, optimizer)
+            with telemetry.span("pipeline.stack_params", cat="pipeline"):
+                cache = self._ensure_stacked(plan, mesh, optimizer)
             if self._pipe_step is None or self._pipe_step_key != key:
-                self._pipe_step = self._build_pipelined_step(
-                    plan, mesh, n_micro, optimizer=optimizer)
+                with telemetry.span("pipeline.build_step", cat="pipeline"):
+                    self._pipe_step = self._build_pipelined_step(
+                        plan, mesh, n_micro, optimizer=optimizer)
                 self._pipe_step_key = key
             front_vals = [p._value for p in plan["front_params"]]
             tail_vals = [p._value for p in plan["tail_params"]]
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-            loss, gfront, gtail, new_vals, new_states = self._pipe_step(
-                front_vals, cache["vals"], list(cache["states"]),
-                tail_vals, xv, yv, lr, rng)
+            with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
+                loss, gfront, gtail, new_vals, new_states = self._pipe_step(
+                    front_vals, cache["vals"], list(cache["states"]),
+                    tail_vals, xv, yv, lr, rng)
             cache["vals"] = new_vals
             cache["states"] = new_states
             self._scatter_block_views(plan, optimizer, cache)
@@ -1104,7 +1111,9 @@ class PipelineParallel(Layer):
             return Tensor(loss)
 
         if self._pipe_step is None or self._pipe_step_key != key:
-            self._pipe_step = self._build_pipelined_step(plan, mesh, n_micro)
+            with telemetry.span("pipeline.build_step", cat="pipeline"):
+                self._pipe_step = self._build_pipelined_step(plan, mesh,
+                                                             n_micro)
             self._pipe_step_key = key
         front_vals = [p._value for p in plan["front_params"]]
         tail_vals = [p._value for p in plan["tail_params"]]
@@ -1112,12 +1121,14 @@ class PipelineParallel(Layer):
         # explicit placement: rows may mix committed view slices (from a
         # previous fused step) with fresh arrays, and committed args must
         # match the jit's declared stacked shardings
-        stack_vals = [
-            jax.device_put(jnp.stack([r[j]._value for r in rows]),
-                           _stacked_sharding(tp, mesh))
-            for j, tp in enumerate(plan["template_params"])]
-        loss, gfront, gstack, gtail = self._pipe_step(
-            front_vals, stack_vals, tail_vals, xv, yv, rng)
+        with telemetry.span("pipeline.stack_params", cat="pipeline"):
+            stack_vals = [
+                jax.device_put(jnp.stack([r[j]._value for r in rows]),
+                               _stacked_sharding(tp, mesh))
+                for j, tp in enumerate(plan["template_params"])]
+        with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
+            loss, gfront, gstack, gtail = self._pipe_step(
+                front_vals, stack_vals, tail_vals, xv, yv, rng)
         for p, g in zip(plan["front_params"], gfront):
             add(p, g)
         for i, row in enumerate(rows):
@@ -1241,6 +1252,18 @@ class PipelineParallel(Layer):
         split into `accumulate_steps` microbatches, grads accumulate across
         them, one optimizer step at the end). On a pp>1 mesh the step runs
         the 1F1B pp-sharded executor (see class docstring)."""
+        # flight-recorder integration: a context-active TelemetryRecorder
+        # records each train_batch as one step (loss noted on return)
+        from .. import monitor, telemetry
+        monitor.incr("pipeline.train_batches")
+        with telemetry.auto_step() as _tw:
+            out = self._train_batch_impl(data, optimizer, lr_scheduler,
+                                         scaler)
+            _tw.note(loss=out)
+            return out
+
+    def _train_batch_impl(self, data, optimizer, lr_scheduler=None,
+                          scaler=None):
         self._layers.train()   # reference train_batch:81 resets the mode
         x, y = data
         loss_fn = self._layers._loss_fn

@@ -207,12 +207,23 @@ class ShardedTrainStep:
                        donate_argnums=donate)
 
     def __call__(self, *batch):
+        # flight-recorder integration (see jit.TrainStep.__call__): a
+        # context-active TelemetryRecorder records this step too
+        from .. import telemetry
+        with telemetry.auto_step() as _tw:
+            out = self._run_step(*batch)
+            _tw.note(loss=out)
+            return out
+
+    def _run_step(self, *batch):
+        from .. import telemetry
         from ..flags import get_flag
         check = get_flag("check_nan_inf")
         if self._jitted is None or getattr(self, "_check_key", None) != check:
             self._jitted = self._make_step(check_nan_inf=check)
             self._check_key = check
-        batch_vals = shard_batch(batch, self.mesh, self.seq_shard)
+        with telemetry.span("sharded.shard_batch", cat="h2d"):
+            batch_vals = shard_batch(batch, self.mesh, self.seq_shard)
         param_vals = [p._value for p in self.params]
         opt_states = [self.optimizer._states[id(p)] for p in self.params]
         buffer_vals = [b._value for b in self.buffers]
@@ -220,25 +231,28 @@ class ShardedTrainStep:
             # async H2D: bring host-resident states onto the chip for the
             # update (device_put returns immediately; the transfer
             # overlaps the batch sharding / dispatch work above)
-            opt_states = [
-                {k: jax.device_put(v, dsh)
-                 if getattr(getattr(v, "sharding", None), "memory_kind",
-                            None) == "pinned_host" else v
-                 for k, v in st.items()}
-                for dsh, st in zip(self._dev_state_sh, opt_states)]
+            with telemetry.span("sharded.offload_h2d", cat="h2d"):
+                opt_states = [
+                    {k: jax.device_put(v, dsh)
+                     if getattr(getattr(v, "sharding", None), "memory_kind",
+                                None) == "pinned_host" else v
+                     for k, v in st.items()}
+                    for dsh, st in zip(self._dev_state_sh, opt_states)]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
-        loss, new_vals, new_states, new_buf, checks = self._jitted(
-            param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        with telemetry.span("sharded.step_dispatch", cat="dispatch"):
+            loss, new_vals, new_states, new_buf, checks = self._jitted(
+                param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
         if self.offload:
             # async D2H: evict the updated states back to pinned_host so
             # HBM is free of them between steps
-            new_states = [
-                {k: jax.device_put(v, hsh)
-                 if np.shape(v) == tuple(nv.shape) else v
-                 for k, v in st.items()}
-                for hsh, nv, st in zip(self._host_state_sh, new_vals,
-                                       new_states)]
+            with telemetry.span("sharded.offload_d2h", cat="d2h"):
+                new_states = [
+                    {k: jax.device_put(v, hsh)
+                     if np.shape(v) == tuple(nv.shape) else v
+                     for k, v in st.items()}
+                    for hsh, nv, st in zip(self._host_state_sh, new_vals,
+                                           new_states)]
         for p, v in zip(self.params, new_vals):
             p._value = v
             p.grad = None

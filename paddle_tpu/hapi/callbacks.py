@@ -239,6 +239,66 @@ class ReduceLROnPlateau(Callback):
             self.wait = 0
 
 
+class TelemetryCallback(Callback):
+    """Model.fit integration of the training flight recorder
+    (`paddle_tpu.telemetry`): every train batch becomes one step record
+    in a JSONL log — wall time, compile/execute split, tokens/sec, MFU,
+    memory, per-collective time — and host spans export as a Chrome
+    trace. The VisualDL scalars file tells you WHAT the loss did; this
+    tells you WHERE the step time went.
+
+    cb = TelemetryCallback("run.jsonl", tokens_per_step=B*S,
+                           flops_per_token=telemetry.model_flops_per_token(...))
+    model.fit(..., callbacks=[cb]); cb.recorder.records / cb.export(path)
+    """
+
+    def __init__(self, path=None, tokens_per_step=None, flops_per_step=None,
+                 flops_per_token=None, peak_flops=None, recorder=None):
+        super().__init__()
+        if recorder is None:
+            from .. import telemetry
+            recorder = telemetry.TelemetryRecorder(
+                sink=path, tokens_per_step=tokens_per_step,
+                flops_per_step=flops_per_step,
+                flops_per_token=flops_per_token, peak_flops=peak_flops)
+        self.recorder = recorder
+        self._activated = False
+
+    def on_train_begin(self, logs=None):
+        # context-activate the recorder for the whole fit: collective /
+        # pipeline / h2d spans (telemetry.span) record into the ACTIVE
+        # recorder only. TrainStep's auto_step stays inert because this
+        # callback opens the step window first (on_train_batch_begin
+        # fires before train_batch), so the loss still attaches here.
+        if not self._activated:
+            self.recorder.__enter__()
+            self._activated = True
+
+    def on_train_batch_begin(self, step, logs=None):
+        if not self.recorder._open:
+            self.recorder.start_step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.recorder._open:
+            loss = (logs or {}).get("loss")
+            if isinstance(loss, (list, tuple)) and loss:
+                loss = loss[0]
+            loss = np.ravel(loss)[0] if loss is not None else None
+            self.recorder.end_step(loss=loss)
+
+    def on_train_end(self, logs=None):
+        if self.recorder._open:   # tail window from an aborted batch
+            self.recorder.end_step()
+        if self._activated:
+            self.recorder.__exit__(None, None, None)
+            self._activated = False
+
+    def export(self, path, extra_sources=(), align_on=None):
+        """Write this run's host spans as a Chrome trace."""
+        return self.recorder.export_chrome_tracing(
+            path, extra_sources=extra_sources, align_on=align_on)
+
+
 class VisualDL(Callback):
     """Scalar logger (reference logs to VisualDL; here a simple JSONL file,
     TensorBoard-compatible via jax.profiler for traces)."""

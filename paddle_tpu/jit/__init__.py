@@ -288,6 +288,17 @@ class TrainStep:
         return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
+        # flight-recorder integration: a context-active TelemetryRecorder
+        # sees every step (wall time + the compile/execute split via the
+        # jax.monitoring compile events this dispatch may emit) with no
+        # call-site changes; inert (one stack peek) when no recorder is on
+        from .. import telemetry
+        with telemetry.auto_step() as _tw:
+            out = self._run_step(*batch)
+            _tw.note(loss=out)
+            return out
+
+    def _run_step(self, *batch):
         from ..amp import amp_state
         from .. import flags
         st = amp_state()

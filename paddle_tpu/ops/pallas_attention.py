@@ -213,6 +213,11 @@ def _fwd_kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
     bn, sq, h = qr.shape
     T = nq * (nq + 1) // 2
+    # exact live-tile fraction of the full nq x nq square: the cost
+    # estimate below quotes full-square costs scaled by this, so the
+    # scheduler sees the causal work the grid actually runs (~half),
+    # not the ~2x-overstated dense cost
+    frac = (nq + 1) / (2 * nq)
 
     def qmap(bn_, t):
         return (bn_, _tri_fwd_decode(t)[0], 0)
@@ -227,6 +232,11 @@ def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
         return (bn_, 0, _tri_fwd_decode(t)[0])
 
     kernel = functools.partial(_fwd_kernel_tri, bq=bq, bk=bk)
+    # SEQUENTIAL-GRID INVARIANT: the flat-index dimension (T) enumerates
+    # live tiles in row-major order and the kernel's running softmax
+    # state (acc/m/l scratch) carries across its steps; this dimension
+    # must NEVER be marked parallel (dimension_semantics) — Mosaic's
+    # default sequential execution is load-bearing.
     out, lse = pl.pallas_call(
         kernel,
         grid=(bn, T),
@@ -249,9 +259,12 @@ def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2 * bn * sq * sq * h,
-            bytes_accessed=(qr.size * 2 + kr.size + vr.size) * qr.dtype.itemsize,
-            transcendentals=bn * sq * sq // 2),
+            # full-square costs (4 flops/elem over sq x sq scores + pv,
+            # 1 exp/elem, dense q/k/v/o traffic) x the live-tile fraction
+            flops=int(4 * bn * sq * sq * h * frac),
+            bytes_accessed=int((qr.size * 2 + kr.size + vr.size)
+                               * qr.dtype.itemsize * frac),
+            transcendentals=int(bn * sq * sq * frac)),
         interpret=_interpret(),
     )(qr, kr, vr)
     return out, lse
@@ -570,6 +583,10 @@ def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
     r = bk // bq
     nk = sq // bk
     T = nk * nq - r * nk * (nk - 1) // 2
+    # exact live-tile fraction of the full nk x nq tile square (~(nq+1)/
+    # (2*nq) at r=1): scales the full-square cost estimate below so the
+    # scheduler no longer sees ~2x-overstated causal backward cost
+    frac = T / (nk * nq)
 
     def qmap(bn_, t):
         return (bn_, _tri_bwd_decode(t, nq, r)[1], 0)
@@ -582,6 +599,15 @@ def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
 
     kernel = functools.partial(
         _bwd_merged_kernel_tri, bq=bq, bk=bk, nq=nq, r=r)
+    # SEQUENTIAL-GRID INVARIANT: the flat-index dimension (T) walks live
+    # tiles column-major and the kernel relies on Mosaic's sequential
+    # grid order twice — (a) dk/dv scratch accumulates down each column,
+    # and (b) a dq output window is revisited across columns with its
+    # COMPLETE value flushed only in the diagonal column (_flush_dq);
+    # intermediate revisits DMA whatever the buffer holds and are
+    # overwritten in order. Marking this grid dimension parallel
+    # (dimension_semantics) would silently corrupt dq and dk/dv — never
+    # do it.
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(bn, T),
@@ -609,9 +635,12 @@ def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
             pltpu.VMEM((sq, h), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=5 * bn * sq * sq * h,
-            bytes_accessed=(qr.size * 4 + kr.size * 4) * qr.dtype.itemsize,
-            transcendentals=bn * sq * sq // 2),
+            # full-square costs (5 MXU dots/tile = 10 flops/elem, 1 exp/
+            # elem, q/do/lse/delta + k/v + dq/dk/dv traffic) x live frac
+            flops=int(10 * bn * sq * sq * h * frac),
+            bytes_accessed=int((qr.size * 4 + kr.size * 4)
+                               * qr.dtype.itemsize * frac),
+            transcendentals=int(bn * sq * sq * frac)),
         interpret=_interpret(),
     )(qr, kr, vr, gr, lse, delta)
     return dq, dk, dv

@@ -1,4 +1,4 @@
-"""paddle_tpu.profiler — host spans + device traces.
+"""paddle_tpu.profiler — host spans + device traces (legacy surface).
 
 Reference analog: `platform/profiler.h:130` RecordEvent RAII spans with
 EnableProfiler/DisableProfiler summary tables, and DeviceTracer's CUPTI
@@ -7,6 +7,15 @@ tracing is `jax.profiler` (XPlane -> TensorBoard, captures XLA ops and ICI
 collectives); this module keeps the RecordEvent-style host span API, a
 sorted summary table, and wraps jax.profiler start/stop so one call
 produces both views.
+
+DEPRECATION PATH: step-level observability now lives in
+`paddle_tpu.telemetry` (the training flight recorder: per-step JSONL with
+the compile/execute split, MFU, per-collective time, multi-rank chrome
+export). Direct `start_profiler`/`RecordEvent` use stays supported for
+span summary tables, but new instrumentation should go through
+`telemetry.span` / `TelemetryRecorder` — telemetry spans recorded while
+this profiler is enabled ALSO land here, so the two views never diverge;
+the reverse direction is not bridged and will not grow new features.
 """
 import contextlib
 import threading

@@ -1,0 +1,41 @@
+"""paddle_tpu.telemetry — the training flight recorder.
+
+Unifies the three older observability stubs into one step-level layer:
+
+- `profiler.py` host spans (RecordEvent)  -> `telemetry.span` /
+  recorder span buffer + multi-rank Chrome-trace export;
+- `monitor.py` counters                   -> advanced automatically per
+  recorded step (`telemetry.steps`, `telemetry.compile_cache_*`);
+- `distributed/metrics.py` eval stats     -> unchanged (eval-metric math),
+  but per-step comm/step telemetry now lives here.
+
+Reference analogs: `platform/profiler.h` RecordEvent + DeviceTracer and
+`tools/CrossStackProfiler`'s per-rank merge; JAX-era device detail stays
+on `jax.profiler` (XPlane/TensorBoard) — this layer owns the host-side
+step ledger: wall time, compile vs. execute split, tokens/sec, MFU,
+memory, per-collective time.
+
+Entry points:
+- TelemetryRecorder — per-step JSONL records; context-activate it and
+  `jit.TrainStep` / `distributed.ShardedTrainStep` record themselves.
+- StepTimer — explicit jax.stages AOT compile-cache wrapper.
+- hapi.callbacks.TelemetryCallback — Model.fit integration.
+- sink.export_chrome_tracing / tools/trace_check.py — trace tooling.
+"""
+from . import mfu  # noqa: F401
+from . import sink  # noqa: F401
+from .mfu import (  # noqa: F401
+    device_peak_flops, model_flops_per_token, train_step_flops)
+from .recorder import (  # noqa: F401
+    StepTimer, TelemetryRecorder, auto_step, current_recorder, span)
+from .sink import (  # noqa: F401
+    JsonlSink, export_chrome_tracing, make_phase_record, make_step_record,
+    read_jsonl, validate_step_record)
+
+__all__ = [
+    "TelemetryRecorder", "StepTimer", "span", "auto_step",
+    "current_recorder", "JsonlSink", "read_jsonl", "make_step_record",
+    "make_phase_record", "validate_step_record", "export_chrome_tracing",
+    "device_peak_flops", "model_flops_per_token", "train_step_flops",
+    "mfu", "sink",
+]

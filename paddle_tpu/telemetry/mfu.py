@@ -1,0 +1,75 @@
+"""MFU / throughput accounting: model FLOPs x measured time / device peak.
+
+One home for the three inputs every MFU number needs:
+
+- model FLOPs per step — either analytic (PaLM-style 6N + attention term,
+  `model_flops_per_token`), hook-counted (`hapi.flops.flops`), or exact
+  from the compiled program (`hapi.flops.flops_compiled` /
+  `cost_model.CostModel` — XLA's own cost analysis);
+- measured step time — from the TelemetryRecorder;
+- device peak FLOP/s — `device_peak_flops` below, keyed on the JAX
+  device_kind string (bf16 peaks; the table bench.py's MFU numbers have
+  always used, now shared).
+"""
+import jax
+
+
+# bf16 peak FLOP/s per chip by device kind substring
+PEAK_FLOPS_BY_KIND = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def device_peak_flops(kind=None):
+    """Peak bf16 FLOP/s for a device-kind string (longest-substring match,
+    e.g. 'TPU v5 lite' -> 197e12). kind=None reads the default jax device.
+    Returns None when unknown (CPU backends) — callers treat that as
+    'MFU not computable' and report 0.0."""
+    if kind is None:
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(kind).lower()
+    for key, val in sorted(PEAK_FLOPS_BY_KIND.items(),
+                           key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return None
+
+
+def model_flops_per_token(n_params, num_layers=0, hidden_size=0, seq_len=0):
+    """PaLM-style train FLOPs per token: 6N for the parameter matmuls
+    (fwd 2N + bwd 4N) plus 12*L*H*S for self-attention score/value work."""
+    return 6 * int(n_params) + 12 * int(num_layers) * int(hidden_size) \
+        * int(seq_len)
+
+
+def mfu(flops_per_step, step_time_s, peak_flops=None, n_devices=1):
+    """Model FLOPs utilization in [0, ~1]: achieved model FLOP/s over the
+    aggregate peak. Returns 0.0 (finite) when the peak is unknown or the
+    window is degenerate, never NaN/inf."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops()
+    if not peak_flops or not step_time_s or step_time_s <= 0:
+        return 0.0
+    return float(flops_per_step) / float(step_time_s) \
+        / (float(peak_flops) * max(1, int(n_devices)))
+
+
+def train_step_flops(loss_fn, example_batch, model=None):
+    """EXACT per-step FLOPs: lower loss_fn through XLA with backprop (the
+    `hapi.flops.flops_compiled` feedback loop — fusion and the dL/dW
+    contractions included) and read the compiler's own cost analysis.
+    Returns None when the backend refuses cost analysis; callers fall back
+    to the analytic `model_flops_per_token` formula."""
+    try:
+        from ..hapi.flops import flops_compiled
+        got = flops_compiled(loss_fn, list(example_batch),
+                             backprop=True, net=model)
+        flops = float(got.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None

@@ -1,0 +1,397 @@
+"""Training flight recorder: per-step telemetry with compile/execute split.
+
+The reference stack spreads this over RecordEvent/DeviceTracer
+(`platform/profiler.h`), the Monitor StatRegistry (`platform/monitor.h`)
+and ad-hoc trainer logging; here one recorder unifies them for the
+TPU-native regime, where the interesting split is *XLA compile time vs.
+execute time*, not per-op kernels (XLA owns those — `jax.profiler`'s
+XPlane trace covers device detail).
+
+Mechanics:
+
+- compile time is observed through `jax.monitoring`'s event-duration
+  stream (jaxpr trace + MLIR lowering + backend_compile — the same
+  events `jax.stages` lowering/compilation emit), accumulated into
+  whichever step window is open. Step 0 of a jitted loop therefore shows
+  nonzero compile_ms; steady-state steps show 0.0 and advance the
+  compile-cache hit counter.
+- spans (`telemetry.span("name")`) are host intervals tagged with the
+  recorder's rank; `distributed/collective.py` tags each eager
+  collective, so per-step comm time is attributable. Spans export to a
+  multi-rank Chrome trace (sink.export_chrome_tracing).
+- every closed step writes one JSONL record (sink.make_step_record):
+  step, loss, step_ms, compile_ms, execute_ms, tokens/sec, MFU,
+  mem_bytes, per-collective ms, cache hit/miss counters.
+- `paddle_tpu.monitor` counters (`telemetry.steps`,
+  `telemetry.compile_cache_hits/misses`) advance with every step so a
+  stuck job is still triagable from `monitor.snapshot()` alone.
+"""
+import contextlib
+import threading
+import time
+
+import jax
+
+from .. import monitor
+from . import mfu as _mfu
+from .sink import JsonlSink, make_step_record
+
+_LOCK = threading.Lock()
+_RECORDER_STACK = []          # active (context-entered) recorders
+_OPEN_STEPS = []              # open _StepWindow objects (compile sink)
+_LISTENER_INSTALLED = False
+
+# jax.monitoring events that constitute "compile" for the split; all
+# three fire on a jit cache miss and none on a hit
+_COMPILE_EVENTS = (
+    "/jax/core/compile/jaxpr_trace_duration",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+    "/jax/core/compile/backend_compile_duration",
+)
+
+
+def _compile_listener(event, duration, **kwargs):
+    if event not in _COMPILE_EVENTS:
+        return
+    with _LOCK:
+        for win in _OPEN_STEPS:
+            win.compile_secs += duration
+
+
+def _install_listener():
+    """Idempotently hook jax's compile-event stream. The listener stays
+    registered for the process lifetime (it is a no-op with no open step
+    windows — a dict lookup and a lock-free len check)."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    jax.monitoring.register_event_duration_secs_listener(_compile_listener)
+    _LISTENER_INSTALLED = True
+
+
+def current_recorder():
+    """The innermost context-active TelemetryRecorder, or None."""
+    return _RECORDER_STACK[-1] if _RECORDER_STACK else None
+
+
+class _StepWindow:
+    """One open step measurement: wall clock + compile accumulation +
+    span capture start index."""
+
+    def __init__(self, recorder):
+        self.recorder = recorder
+        self.compile_secs = 0.0
+        self.loss = None
+        self.extra = {}
+        self.span_start = len(recorder.spans)
+        self.t0 = time.perf_counter()
+
+    def note(self, loss=None, **extra):
+        """Attach the step's loss (Tensor/array/float — fetched lazily at
+        close, which also syncs the device) and any extra record fields."""
+        if loss is not None:
+            self.loss = loss
+        self.extra.update(extra)
+        return self
+
+
+@contextlib.contextmanager
+def auto_step(**extra):
+    """Bracket a train-step body with the active recorder, if any.
+
+    Used by TrainStep/ShardedTrainStep so any step executed while a
+    recorder is context-active gets recorded with zero call-site changes.
+    Re-entrant calls (a recorder-managed wrapper around an instrumented
+    step) record only the OUTERMOST window. Yields a _StepWindow (or an
+    inert one when no recorder is active) whose .note(loss=...) feeds the
+    record.
+    """
+    rec = current_recorder()
+    if rec is None or rec._open:
+        yield _InertWindow()
+        return
+    win = rec.start_step()
+    if extra:
+        win.extra.update(extra)
+    try:
+        yield win
+    finally:
+        rec.end_step()
+
+
+class _InertWindow:
+    def note(self, loss=None, **extra):
+        return self
+
+
+@contextlib.contextmanager
+def span(name, cat="host", rank=None):
+    """Record a named host span into the active recorder (and bridge it
+    into paddle_tpu.profiler's table when that profiler is enabled, so
+    existing RecordEvent consumers keep seeing one merged view)."""
+    rec = current_recorder()
+    from .. import profiler as _profiler
+    ev = _profiler.RecordEvent(name) if _profiler._GLOBAL["enabled"] else None
+    t0 = time.perf_counter()
+    if ev is not None:
+        ev._t0 = t0
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        if ev is not None:
+            ev.end()
+        if rec is not None:
+            rec.add_span(name, t0, dur, cat=cat, rank=rank)
+
+
+class StepTimer:
+    """Explicit compile/execute split for a plain jittable function via
+    `jax.stages`: an AOT cache keyed on input avals. A key miss runs
+    lower()+compile() under the clock (compile_ms); a hit dispatches the
+    cached executable (execute only). The deterministic-counter
+    counterpart to the listener-based split in TelemetryRecorder.
+
+    timer = StepTimer(fn); out = timer(*args)
+    timer.cache_hits / timer.cache_misses / timer.last_compile_ms
+    """
+
+    def __init__(self, fn, recorder=None):
+        self._fn = fn
+        self._cache = {}
+        self._last_compiled = None
+        self.recorder = recorder
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.last_compile_ms = 0.0
+        self.last_execute_ms = 0.0
+
+    @staticmethod
+    def _key(args):
+        leaves = jax.tree_util.tree_leaves(args)
+        return tuple(
+            (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x))))
+            for x in leaves)
+
+    def __call__(self, *args):
+        key = self._key(args)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            t0 = time.perf_counter()
+            compiled = jax.jit(self._fn).lower(*args).compile()
+            self.last_compile_ms = (time.perf_counter() - t0) * 1000.0
+            self._cache[key] = compiled
+            self._last_compiled = compiled
+            self.cache_misses += 1
+            monitor.incr("telemetry.aot_cache_misses")
+        else:
+            self.last_compile_ms = 0.0
+            self.cache_hits += 1
+            monitor.incr("telemetry.aot_cache_hits")
+        t0 = time.perf_counter()
+        out = compiled(*args)
+        jax.block_until_ready(out)
+        self.last_execute_ms = (time.perf_counter() - t0) * 1000.0
+        if self.recorder is not None:
+            self.recorder.record_external_step(
+                step_ms=self.last_compile_ms + self.last_execute_ms,
+                compile_ms=self.last_compile_ms)
+        return out
+
+    def memory_analysis(self):
+        """Compiled memory analysis of the last-compiled executable (HBM
+        argument/output/temp bytes), None when unavailable."""
+        if self._last_compiled is None:
+            return None
+        try:
+            return self._last_compiled.memory_analysis()
+        except Exception:
+            return None
+
+
+class TelemetryRecorder:
+    """Flight recorder for a training loop.
+
+    rec = TelemetryRecorder(sink="run.jsonl", tokens_per_step=B*S,
+                            flops_per_token=mfu.model_flops_per_token(...))
+    with rec:                      # recorder active: TrainStep auto-records
+        for batch in loader:
+            loss = train_step(*batch)
+
+    or wrap an arbitrary step callable:  step = rec.wrap(train_step).
+
+    Per closed step, one schema record (sink.make_step_record) goes to the
+    JSONL sink and to `rec.records`. MFU inputs: flops_per_step (exact,
+    e.g. mfu.train_step_flops) OR flops_per_token (analytic) combined with
+    tokens_per_step; peak_flops defaults from the device kind
+    (mfu.device_peak_flops — None on CPU => MFU 0.0, still finite).
+    """
+
+    def __init__(self, sink=None, rank=0, tokens_per_step=None,
+                 flops_per_step=None, flops_per_token=None,
+                 peak_flops=None, n_devices=None, track_memory=True):
+        self.sink = JsonlSink(sink) if isinstance(sink, str) else sink
+        self.rank = int(rank)
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self.flops_per_token = flops_per_token
+        if peak_flops is None:
+            peak_flops = _mfu.device_peak_flops()
+        self.peak_flops = peak_flops
+        self.n_devices = n_devices or 1
+        self.track_memory = track_memory
+        self.records = []
+        self.spans = []
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._step_idx = 0
+        self._win = None
+        _install_listener()
+
+    # -- span API ----------------------------------------------------------
+    def add_span(self, name, t0, dur, cat="host", rank=None, tid=None):
+        self.spans.append({
+            "name": name, "t0": float(t0), "dur": float(dur),
+            "cat": cat, "rank": self.rank if rank is None else int(rank),
+            "tid": threading.get_ident() % 1000 if tid is None else tid})
+
+    # -- step lifecycle ----------------------------------------------------
+    @property
+    def _open(self):
+        return self._win is not None
+
+    def start_step(self):
+        if self._win is not None:
+            raise RuntimeError("TelemetryRecorder: step already open")
+        self._win = _StepWindow(self)
+        with _LOCK:
+            _OPEN_STEPS.append(self._win)
+        return self._win
+
+    def end_step(self, loss=None, **extra):
+        win = self._win
+        if win is None:
+            raise RuntimeError("TelemetryRecorder: no open step")
+        if loss is not None:
+            win.loss = loss
+        win.extra.update(extra)
+        loss_val = None
+        if win.loss is not None:
+            # fetching the scalar double-duties as the device sync, so
+            # step_ms covers the full computation, not just dispatch
+            try:
+                v = win.loss
+                v = v.item() if hasattr(v, "item") else v
+                loss_val = float(v)
+            except Exception:
+                loss_val = None
+        t1 = time.perf_counter()
+        with _LOCK:
+            _OPEN_STEPS.remove(win)
+        self._win = None
+        step_s = t1 - win.t0
+        compile_ms = win.compile_secs * 1000.0
+        if compile_ms > 0:
+            self.cache_misses += 1
+            monitor.incr("telemetry.compile_cache_misses")
+        else:
+            self.cache_hits += 1
+            monitor.incr("telemetry.compile_cache_hits")
+        monitor.incr("telemetry.steps")
+
+        execute_s = max(1e-9, step_s - win.compile_secs)
+        tokens_per_sec = None
+        if self.tokens_per_step:
+            tokens_per_sec = self.tokens_per_step / execute_s
+        flops_per_step = self.flops_per_step
+        if flops_per_step is None and self.flops_per_token \
+                and self.tokens_per_step:
+            flops_per_step = self.flops_per_token * self.tokens_per_step
+        mfu_val = None
+        if flops_per_step is not None:
+            mfu_val = _mfu.mfu(flops_per_step, execute_s,
+                               peak_flops=self.peak_flops,
+                               n_devices=self.n_devices)
+        mem_bytes = self._live_bytes() if self.track_memory else None
+        coll = self._collect_collectives(win.span_start)
+
+        rec = make_step_record(
+            step=self._step_idx, step_ms=step_s * 1000.0,
+            compile_ms=compile_ms, rank=self.rank, loss=loss_val,
+            tokens_per_sec=tokens_per_sec, mfu=mfu_val, mem_bytes=mem_bytes,
+            cache_hits=self.cache_hits, cache_misses=self.cache_misses,
+            collectives=coll, **win.extra)
+        # the whole step is also a span, so the JSONL ledger and the
+        # chrome trace describe the same intervals
+        self.add_span(f"step {self._step_idx}", win.t0, step_s, cat="step")
+        self._step_idx += 1
+        self.records.append(rec)
+        if self.sink is not None:
+            self.sink.write(rec)
+        return rec
+
+    def record_external_step(self, step_ms, compile_ms, **kwargs):
+        """Record a step measured elsewhere (StepTimer, bench phases)."""
+        win = self.start_step()
+        win.t0 = time.perf_counter() - step_ms / 1000.0
+        win.compile_secs = compile_ms / 1000.0
+        return self.end_step(**kwargs)
+
+    @contextlib.contextmanager
+    def step(self, **extra):
+        win = self.start_step()
+        win.extra.update(extra)
+        try:
+            yield win
+        finally:
+            self.end_step()
+
+    def wrap(self, step_fn):
+        """Wrap a train-step callable: every invocation becomes one
+        recorded step, the (scalar) return value its loss."""
+        def wrapped(*args, **kwargs):
+            win = self.start_step()
+            try:
+                out = step_fn(*args, **kwargs)
+                win.note(loss=out)
+                return out
+            finally:
+                self.end_step()
+        wrapped.__name__ = getattr(step_fn, "__name__", "step")
+        return wrapped
+
+    # -- context activation (TrainStep auto-record) ------------------------
+    def __enter__(self):
+        _RECORDER_STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self._win is not None:   # abandoned window (step raised)
+            with _LOCK:
+                _OPEN_STEPS.remove(self._win)
+            self._win = None
+        _RECORDER_STACK.remove(self)
+        return False
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _live_bytes():
+        try:
+            return int(sum(getattr(a, "nbytes", 0)
+                           for a in jax.live_arrays()))
+        except Exception:
+            return None
+
+    def _collect_collectives(self, span_start):
+        coll = {}
+        for sp in self.spans[span_start:]:
+            if sp.get("cat") == "collective":
+                ms, calls = coll.get(sp["name"], (0.0, 0))
+                coll[sp["name"]] = (ms + sp["dur"] * 1000.0, calls + 1)
+        return coll or None
+
+    def export_chrome_tracing(self, path, extra_sources=(), align_on=None):
+        """Export this recorder's spans (plus any peer ranks') as one
+        Chrome trace. See sink.export_chrome_tracing."""
+        from .sink import export_chrome_tracing as _export
+        return _export(path, [self, *extra_sources], align_on=align_on)

@@ -1,0 +1,199 @@
+"""Structured metrics sink: one JSONL record per step + Chrome trace export.
+
+This is the serialization half of the flight recorder. Every consumer of
+training/bench metrics in the repo (TelemetryRecorder, TelemetryCallback,
+bench.py phases, tools/trace_check.py) speaks the same schema, so a
+`BENCH_*.json` entry and a training-run log are directly comparable.
+
+Reference analogs: the profiler's `profiler.proto` serialized output and
+`tools/CrossStackProfiler`'s per-rank chrome-trace merge; JAX's
+XPlane->TensorBoard path covers device-side detail, this covers the
+host-side step ledger.
+"""
+import json
+import os
+import threading
+
+SCHEMA_VERSION = 1
+
+# required keys of a per-step record (validated by tools/trace_check.py)
+STEP_RECORD_KEYS = ("schema", "kind", "rank", "step", "step_ms",
+                    "compile_ms", "execute_ms")
+# optional, present when the recorder has the inputs to compute them
+STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
+                      "cache_hits", "cache_misses", "collectives", "extra")
+
+
+def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
+                     tokens_per_sec=None, mfu=None, mem_bytes=None,
+                     cache_hits=None, cache_misses=None, collectives=None,
+                     **extra):
+    """Normalize one step's measurements into the schema dict."""
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "step",
+        "rank": int(rank),
+        "step": int(step),
+        "step_ms": round(float(step_ms), 4),
+        "compile_ms": round(float(compile_ms), 4),
+        "execute_ms": round(max(0.0, float(step_ms) - float(compile_ms)), 4),
+    }
+    if loss is not None:
+        rec["loss"] = float(loss)
+    if tokens_per_sec is not None:
+        rec["tokens_per_sec"] = round(float(tokens_per_sec), 2)
+    if mfu is not None:
+        rec["mfu"] = round(float(mfu), 6)
+    if mem_bytes is not None:
+        rec["mem_bytes"] = int(mem_bytes)
+    if cache_hits is not None:
+        rec["cache_hits"] = int(cache_hits)
+    if cache_misses is not None:
+        rec["cache_misses"] = int(cache_misses)
+    if collectives:
+        rec["collectives"] = {
+            str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
+            if isinstance(v, (tuple, list)) else v
+            for k, v in collectives.items()}
+    if extra:
+        rec["extra"] = extra
+    return rec
+
+
+def make_phase_record(phase, metrics, rank=0):
+    """A bench-phase record (bench.py): same envelope, kind='phase', the
+    phase's metric dict under 'metrics'. Non-finite floats become None —
+    json.dumps would otherwise emit bare NaN/Infinity tokens, which are
+    invalid for strict JSON consumers (jq, Chrome)."""
+    clean = {}
+    for k, v in (metrics or {}).items():
+        if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                     float("-inf"))):
+            clean[k] = None
+        elif isinstance(v, (int, float)) or v is None or isinstance(v, str):
+            clean[k] = v
+    return {"schema": SCHEMA_VERSION, "kind": "phase", "rank": int(rank),
+            "phase": str(phase), "metrics": clean}
+
+
+class JsonlSink:
+    """Append-only JSONL metrics file, one record per line. Thread-safe;
+    flushes per record so a killed run keeps everything written."""
+
+    def __init__(self, path):
+        self.path = os.fspath(path)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._mu = threading.Lock()
+        self._n = 0
+
+    def write(self, record):
+        line = json.dumps(record, sort_keys=True)
+        with self._mu:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+            self._n += 1
+        return record
+
+    def __len__(self):
+        return self._n
+
+
+def read_jsonl(path):
+    """Load a metrics JSONL back into a list of dicts (round-trip)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def validate_step_record(rec):
+    """Return a list of problems with one record ([] == valid)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not dict"]
+    kind = rec.get("kind")
+    if kind == "phase":
+        for key in ("schema", "phase", "metrics"):
+            if key not in rec:
+                problems.append(f"phase record missing '{key}'")
+        return problems
+    for key in STEP_RECORD_KEYS:
+        if key not in rec:
+            problems.append(f"step record missing '{key}'")
+    for key in ("step_ms", "compile_ms", "execute_ms"):
+        v = rec.get(key)
+        if v is not None and (not isinstance(v, (int, float)) or v < 0):
+            problems.append(f"'{key}' not a non-negative number: {v!r}")
+    for key in ("tokens_per_sec", "mfu", "loss"):
+        v = rec.get(key)
+        if v is not None and not isinstance(v, (int, float)):
+            problems.append(f"'{key}' not numeric: {v!r}")
+        if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                     float("-inf"))):
+            problems.append(f"'{key}' non-finite: {v!r}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export (CrossStackProfiler analog, multi-rank)
+# ---------------------------------------------------------------------------
+
+def spans_to_trace_events(spans, default_rank=0):
+    """spans: iterable of dicts {name, t0, dur, rank?, tid?, cat?} (seconds)
+    -> chrome trace 'X' events in microseconds, pid == rank."""
+    events = []
+    ranks = set()
+    for sp in spans:
+        rank = int(sp.get("rank", default_rank))
+        ranks.add(rank)
+        events.append({
+            "name": sp["name"], "ph": "X",
+            "pid": rank, "tid": int(sp.get("tid", 0)),
+            "ts": float(sp["t0"]) * 1e6, "dur": float(sp["dur"]) * 1e6,
+            "cat": sp.get("cat", "host"),
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": r,
+             "args": {"name": f"rank {r}"}} for r in sorted(ranks)]
+    return meta + events
+
+
+def export_chrome_tracing(path, sources, align_on=None):
+    """Write one Chrome-trace JSON merging host spans across ranks.
+
+    `sources` is a list whose items are either TelemetryRecorder objects
+    (their `.spans` and `.rank` are used) or plain span-dict lists. Each
+    rank becomes its own trace pid so the merged timeline reads like the
+    reference CrossStackProfiler output. `align_on`: optional span name
+    whose start is declared t=0 per rank (the `__sync__`-marker recipe
+    from tools/merge_profiles.py).
+
+    Returns the number of spans written. Output loads in chrome://tracing
+    or Perfetto.
+    """
+    all_spans = []
+    for i, src in enumerate(sources):
+        spans = getattr(src, "spans", src)
+        rank = getattr(src, "rank", None)
+        for sp in spans:
+            sp = dict(sp)
+            if "rank" not in sp:
+                sp["rank"] = i if rank is None else rank
+            all_spans.append(sp)
+    if align_on is not None:
+        zero = {}
+        for sp in all_spans:
+            if sp["name"] == align_on:
+                zero.setdefault(sp["rank"], sp["t0"])
+        for sp in all_spans:
+            sp["t0"] = sp["t0"] - zero.get(sp["rank"], 0.0)
+    events = spans_to_trace_events(all_spans)
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(all_spans)

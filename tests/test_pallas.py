@@ -81,6 +81,36 @@ def test_non_block_multiple_seq():
         assert np.allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
 
 
+def test_triangle_grid_backward_rect_blocks():
+    """Causal grads with EXPLICIT block_q=128, block_k=512 (r = bk/bq = 4):
+    exercises the column-major _tri_bwd_decode at r>1 and the per-column
+    dq-flush path of the merged triangle-grid backward, which the default
+    block policy never reaches at test sizes (ADVICE.md r5: r>1 is the
+    production config for sq>8192 but had no coverage)."""
+    rs = np.random.RandomState(7)
+    b, s, n, h = 1, 1024, 2, 64
+    q = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    k = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    v = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention_fwd(
+            q, k, v, True, None, 128, 512) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, True) ** 2)
+
+    out = flash_attention_fwd(q, k, v, True, None, 128, 512)
+    ref = _ref(q, k, v, True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g1, g2):
+        assert np.allclose(np.asarray(a), np.asarray(b_), atol=5e-4), \
+            (name, np.abs(np.asarray(a) - np.asarray(b_)).max())
+
+
 def test_fused_add_layer_norm_matches_composed():
     """Pallas fused residual+LN (interpret on CPU via the composed-path
     equivalence + direct kernel run) matches LN(x+res) fwd and grads."""

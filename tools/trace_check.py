@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Validate a telemetry metrics JSONL + Chrome trace pair.
+
+CI gate for the flight-recorder schema (paddle_tpu/telemetry): checks
+that every JSONL record parses and carries the required step/phase
+fields with finite values, that the Chrome trace is valid trace JSON
+(traceEvents with ph/ts/dur/pid), and — when both are given — that the
+trace's step spans are consistent with the JSONL step count. Used by
+tests/test_telemetry.py and runnable standalone:
+
+    python tools/trace_check.py run.jsonl [trace.json]
+
+Exit 0 when valid; exit 7 with a problem listing otherwise (distinct
+from pytest/op-bench gate codes so CI logs disambiguate).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_metrics_jsonl(path):
+    """Returns (n_records, problems)."""
+    from paddle_tpu.telemetry.sink import validate_step_record
+
+    problems = []
+    records = []
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    problems.append(f"{path}:{i + 1}: not JSON: {e}")
+    except OSError as e:
+        return 0, [f"{path}: unreadable: {e}"]
+    if not records:
+        problems.append(f"{path}: no records")
+    for i, rec in enumerate(records):
+        for p in validate_step_record(rec):
+            problems.append(f"{path}:{i + 1}: {p}")
+    return len(records), problems
+
+
+def check_chrome_trace(path):
+    """Returns (n_events, ranks, problems)."""
+    problems = []
+    try:
+        with open(path) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return 0, set(), [f"{path}: not valid JSON: {e}"]
+    events = trace.get("traceEvents") if isinstance(trace, dict) else trace
+    if not isinstance(events, list):
+        return 0, set(), [f"{path}: no traceEvents list"]
+    ranks = set()
+    n = 0
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            problems.append(f"{path}: event {i} missing 'ph'")
+            continue
+        if ev["ph"] == "M":
+            continue
+        n += 1
+        if ev["ph"] == "X":
+            for key in ("name", "ts", "dur", "pid"):
+                if key not in ev:
+                    problems.append(
+                        f"{path}: X event {i} ({ev.get('name')}) "
+                        f"missing '{key}'")
+            if "pid" in ev:
+                ranks.add(ev["pid"])
+    if n == 0:
+        problems.append(f"{path}: no duration events")
+    return n, ranks, problems
+
+
+def check_pair(jsonl_path, trace_path=None):
+    """Full validation. Returns (problems, stats): problems == [] means
+    valid; stats carries the already-computed counts so callers don't
+    re-parse the files."""
+    n_rec, problems = check_metrics_jsonl(jsonl_path)
+    stats = {"n_records": n_rec, "n_events": 0, "ranks": set()}
+    if trace_path is not None:
+        n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
+        stats["n_events"], stats["ranks"] = n_ev, ranks
+        problems += trace_problems
+        if not trace_problems:
+            with open(trace_path) as f:
+                trace = json.load(f)
+            events = trace.get("traceEvents", []) \
+                if isinstance(trace, dict) else trace
+            steps = [e for e in events if isinstance(e, dict)
+                     and e.get("cat") == "step" and e.get("ph") == "X"]
+            if steps and n_rec and len(steps) > n_rec:
+                problems.append(
+                    f"{trace_path}: {len(steps)} step spans but only "
+                    f"{n_rec} JSONL records")
+    return problems, stats
+
+
+def main(argv):
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    jsonl_path = argv[1]
+    trace_path = argv[2] if len(argv) > 2 else None
+    problems, stats = check_pair(jsonl_path, trace_path)
+    if problems:
+        for p in problems:
+            print(f"INVALID: {p}")
+        return 7
+    msg = f"OK: {stats['n_records']} records in {jsonl_path}"
+    if trace_path:
+        msg += (f"; {stats['n_events']} trace events over ranks "
+                f"{sorted(stats['ranks'])} in {trace_path}")
+    print(msg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
