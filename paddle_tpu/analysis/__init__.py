@@ -1,0 +1,129 @@
+"""paddle_tpu.analysis — the graph doctor: pre-flight static analysis.
+
+The reference's static-graph world validated a ProgramDesc before the
+Executor ran it (`framework/op_desc.cc` InferShape/InferVarType passes,
+`framework/ir/` graph passes); the trace-and-jit world lost that gate —
+a non-donated optimizer buffer, a PartitionSpec that silently
+replicates, or a cross-rank collective mismatch only surfaces after it
+has burned pod-hours. This package restores the pre-dispatch check as
+four passes over traced-but-not-executed programs and the framework's
+own source, all reporting through one `Finding` model:
+
+- `jaxpr_lint`      — walks a ClosedJaxpr (TrainStep / ShardedTrainStep
+                      / PipelineParallel step): donation, host
+                      callbacks, silent upcasts, x64 hazards,
+                      degenerate collectives.  Rules JX1xx.
+- `sharding_lint`   — mesh + `mesh_axes` specs: rank vs array rank,
+                      divisibility, replicated-under-fsdp, projected
+                      per-device HBM.  Rules SH2xx.
+- `collective_order`— records each rank's ordered collective signatures
+                      through the `distributed/collective.py` span
+                      hooks and verifies all ranks agree — a deadlock
+                      detector that never executes a collective.
+                      Rules CO3xx.
+- `astlint`         — AST rules over `paddle_tpu/` itself: tracer
+                      leaks, impurity inside traced functions,
+                      device_get in library code, `pallas_call` without
+                      an `interpret=` escape hatch.  Rules FW4xx.
+
+Entry points: `tools/graphdoctor.py` (CLI over the in-repo GPT/ResNet
+configs), `TrainStep(..., lint=True)` / `ShardedTrainStep(...,
+lint=True)` (trace-time), `hapi.Model.prepare(..., lint=True)`, and
+`python -m paddle_tpu.analysis.astlint paddle_tpu` (framework gate in
+`tools/ci.sh`).
+"""
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+# rule-id prefix -> family name (stable: report consumers key on these)
+FAMILIES = {
+    "JX": "jaxpr",
+    "SH": "sharding",
+    "CO": "collective_order",
+    "FW": "framework",
+}
+
+
+class Finding:
+    """One static-analysis result. `location` is a human-readable site
+    (file:line, param name, or jaxpr path); `suggestion` is the fix."""
+
+    __slots__ = ("rule_id", "severity", "location", "message", "suggestion")
+
+    def __init__(self, rule_id, severity, location, message, suggestion=None):
+        self.rule_id = str(rule_id)
+        self.severity = str(severity)
+        self.location = str(location)
+        self.message = str(message)
+        self.suggestion = suggestion
+
+    @property
+    def family(self):
+        return FAMILIES.get(self.rule_id[:2], "unknown")
+
+    def to_dict(self):
+        d = {"rule_id": self.rule_id, "severity": self.severity,
+             "family": self.family, "location": self.location,
+             "message": self.message}
+        if self.suggestion:
+            d["suggestion"] = self.suggestion
+        return d
+
+    def __repr__(self):
+        return (f"[{self.rule_id}/{self.severity}] {self.location}: "
+                f"{self.message}"
+                + (f" (fix: {self.suggestion})" if self.suggestion else ""))
+
+
+class GraphDoctorError(RuntimeError):
+    """Raised in strict lint mode when a pass reports error findings."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        super().__init__(
+            "graph doctor found %d problem(s):\n%s"
+            % (len(self.findings), format_findings(self.findings)))
+
+
+def format_findings(findings):
+    return "\n".join("  " + repr(f) for f in findings) or "  (none)"
+
+
+def summarize(findings):
+    """Counts per family and per severity — the report footer."""
+    by_family, by_sev = {}, {}
+    for f in findings:
+        by_family[f.family] = by_family.get(f.family, 0) + 1
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+    return {"n": len(list(findings)), "by_family": by_family,
+            "by_severity": by_sev}
+
+
+def emit(findings, mode=True, title="graph doctor"):
+    """Uniform handling for trace-time lint hooks.
+
+    mode True/"warn": warn (one summary warning) when findings exist;
+    mode "strict": raise GraphDoctorError when any ERROR finding exists
+    — the exception carries ALL findings (errors first) so the
+    warning-severity ones are not lost with it. Returns the findings
+    unchanged when nothing raises."""
+    findings = list(findings)
+    if not findings or mode is False:
+        return findings
+    errors = [f for f in findings if f.severity == SEV_ERROR]
+    if mode == "strict" and errors:
+        raise GraphDoctorError(
+            errors + [f for f in findings if f.severity != SEV_ERROR])
+    import warnings
+    warnings.warn(f"{title}: {len(findings)} finding(s)\n"
+                  + format_findings(findings), stacklevel=3)
+    return findings
+
+
+__all__ = [
+    "Finding", "GraphDoctorError", "FAMILIES",
+    "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
+    "format_findings", "summarize", "emit",
+]

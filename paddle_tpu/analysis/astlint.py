@@ -1,0 +1,266 @@
+"""Framework lint: AST rules over paddle_tpu's own source.
+
+The reference enforced framework hygiene with clang-tidy-style CI
+scripts over C++; the hazards of a trace-and-jit framework are
+different and invisible to generic linters:
+
+- FW401 tracer-leak     — `self.attr = ...` inside a traced function:
+                          the attribute outlives the trace holding a
+                          dead tracer; the next eager read explodes (or
+                          worse, silently retraces).
+- FW402 trace-impurity  — `time.time()` / `datetime.now()` /
+                          `random.*` / `np.random.*` inside a traced
+                          function: evaluated ONCE at trace time and
+                          baked into the compiled program as a
+                          constant.
+- FW403 device-get      — `jax.device_get` in library code: a hidden
+                          host sync; library hot paths must stay async
+                          and let the caller decide when to block.
+- FW404 no-interpret    — a `pallas_call` site without an `interpret=`
+                          escape hatch: the kernel cannot run (or be
+                          debugged) off-TPU, so CPU CI silently loses
+                          coverage of it.
+
+"Traced function" is resolved statically: a function is traced when its
+name is passed to a jax tracing wrapper in the same module
+(`jax.jit(step, ...)`, `shard_map(inner, ...)`, `lax.scan(body, ...)`,
+`jax.vjp(f, ...)`, `vmap`/`grad`/`checkpoint`/`custom_vjp`/
+`make_jaxpr`/...), when it is decorated with one, or when it is defined
+inside another traced function. Suppress a finding with a trailing
+`# astlint: disable=FW4xx` comment on the offending line.
+
+CLI (the ci.sh framework gate):
+
+    python -m paddle_tpu.analysis.astlint paddle_tpu [--json]
+
+exits 0 when clean, 6 with a listing otherwise.
+"""
+import ast
+import os
+import re
+import sys
+
+from . import Finding, SEV_ERROR, SEV_WARNING
+
+# callables whose function-valued arguments get traced
+_TRACING_WRAPPERS = frozenset((
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "vjp", "jvp",
+    "linearize", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "shard_map", "smap", "make_jaxpr", "eval_shape", "named_call",
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+    "associative_scan",
+    "pallas_call", "pure_callback", "custom_gradient",
+))
+
+# Call targets that are impure at trace time: (object chain, attr) pairs
+_IMPURE_CALLS = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("time", "process_time"), ("time", "time_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"),
+    ("random", "random"), ("random", "randint"), ("random", "uniform"),
+    ("random", "choice"), ("random", "shuffle"), ("random", "seed"),
+}
+_IMPURE_NP_RANDOM = ("np", "numpy")
+
+_DISABLE_RE = re.compile(r"#\s*astlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+def _dotted(node):
+    """Call func -> tuple of name parts ('jax','lax','scan') or ()."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("")
+    return tuple(reversed(parts))
+
+
+def _disabled_rules(src_lines, lineno):
+    if 0 < lineno <= len(src_lines):
+        m = _DISABLE_RE.search(src_lines[lineno - 1])
+        if m:
+            return {r.strip() for r in m.group(1).split(",")}
+    return set()
+
+
+class _ModuleLinter(ast.NodeVisitor):
+    def __init__(self, path, src):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.findings = []
+        self.traced_names = set()     # function names traced in this module
+        self._fn_stack = []           # (FunctionDef, is_traced)
+
+    # -- pass 1: which names get traced ---------------------------------
+    def collect_traced(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                if chain and chain[-1] in _TRACING_WRAPPERS:
+                    for pos, arg in enumerate(node.args):
+                        if isinstance(arg, ast.Name):
+                            self.traced_names.add(arg.id)
+                        if isinstance(arg, ast.Call):
+                            inner = _dotted(arg.func)
+                            if inner and inner[-1] == "partial" \
+                                    and arg.args \
+                                    and isinstance(arg.args[0], ast.Name):
+                                # functools.partial(body, ...) traces body
+                                self.traced_names.add(arg.args[0].id)
+                            elif inner and pos == 0:
+                                # factory pattern: jax.jit(self._build(...))
+                                # traces whatever _build returns — mark
+                                # the factory so its nested defs get the
+                                # traced rules. FIRST arg only: later
+                                # call-args of scan/fori_loop/vjp are
+                                # data (init values, operands), and
+                                # marking their producers would flag
+                                # host-side setup as traced
+                                self.traced_names.add(inner[-1])
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    chain = _dotted(target)
+                    names = set(chain)
+                    if isinstance(dec, ast.Call):
+                        for a in dec.args:
+                            names.update(_dotted(a))
+                    if names & _TRACING_WRAPPERS:
+                        self.traced_names.add(node.name)
+
+    # -- pass 2: rules ---------------------------------------------------
+    def _add(self, rule, severity, node, message, suggestion=None):
+        if rule in _disabled_rules(self.src_lines, node.lineno):
+            return
+        self.findings.append(Finding(
+            rule, severity, f"{self.path}:{node.lineno}", message,
+            suggestion))
+
+    def _in_traced(self):
+        return any(traced for _, traced in self._fn_stack)
+
+    def visit_FunctionDef(self, node):
+        traced = node.name in self.traced_names or self._in_traced()
+        self._fn_stack.append((node, traced))
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_self_store(self, target, node):
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            fn = self._fn_stack[-1][0].name if self._fn_stack else "?"
+            self._add(
+                "FW401", SEV_ERROR, node,
+                f"`self.{target.attr} = ...` inside traced function "
+                f"`{fn}`: the attribute keeps a dead tracer after the "
+                "trace ends",
+                suggestion="thread the value through the function's "
+                           "outputs (functional state) instead of "
+                           "storing it on self")
+
+    def visit_Assign(self, node):
+        if self._in_traced():
+            for t in node.targets:
+                self._check_self_store(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._in_traced():
+            self._check_self_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        chain = _dotted(node.func)
+        if self._in_traced() and len(chain) >= 2:
+            head, tail = chain[-2], chain[-1]
+            if (head, tail) in _IMPURE_CALLS or (
+                    len(chain) >= 3 and chain[-3] in _IMPURE_NP_RANDOM
+                    and chain[-2] == "random"):
+                fn = self._fn_stack[-1][0].name
+                self._add(
+                    "FW402", SEV_ERROR, node,
+                    f"impure host call `{'.'.join(c for c in chain if c)}"
+                    f"()` inside traced function `{fn}`: evaluated once "
+                    "at trace time and baked into the compiled program",
+                    suggestion="pass the value in as an argument, or use "
+                               "the framework RNG (core.random) for "
+                               "randomness")
+        if chain and chain[-1] == "device_get":
+            self._add(
+                "FW403", SEV_WARNING, node,
+                "`jax.device_get` in library code forces a host sync on "
+                "every caller",
+                suggestion="return the device array and let the caller "
+                           "block (np.asarray at the API boundary)")
+        if chain and chain[-1] == "pallas_call":
+            kw = {k.arg for k in node.keywords}
+            if "interpret" not in kw:
+                self._add(
+                    "FW404", SEV_ERROR, node,
+                    "`pallas_call` without an `interpret=` escape hatch: "
+                    "the kernel cannot run or be debugged off-TPU",
+                    suggestion="pass interpret=_interpret() (backend "
+                               "probe) like the other kernel sites")
+        self.generic_visit(node)
+
+
+def lint_source(src, path="<string>"):
+    """Lint one module's source text. Returns findings (parse errors
+    become a single FW400 finding rather than raising)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("FW400", SEV_ERROR, f"{path}:{e.lineno}",
+                        f"syntax error: {e.msg}")]
+    linter = _ModuleLinter(path, src)
+    linter.collect_traced(tree)
+    linter.visit(tree)
+    return linter.findings
+
+
+def lint_file(path):
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_tree(root):
+    """Lint every .py under `root` (a package dir or single file)."""
+    findings = []
+    if os.path.isfile(root):
+        return lint_file(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn)))
+    return findings
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")] or ["paddle_tpu"]
+    findings = []
+    for p in paths:
+        findings.extend(lint_tree(p))
+    if as_json:
+        import json
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(repr(f))
+        print(f"astlint: {len(findings)} finding(s) over "
+              f"{', '.join(paths)}")
+    return 6 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Sharding lint: mesh + PartitionSpec checks before any placement.
+
+`distributed/env.py` is deliberately forgiving at apply time (it drops
+axes that do not divide so tiny test shapes still run) — which is
+exactly how a 1.3B run ends up with a tensor the mesh was supposed to
+shard silently replicated on every chip. This pass surfaces what the
+forgiving path would drop, BEFORE `shard_model`/`ShardedTrainStep`
+place anything.
+
+Rules (family SH):
+
+- SH201 spec-rank        — `mesh_axes` tag longer than the array rank
+                           (the apply-time validator in
+                           `distributed/sharded_train.py` raises the
+                           same condition).
+- SH202 unknown-axis     — tag names an axis the mesh does not have.
+- SH203 non-divisible    — a tagged dim is not divisible by the mesh
+                           axis size: env would silently drop the axis
+                           and replicate the tensor.
+- SH204 duplicate-axis   — the same mesh axis appears twice in one tag
+                           (an invalid PartitionSpec under GSPMD).
+- SH205 replicated-under-fsdp — with ZeRO-3 intent (params sharded over
+                           the data axis), a large parameter that no
+                           dim lets the dp axis shard stays fully
+                           replicated on every rank.
+- SH206 hbm-budget       — projected per-device bytes exceed the given
+                           HBM budget (emitted by `project_hbm`).
+- SH207 tuple-entry      — a multi-axis tuple entry in the tag:
+                           PartitionSpec allows it, the mesh_axes apply
+                           path does not (it drops the entry wholesale,
+                           replicating the tensor).
+
+`project_hbm` reports the projected per-device bytes for params, a
+same-size gradient, and the optimizer states under the given mesh and
+zero stage — the planner-style accounting, derived from the same
+tag->axes rule the trainers use (`env.normalize_param_axes`).
+"""
+import numpy as np
+
+from . import Finding, SEV_ERROR, SEV_WARNING
+
+# SH205 floor: below this a replicated parameter is not worth a finding
+LARGE_PARAM_BYTES = 1 << 20
+
+
+def _named_params(model_or_named):
+    if hasattr(model_or_named, "named_parameters"):
+        return [(n, p) for n, p in model_or_named.named_parameters()]
+    return list(model_or_named)
+
+
+def _axis_size(mesh, a):
+    return int(mesh.shape[a]) if a in mesh.axis_names else None
+
+
+def lint_spec(name, shape, axes, mesh):
+    """Core per-tensor rules over a raw `mesh_axes` tag (pre-normalize).
+    Returns findings; an untagged tensor returns []."""
+    findings = []
+    shape = tuple(int(s) for s in shape)
+    axes = tuple(axes or ())
+    if not axes:
+        return findings
+    if len(axes) > len(shape):
+        findings.append(Finding(
+            "SH201", SEV_ERROR, name,
+            f"PartitionSpec {axes} has rank {len(axes)} but "
+            f"'{name}' has rank {len(shape)} (shape {shape})",
+            suggestion="the spec must have at most one entry per array "
+                       "dim; trim the tag"))
+        axes = axes[:len(shape)]
+    seen = {}
+    for i, a in enumerate(axes):
+        if a is None:
+            continue
+        if isinstance(a, (tuple, list)):
+            # PartitionSpec allows multi-axis tuple entries, but the
+            # tag apply path (env.normalize_param_axes) does not — it
+            # drops them wholesale, replicating the tensor
+            findings.append(Finding(
+                "SH207", SEV_ERROR, name,
+                f"dim {i} of '{name}' uses a multi-axis tuple entry "
+                f"{tuple(a)}: the mesh_axes apply path does not support "
+                "tuples and would silently replicate the tensor",
+                suggestion="shard the dim over a single mesh axis, or "
+                           "reshape so each axis gets its own dim"))
+            continue
+        size = _axis_size(mesh, a)
+        if size is None:
+            findings.append(Finding(
+                "SH202", SEV_ERROR, name,
+                f"spec axis {a!r} (dim {i}) is not a mesh axis "
+                f"(mesh has {tuple(mesh.axis_names)})",
+                suggestion="tag with one of the mesh axis names or "
+                           "None"))
+            continue
+        if a in seen:
+            findings.append(Finding(
+                "SH204", SEV_ERROR, name,
+                f"mesh axis {a!r} appears on dims {seen[a]} and "
+                f"{i} of one spec: a mesh axis may shard at most "
+                "one dim",
+                suggestion="drop one of the entries"))
+            continue
+        seen[a] = i
+        if size > 1 and shape[i] % size != 0:
+            findings.append(Finding(
+                "SH203", SEV_ERROR, name,
+                f"dim {i} of '{name}' (size {shape[i]}) is not "
+                f"divisible by mesh axis {a!r} (size {size}); the "
+                "axis would be silently dropped and the tensor "
+                "fully replicated",
+                suggestion=f"pad dim {i} to a multiple of {size} or "
+                           "re-tag the parameter"))
+    return findings
+
+
+def _shard_fraction(shape, axes, mesh, extra_axis=None):
+    """1/n factor the normalized spec (+optional ZeRO extra axis)
+    achieves — mirrors env.normalize_param_axes + param_sharding."""
+    shape = tuple(int(s) for s in shape)
+    axes = list(axes or ()) + [None] * (len(shape) - len(axes or ()))
+    axes = axes[:len(shape)]
+    denom = 1
+    used = set()
+    for i, a in enumerate(axes):
+        size = _axis_size(mesh, a) if a is not None else None
+        if size and size > 1 and shape[i] % size == 0 and a not in used:
+            denom *= size
+            used.add(a)
+        else:
+            axes[i] = None
+    if extra_axis is not None and extra_axis not in used:
+        size = _axis_size(mesh, extra_axis)
+        if size and size > 1:
+            for i, a in enumerate(axes):
+                if a is None and shape[i] % size == 0:
+                    denom *= size
+                    break
+    return 1.0 / denom
+
+
+def lint_model_sharding(model_or_named, mesh, zero_stage=0,
+                        large_param_bytes=LARGE_PARAM_BYTES):
+    """All SH rules over a model's (or [(name, param)] list's) tags."""
+    findings = []
+    for name, p in _named_params(model_or_named):
+        axes = getattr(p, "mesh_axes", None)
+        shape = tuple(p._value.shape) if hasattr(p, "_value") \
+            else tuple(p.shape)
+        findings.extend(lint_spec(name, shape, axes, mesh))
+        if zero_stage >= 3:
+            nbytes = int(np.prod(shape or (1,))) * np.dtype(
+                getattr(p._value if hasattr(p, "_value") else p,
+                        "dtype", np.float32)).itemsize
+            dp = _axis_size(mesh, "dp") or 1
+            if nbytes >= large_param_bytes and dp > 1 and \
+                    _shard_fraction(shape, axes, mesh, extra_axis="dp") \
+                    == 1.0:
+                findings.append(Finding(
+                    "SH205", SEV_WARNING, name,
+                    f"'{name}' ({nbytes / 1e6:.1f} MB) stays fully "
+                    f"replicated under ZeRO-3: no dim is divisible by "
+                    f"the dp axis (size {dp}), so every rank holds a "
+                    "full copy",
+                    suggestion="pad a dim to a multiple of the dp size "
+                               "or accept the replication explicitly"))
+    return findings
+
+
+def project_hbm(model_or_named, mesh, zero_stage=0, optimizer_slots=2,
+                hbm_bytes=None):
+    """Projected steady-state per-device bytes for params + grads +
+    optimizer states under the mesh/zero-stage, plus an SH206 finding
+    when a budget is given and exceeded. Returns (report_dict,
+    findings)."""
+    params_b = grads_b = opt_b = total_logical = 0.0
+    for _, p in _named_params(model_or_named):
+        val = p._value if hasattr(p, "_value") else p
+        shape = tuple(val.shape)
+        nbytes = int(np.prod(shape or (1,))) * np.dtype(val.dtype).itemsize
+        total_logical += nbytes
+        axes = getattr(p, "mesh_axes", None)
+        pfrac = _shard_fraction(shape, axes, mesh,
+                                extra_axis="dp" if zero_stage >= 3
+                                else None)
+        # ZeRO ladder: stage 1 shards optimizer states over dp, stage 2
+        # additionally gradients, stage 3 additionally the params
+        gfrac = _shard_fraction(shape, axes, mesh,
+                                extra_axis="dp" if zero_stage >= 2
+                                else None)
+        sfrac = _shard_fraction(shape, axes, mesh,
+                                extra_axis="dp" if zero_stage >= 1
+                                else None)
+        params_b += nbytes * pfrac
+        grads_b += nbytes * gfrac
+        opt_b += nbytes * sfrac * optimizer_slots
+    report = {
+        "n_devices": int(mesh.devices.size),
+        "zero_stage": int(zero_stage),
+        "logical_param_bytes": int(total_logical),
+        "per_device": {
+            "param_bytes": int(params_b),
+            "grad_bytes": int(grads_b),
+            "opt_state_bytes": int(opt_b),
+            "total_bytes": int(params_b + grads_b + opt_b),
+        },
+    }
+    findings = []
+    if hbm_bytes is not None:
+        report["hbm_bytes"] = int(hbm_bytes)
+        if report["per_device"]["total_bytes"] > hbm_bytes:
+            findings.append(Finding(
+                "SH206", SEV_ERROR, "mesh",
+                f"projected per-device state "
+                f"{report['per_device']['total_bytes'] / 1e9:.2f} GB "
+                f"exceeds the HBM budget {hbm_bytes / 1e9:.2f} GB",
+                suggestion="raise zero_stage, enable offload, or grow "
+                           "the mesh"))
+    return report, findings

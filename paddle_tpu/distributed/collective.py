@@ -24,20 +24,32 @@ from ..tensor._helpers import ensure_tensor
 from . import env
 
 
-def _comm_span(name):
+def _comm_span(name, tensor=None, axis_name=None):
     """Telemetry hook shared by every collective: a host span tagged
     cat='collective' (so TelemetryRecorder attributes per-step comm time
     and the Chrome trace shows it per rank) plus a `comm.<name>` monitor
     counter. For the shard_map primitives the span covers trace time and
     the named_scope inside `_traced_collective` labels the op in the
-    XPlane device trace, where its real run time lives."""
+    XPlane device trace, where its real run time lives.
+
+    The same hook feeds the graph doctor's cross-rank deadlock detector:
+    under an active `analysis.collective_order.capture()` every
+    collective's ordered signature (op, axis, shape, dtype, call-site)
+    is recorded — trace-time only, nothing executes — so mismatched
+    rank sequences are caught before a pod ever hangs on them."""
     from .. import telemetry
+    from ..analysis import collective_order as _corder
     monitor.incr(f"comm.{name}")
+    if _corder._ACTIVE is not None:
+        v = getattr(tensor, "_value", tensor)
+        _corder.note(name, axis=axis_name,
+                     shape=getattr(v, "shape", None),
+                     dtype=getattr(v, "dtype", None))
     return telemetry.span(f"collective.{name}", cat="collective")
 
 
-def _traced_collective(name, fn, t):
-    with _comm_span(name):
+def _traced_collective(name, fn, t, axis_name=None):
+    with _comm_span(name, tensor=t, axis_name=axis_name):
         return apply(lambda v: jax.named_scope(f"collective.{name}")(fn)(v),
                      t)
 
@@ -127,8 +139,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
     Under single-controller XLA every collective is synchronous in
     program order (no comm streams exist to toggle), so both carry no
     behavioral weight; neither is silently dropped from the signature."""
-    with _comm_span("all_reduce"):
-        t = ensure_tensor(tensor)
+    t = ensure_tensor(tensor)
+    with _comm_span("all_reduce", tensor=t):
         mesh = env.current_mesh()
         if mesh is not None:
             sh = env.replicated(mesh)
@@ -139,8 +151,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
 
 def broadcast(tensor, src=0, group=None, use_calc_stream=True,
               sync_op=None):
-    with _comm_span("broadcast"):
-        return ensure_tensor(tensor)
+    t = ensure_tensor(tensor)
+    with _comm_span("broadcast", tensor=t):
+        return t
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None,  # noqa: A001
@@ -150,8 +163,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None,  # noqa: A001
 
 def all_gather(tensor_list, tensor, group=None, use_calc_stream=True,
                sync_op=None):
-    with _comm_span("all_gather"):
-        t = ensure_tensor(tensor)
+    t = ensure_tensor(tensor)
+    with _comm_span("all_gather", tensor=t):
         n = (group or _world()).nranks
         for _ in range(max(n, 1)):
             tensor_list.append(t)
@@ -172,8 +185,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None,
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None,
              use_calc_stream=True, sync_op=None):
-    with _comm_span("alltoall"):
-        outs = [ensure_tensor(t) for t in in_tensor_list]
+    outs = [ensure_tensor(t) for t in in_tensor_list]
+    with _comm_span("alltoall", tensor=outs[0] if outs else None):
         if out_tensor_list is not None:
             out_tensor_list.extend(outs)
             return out_tensor_list
@@ -197,39 +210,39 @@ def _is_traced(t):
 def psum(tensor, axis_name):
     return _traced_collective(
         "psum", lambda v: jax.lax.psum(v, axis_name),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def pmean(tensor, axis_name):
     return _traced_collective(
         "pmean", lambda v: jax.lax.pmean(v, axis_name),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def pmax(tensor, axis_name):
     return _traced_collective(
         "pmax", lambda v: jax.lax.pmax(v, axis_name),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def all_gather_axis(tensor, axis_name, axis=0, tiled=True):
     return _traced_collective(
         "all_gather", lambda v: jax.lax.all_gather(
             v, axis_name, axis=axis, tiled=tiled),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def reduce_scatter_axis(tensor, axis_name, axis=0):
     return _traced_collective(
         "reduce_scatter", lambda v: jax.lax.psum_scatter(
             v, axis_name, scatter_dimension=axis, tiled=True),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def ppermute(tensor, axis_name, perm):
     return _traced_collective(
         "ppermute", lambda v: jax.lax.ppermute(v, axis_name, perm),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 def all_to_all_axis(tensor, axis_name, split_axis, concat_axis):
@@ -237,7 +250,7 @@ def all_to_all_axis(tensor, axis_name, split_axis, concat_axis):
         "all_to_all", lambda v: jax.lax.all_to_all(
             v, axis_name, split_axis=split_axis, concat_axis=concat_axis,
             tiled=True),
-        ensure_tensor(tensor))
+        ensure_tensor(tensor), axis_name=axis_name)
 
 
 # ---- model-parallel split op (reference collective.py:1233) ---------------

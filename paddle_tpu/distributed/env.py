@@ -85,6 +85,23 @@ def get_local_rank():
     return 0
 
 
+def validate_param_axes(name, param):
+    """Apply-time guard for a param's `mesh_axes` tag: a spec longer
+    than the array rank is always a bug (the forgiving normalize path
+    would silently trim it), so raise a clear error NAMING the
+    parameter instead of letting JAX produce an opaque trace-time
+    shape error. Divisibility problems stay soft (normalize drops the
+    axis; `analysis.sharding_lint` reports them)."""
+    axes = tuple(getattr(param, "mesh_axes", None) or ())
+    shape = tuple(param._value.shape)
+    if len(axes) > len(shape):
+        raise ValueError(
+            f"parameter '{name}': PartitionSpec {axes} has rank "
+            f"{len(axes)} but the array has rank {len(shape)} (shape "
+            f"{shape}); a spec may have at most one entry per array dim "
+            "— fix the parameter's mesh_axes tag")
+
+
 def normalize_param_axes(param, mesh):
     """The single tag->axes rule: pad/trim the param's `mesh_axes` tag
     to its rank and drop axes that are absent from the mesh or don't

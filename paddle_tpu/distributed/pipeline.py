@@ -754,6 +754,34 @@ class PipelineParallel(Layer):
         self._eval_fn = None
         self._eval_key = None
         self._eval_used_cache = False
+        # graph-doctor pre-flight: False | True (warn) | "strict"
+        # (raise on error findings); runs the jaxpr lint over the
+        # pipelined step the first time each program shape is built
+        self.lint = False
+        self._pipe_step_raw = None
+        self._pipe_lint_key = None
+        self.lint_findings = None
+
+    def _maybe_lint_pipeline(self, args, mesh):
+        """Jaxpr-lint the pipelined step (one extra trace, nothing
+        executes) when `self.lint` is enabled, once per program key."""
+        if not self.lint or self._pipe_step_raw is None \
+                or self._pipe_lint_key == self._pipe_step_key:
+            return
+        from ..analysis import emit
+        from ..analysis.jaxpr_lint import flat_argnum_indices, lint_jaxpr
+        fn, donate_argnums, state_argnums = self._pipe_step_raw
+        closed = jax.make_jaxpr(fn)(*args)
+        donated = flat_argnum_indices(args, donate_argnums)
+        state_idx = flat_argnum_indices(args, state_argnums)
+        axis_sizes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+        self.lint_findings = emit(
+            lint_jaxpr(closed, donated=donated,
+                       state_invars=state_idx or None,
+                       mesh_axis_sizes=axis_sizes,
+                       fn_name="PipelineParallel.train_batch"),
+            mode=self.lint, title="graph doctor [PipelineParallel]")
+        self._pipe_lint_key = self._pipe_step_key
 
     def forward(self, x):
         return self._layers(x)
@@ -963,6 +991,11 @@ class PipelineParallel(Layer):
         if optimizer is None:
             in_sh = (fr_sh, stks, tl_sh, rep, rep, rep)
             out_sh = (rep, fr_sh, stks, tl_sh)
+            # raw fn + (donated, in-graph-updated-state) argnums kept
+            # for the graph-doctor lint (self.lint): make_jaxpr over it
+            # re-traces without executing. The grads-only path updates
+            # nothing in-graph, so its state set is empty.
+            self._pipe_step_raw = (pipelined_grads, (), ())
             return jax.jit(pipelined_grads, in_shardings=in_sh,
                            out_shardings=out_sh)
 
@@ -995,6 +1028,10 @@ class PipelineParallel(Layer):
             for j, st in enumerate(plan["stack_state_tmpl"])]
         in_sh = (fr_sh, stks, state_sh, tl_sh, rep, rep, rep, rep)
         out_sh = (rep, fr_sh, tl_sh, stks, state_sh)
+        # args 1/2 (stacked params + opt states) are the persistent
+        # state this step updates in-graph — the JX101 set stays tied
+        # to that fact, not to whatever happens to be donated
+        self._pipe_step_raw = (step, (1, 2), (1, 2))
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=(1, 2))
 
@@ -1090,6 +1127,9 @@ class PipelineParallel(Layer):
             front_vals = [p._value for p in plan["front_params"]]
             tail_vals = [p._value for p in plan["tail_params"]]
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            self._maybe_lint_pipeline(
+                (front_vals, cache["vals"], list(cache["states"]),
+                 tail_vals, xv, yv, lr, rng), mesh)
             with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
                 loss, gfront, gtail, new_vals, new_states = self._pipe_step(
                     front_vals, cache["vals"], list(cache["states"]),
@@ -1126,6 +1166,8 @@ class PipelineParallel(Layer):
                 jax.device_put(jnp.stack([r[j]._value for r in rows]),
                                _stacked_sharding(tp, mesh))
                 for j, tp in enumerate(plan["template_params"])]
+        self._maybe_lint_pipeline(
+            (front_vals, stack_vals, tail_vals, xv, yv, rng), mesh)
         with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
             loss, gfront, gstack, gtail = self._pipe_step(
                 front_vals, stack_vals, tail_vals, xv, yv, rng)

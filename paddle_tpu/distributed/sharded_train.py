@@ -32,9 +32,10 @@ def shard_model(model, mesh=None):
     (replicated if untagged). The analog of
     `fleet.distributed_model` (`fleet_base.py:881`)."""
     mesh = mesh or env.current_mesh()
-    for p in model.parameters():
+    for n, p in model.named_parameters():
         if p is None:
             continue
+        env.validate_param_axes(n, p)
         sh = env.param_sharding(p, mesh)
         p._value = jax.device_put(p._value, sh)
     for b in model.buffers():
@@ -83,7 +84,8 @@ class ShardedTrainStep:
     DistributedStrategy when the optimizer is fleet-wrapped."""
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=None,
-                 seq_shard_batch=False, donate=True, offload=None):
+                 seq_shard_batch=False, donate=True, offload=None,
+                 lint=False):
         self.mesh = mesh or env.current_mesh()
         self.model = model
         self.loss_fn = loss_fn
@@ -104,6 +106,10 @@ class ShardedTrainStep:
         self.seq_shard = seq_shard_batch
         named = [(n, p) for n, p in model.named_parameters()
                  if not p.stop_gradient]
+        for n, p in named:
+            # clear apply-time error (naming the parameter) instead of
+            # an opaque trace-time shape failure from JAX
+            env.validate_param_axes(n, p)
         self.param_names = [n for n, _ in named]
         self.params = [p for _, p in named]
         self.buffers = [b for _, b in model.named_buffers() if b is not None]
@@ -117,6 +123,8 @@ class ShardedTrainStep:
         self._place_states()
         self._jitted = None
         self._donate = donate
+        self._lint = lint
+        self.lint_findings = None
         if self.offload:
             # static per instance: precompute both memory-kind variants
             # so the per-step H2D/D2H hops don't rebuild NamedShardings
@@ -147,23 +155,25 @@ class ShardedTrainStep:
                 st[k] = jax.device_put(
                     v, sh if v.shape == tuple(p._value.shape) else rep)
 
-    def _make_step(self, check_nan_inf=False):
+    def _maybe_lint(self, batch):
+        """Graph-doctor pre-flight: jaxpr lint of the traced step plus
+        the sharding lint over the mesh + tags (one extra trace, no
+        execution, no collective)."""
+        if not self._lint or self.lint_findings is not None:
+            return
+        from ..analysis import emit
+        from ..analysis.jaxpr_lint import lint_train_step
+        from ..analysis.sharding_lint import lint_model_sharding
+        findings = lint_train_step(self, *batch, mesh=self.mesh)
+        findings += lint_model_sharding(
+            zip(self.param_names, self.params), self.mesh,
+            zero_stage=self.zero_stage)
+        self.lint_findings = emit(findings, mode=self._lint,
+                                  title="graph doctor [ShardedTrainStep]")
+
+    def _build_step_fn(self, check_nan_inf=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
-        mesh = self.mesh
-
-        param_sh = [self._param_sharding(p) for p in params]
-        state_sh = []
-        for p in params:
-            # the compiled step always sees device-memory states; with
-            # offload the host<->device hops happen in __call__
-            psh = self._state_sharding(p, device=True)
-            rep = env.replicated(mesh)
-            st = opt._states[id(p)]
-            state_sh.append({k: (psh if np.shape(v) == tuple(p._value.shape)
-                                 else rep) for k, v in st.items()})
-        buf_sh = [env.replicated(mesh)] * len(buffers)
-        rep = env.replicated(mesh)
 
         def step(param_vals, opt_states, buffer_vals, lr, rng, batch_vals):
             with autograd.fresh_tape(), \
@@ -200,10 +210,28 @@ class ShardedTrainStep:
                 new_buf = [b._value for b in buffers]
                 return loss._value, new_vals, new_states, new_buf, checks
 
+        return step
+
+    def _make_step(self, check_nan_inf=False):
+        params, buffers, opt = self.params, self.buffers, self.optimizer
+        mesh = self.mesh
+        param_sh = [self._param_sharding(p) for p in params]
+        state_sh = []
+        for p in params:
+            # the compiled step always sees device-memory states; with
+            # offload the host<->device hops happen in __call__
+            psh = self._state_sharding(p, device=True)
+            rep = env.replicated(mesh)
+            st = opt._states[id(p)]
+            state_sh.append({k: (psh if np.shape(v) == tuple(p._value.shape)
+                                 else rep) for k, v in st.items()})
+        buf_sh = [env.replicated(mesh)] * len(buffers)
+        rep = env.replicated(mesh)
         in_sh = (param_sh, state_sh, buf_sh, rep, rep, None)
         out_sh = (rep, param_sh, state_sh, buf_sh, None)
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf),
+                       in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
     def __call__(self, *batch):
@@ -220,6 +248,7 @@ class ShardedTrainStep:
         from ..flags import get_flag
         check = get_flag("check_nan_inf")
         if self._jitted is None or getattr(self, "_check_key", None) != check:
+            self._maybe_lint(batch)
             self._jitted = self._make_step(check_nan_inf=check)
             self._check_key = check
         with telemetry.span("sharded.shard_batch", cat="h2d"):

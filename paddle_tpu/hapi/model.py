@@ -51,9 +51,13 @@ class Model:
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, lint=False):
+        # lint: graph-doctor pre-flight (paddle_tpu.analysis) — False |
+        # True (warn on findings) | "strict" (raise on errors); runs the
+        # jaxpr/sharding passes when the fused train step first traces
         self._optimizer = optimizer
         self._loss = loss
+        self._lint = lint
         metrics = metrics or []
         self._metrics = list(metrics) if isinstance(
             metrics, (list, tuple)) else [metrics]
@@ -144,10 +148,12 @@ class Model:
                     mesh = dist_env.current_mesh()
                 shard_model(self.network, mesh)
                 self._train_step = ShardedTrainStep(
-                    self.network, loss_fn, self._optimizer, mesh=mesh)
+                    self.network, loss_fn, self._optimizer, mesh=mesh,
+                    lint=getattr(self, "_lint", False))
             else:
-                self._train_step = TrainStep(self.network, loss_fn,
-                                             self._optimizer)
+                self._train_step = TrainStep(
+                    self.network, loss_fn, self._optimizer,
+                    lint=getattr(self, "_lint", False))
         loss = self._train_step(*inputs, *labels)
         return [loss.numpy()]
 

@@ -223,9 +223,13 @@ class TrainStep:
 
     loss_fn(*batch_tensors) -> scalar loss Tensor, computed with the model
     (closed over). Buffers (e.g. BN running stats) are threaded functionally.
+
+    lint: False (default) | True (run the graph-doctor jaxpr lint at
+    trace time and warn on findings) | "strict" (raise GraphDoctorError
+    on error-severity findings) — see paddle_tpu.analysis.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True, lint=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -238,8 +242,21 @@ class TrainStep:
             self.optimizer._get_state(p)
         self._jitted = None
         self._donate = donate
+        self._lint = lint
+        self.lint_findings = None
 
-    def _make_step(self, check_nan_inf=False):
+    def _maybe_lint(self, batch):
+        """Pre-flight static analysis of the step (one extra trace, no
+        execution) the first time a program is built with lint on."""
+        if not self._lint or self.lint_findings is not None:
+            return
+        from ..analysis import emit
+        from ..analysis.jaxpr_lint import lint_train_step
+        self.lint_findings = emit(
+            lint_train_step(self, *batch), mode=self._lint,
+            title=f"graph doctor [{type(self).__name__}]")
+
+    def _build_step_fn(self, check_nan_inf=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
 
@@ -284,8 +301,12 @@ class TrainStep:
                 new_buf = [b._value for b in buffers]
                 return loss._value, new_vals, new_states, new_buf, checks
 
+        return step
+
+    def _make_step(self, check_nan_inf=False):
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf),
+                       donate_argnums=donate)
 
     def __call__(self, *batch):
         # flight-recorder integration: a context-active TelemetryRecorder
@@ -305,6 +326,7 @@ class TrainStep:
         check = flags.get_flag("check_nan_inf")
         amp_key = (st.enabled, str(st.dtype) if st.enabled else "", check)
         if self._jitted is None or getattr(self, "_amp_key", None) != amp_key:
+            self._maybe_lint(batch)
             self._jitted = self._make_step(check_nan_inf=check)
             self._amp_key = amp_key
         from .. import monitor

@@ -89,6 +89,54 @@ def test_batch_input_sharding(mp_mesh):
     assert v.sharding.shard_shape(v.shape) == (4, 4)
 
 
+@pytest.mark.parametrize("size,axis,axis_size", [
+    (6, "mp", 4),     # 6 % 4 != 0 on the tensor-parallel axis
+    (10, "mp", 4),    # 10 % 4
+    (7, "dp", 2),     # odd dim over the data axis
+    (129, "mp", 4),   # off-by-one over a lane-ish dim
+])
+def test_uneven_divisibility_flagged_by_lint(mp_mesh, size, axis,
+                                             axis_size):
+    """Uneven mesh-axis divisibility: env.normalize_param_axes silently
+    drops the axis (tensor replicates) — the graph doctor's sharding
+    lint must report exactly that with the new SH203 message."""
+    from paddle_tpu.analysis import sharding_lint
+    assert mp_mesh.shape[axis] == axis_size
+    p = paddle.create_parameter([size, 8], "float32")
+    p.mesh_axes = (axis, None)
+    findings = sharding_lint.lint_model_sharding([("blk.w", p)], mp_mesh)
+    assert [f.rule_id for f in findings] == ["SH203"]
+    msg = findings[0].message
+    assert f"not divisible by mesh axis '{axis}' (size {axis_size})" \
+        in msg and "silently dropped" in msg
+    # and the forgiving apply path indeed replicates (what SH203 warns)
+    sh = env.param_sharding(p, mp_mesh)
+    assert all(a is None for a in tuple(sh.spec))
+
+
+@pytest.mark.parametrize("size", [8, 16])
+def test_even_divisibility_is_clean(mp_mesh, size):
+    from paddle_tpu.analysis import sharding_lint
+    p = paddle.create_parameter([size, 8], "float32")
+    p.mesh_axes = ("mp", None)
+    assert sharding_lint.lint_model_sharding([("blk.w", p)],
+                                             mp_mesh) == []
+
+
+def test_apply_time_spec_rank_error_names_parameter(mp_mesh):
+    """Satellite: a spec longer than the array rank fails AT APPLY TIME
+    with the parameter's name, not an opaque JAX trace error."""
+    net = paddle.nn.Linear(16, 16)
+    net.weight.mesh_axes = ("mp", None, "dp")     # rank-3 spec, rank-2 w
+    with pytest.raises(ValueError, match="'weight'.*rank 3.*rank 2"):
+        dist.shard_model(net, mp_mesh)
+    from paddle_tpu import optimizer
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(ValueError, match="'weight'"):
+        dist.ShardedTrainStep(net, lambda x: net(x).mean(), opt,
+                              mesh=mp_mesh)
+
+
 def test_search_plan_13b_feasible_on_v5p_pods():
     """BASELINE config 5: gpt3_13b must have feasible dp x mp x pp plans
     on v5p-32 and v5p-64; the planner enumerates them."""

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] native build =="
+echo "== [1/5] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,18 +37,31 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/4] api-surface audit =="
+echo "== [2/5] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/4] test suite =="
+echo "== [3/5] graph doctor + framework lint =="
+# pre-flight static analysis (paddle_tpu/analysis): the GPT config's
+# traced step + sharding specs must lint clean, every rule family must
+# demonstrably fire on its broken specimen, and a new framework-lint
+# violation (tracer leak, traced impurity, bare pallas_call) anywhere
+# in paddle_tpu/ fails the build. The standalone astlint run overlaps
+# graphdoctor's framework pass on purpose: it is the cheap (~2s AST
+# walk) gate that still fires when graphdoctor itself is broken, and
+# the one developers run locally
+JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
+    --report /tmp/graphdoctor_ci.json
+JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
+
+echo "== [4/5] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [4/4] op benchmark gate =="
+echo "== [5/5] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Graph doctor CLI: pre-flight static analysis of the in-repo configs.
+
+Runs all four paddle_tpu.analysis passes WITHOUT executing a single
+step — the pre-dispatch gate a GSPMD-era framework needs where the
+reference's static-graph world had ProgramDesc validation:
+
+1. jaxpr lint      — trace the fused TrainStep of the selected model
+                     (GPT or ResNet) via jax.make_jaxpr and walk it:
+                     donation, host callbacks, silent upcasts, x64
+                     hazards, degenerate collectives.
+2. sharding lint   — build the dp x mp mesh over virtual CPU devices
+                     and vet every parameter's `mesh_axes` tag: rank,
+                     divisibility, replicated-under-fsdp; plus the
+                     projected per-device HBM accounting.
+3. collective order— capture the eager-API collective signature stream
+                     through the distributed/collective.py span hooks
+                     (trace-time; nothing executes cross-rank).
+4. framework lint  — AST rules over paddle_tpu/ itself (astlint).
+
+A self-check section re-runs every pass against deliberately broken
+specimens so the report demonstrates each rule family actually fires;
+the config findings themselves must be empty on a healthy tree.
+
+    JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
+        --report /tmp/doctor.json
+
+Exit codes: 0 clean; 8 findings on the config; 9 a self-check family
+failed to fire (the doctor itself is broken). Used as a CI gate by
+tools/ci.sh.
+"""
+import argparse
+import json
+import os
+import sys
+
+# 8 virtual CPU devices BEFORE jax loads, so the mesh passes run
+# anywhere (same recipe as tests/conftest.py)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_gpt():
+    """Tiny in-repo GPT pretraining step (gpt_tiny_config)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny_config
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(0)
+    model = GPTForPretraining(gpt_tiny_config())
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    loss_fn = model.loss
+    ids = paddle.to_tensor(np.zeros((2, 32), np.int32))
+    labels = paddle.to_tensor(np.zeros((2, 32), np.int32))
+    return model, loss_fn, optimizer, (ids, labels)
+
+
+def build_resnet():
+    """In-repo ResNet-18 classification step (CIFAR-sized input keeps
+    the trace fast; the op graph is the full residual architecture)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    model = resnet18(num_classes=10)
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return F.cross_entropy(model(x), y).mean()
+
+    x = paddle.to_tensor(np.zeros((2, 3, 32, 32), np.float32))
+    y = paddle.to_tensor(np.zeros((2,), np.int32))
+    return model, loss_fn, optimizer, (x, y)
+
+
+_BUILDERS = {"gpt": build_gpt, "resnet": build_resnet}
+
+
+def run_config(model_name, zero_stage=1):
+    """All four passes over one in-repo config. Returns (findings,
+    extras dict)."""
+    import jax
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import (astlint, collective_order,
+                                     jaxpr_lint, sharding_lint)
+    from paddle_tpu.distributed import env
+    from paddle_tpu.jit import TrainStep
+
+    model, loss_fn, optimizer, batch = _BUILDERS[model_name]()
+    findings, extras = [], {}
+
+    # -- 1. jaxpr lint over the traced (never executed) train step ------
+    # ONE trace, shared by the lint rules, the eqn count, and the
+    # collective-order capture below: tracing the full step is the
+    # CLI's most expensive operation
+    step = TrainStep(model, loss_fn, optimizer, donate=True)
+    with collective_order.capture(rank=0) as coll_trace:
+        closed, donated, state_idx, names = jaxpr_lint.trace_train_step(
+            step, *batch)
+    findings += jaxpr_lint.lint_jaxpr(
+        closed, donated=donated, state_invars=state_idx,
+        param_names=names, fn_name="TrainStep")
+    extras["jaxpr_eqns"] = sum(
+        1 for _ in _count_eqns(closed.jaxpr))
+
+    # -- 2. sharding lint + HBM projection over a dp x mp mesh ----------
+    n_dev = len(jax.devices())
+    mp = 4 if n_dev >= 8 else max(1, n_dev // 2)
+    dp = max(1, n_dev // mp)
+    mesh = env.build_mesh(dp=dp, mp=mp)
+    try:
+        named = list(model.named_parameters())
+        findings += sharding_lint.lint_model_sharding(
+            named, mesh, zero_stage=zero_stage)
+        hbm, hbm_findings = sharding_lint.project_hbm(
+            named, mesh, zero_stage=zero_stage)
+        findings += hbm_findings
+        extras["hbm_projection"] = hbm
+        extras["mesh"] = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+        # -- 3. collective order: the step-1 trace above was captured
+        # through the collective.py span hooks. One honest caveat: a
+        # single controller traces ONE program for all ranks, so
+        # re-tracing cannot produce rank-divergent streams — the
+        # cross-rank comparison is demonstrated in the selfcheck; here
+        # we report what the real config's trace actually recorded.
+        extras["collectives_recorded"] = len(coll_trace)
+        if len(coll_trace) == 0:
+            extras["collective_order"] = (
+                "n/a: this config issues no eager collectives (GSPMD "
+                "inserts them at compile time); the checker applies to "
+                "programs using the collective.* API — see selfcheck")
+        else:
+            extras["collective_order"] = (
+                f"{len(coll_trace)} collective(s) recorded from one "
+                "single-controller trace (rank-invariant by "
+                "construction); cross-rank verification demonstrated "
+                "in selfcheck")
+    finally:
+        env.clear_mesh()
+
+    # -- 4. framework lint over paddle_tpu itself -----------------------
+    findings += astlint.lint_tree(os.path.join(REPO, "paddle_tpu"))
+    return findings, extras
+
+
+def _count_eqns(jaxpr):
+    from paddle_tpu.analysis.jaxpr_lint import _iter_jaxprs
+    for sub, _ in _iter_jaxprs(jaxpr):
+        for eqn in sub.eqns:
+            yield eqn
+
+
+def run_selfcheck():
+    """Each rule family fired against a deliberately broken specimen —
+    proof the doctor can actually see the defects it gates on.
+    Returns {family: [finding dicts]}."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import (astlint, collective_order,
+                                     jaxpr_lint, sharding_lint)
+    from paddle_tpu.distributed import env
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu import optimizer as opt
+
+    out = {}
+
+    # jaxpr family: an undonated step + a host callback in the graph
+    net = paddle.nn.Linear(8, 8)
+    sgd = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    bad_step = TrainStep(net, lambda x: (net(x) ** 2).mean(), sgd,
+                         donate=False)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    jx = jaxpr_lint.lint_train_step(bad_step, x)
+
+    import jax
+
+    def cb_fn(v):
+        jax.debug.print("v={v}", v=v)
+        return v * 2
+    jx += jaxpr_lint.lint_callable(cb_fn, jax.ShapeDtypeStruct(
+        (4,), np.float32))
+    out["jaxpr"] = jx
+
+    # sharding family: a tag whose dim does not divide the mesh axis
+    mesh = env.build_mesh(dp=2, mp=4)
+    try:
+        sh = sharding_lint.lint_spec(
+            "bad.weight", (6, 10), ("mp", "dp"), mesh)
+        sh += sharding_lint.lint_spec(
+            "overlong.bias", (8,), ("mp", None), mesh)
+    finally:
+        env.clear_mesh()
+    out["sharding"] = sh
+
+    # collective family: injected rank-order mismatch (no execution)
+    t0 = collective_order.CollectiveTrace(0)
+    t1 = collective_order.CollectiveTrace(1)
+    for op in ("all_reduce", "broadcast"):
+        t0.append(collective_order.CollectiveSig(op, None, (4,),
+                                                 "float32", "doctor"))
+    for op in ("broadcast", "all_reduce"):
+        t1.append(collective_order.CollectiveSig(op, None, (4,),
+                                                 "float32", "doctor"))
+    out["collective_order"] = collective_order.verify_ranks([t0, t1])
+
+    # framework family: tracer leak + impurity + bare pallas_call
+    specimen = (
+        "import time, jax\n"
+        "class M:\n"
+        "    def build(self):\n"
+        "        def step(x):\n"
+        "            self.cached = x\n"
+        "            return x * time.time()\n"
+        "        return jax.jit(step)\n"
+        "def k(pl, f):\n"
+        "    return pl.pallas_call(f, grid=(1,))\n")
+    out["framework"] = astlint.lint_source(specimen, "selfcheck.py")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=sorted(_BUILDERS), default="gpt")
+    ap.add_argument("--report", default=None,
+                    help="write the JSON report here")
+    ap.add_argument("--zero-stage", type=int, default=1)
+    ap.add_argument("--no-selfcheck", action="store_true",
+                    help="skip the broken-specimen demonstration pass")
+    args = ap.parse_args(argv)
+
+    import jax
+    from paddle_tpu import analysis
+
+    findings, extras = run_config(args.model, zero_stage=args.zero_stage)
+    report = {
+        "tool": "graphdoctor",
+        "model": args.model,
+        "platform": jax.default_backend(),
+        "findings": [f.to_dict() for f in findings],
+        "summary": analysis.summarize(findings),
+        **extras,
+    }
+
+    rc = 0
+    if not args.no_selfcheck:
+        selfcheck = run_selfcheck()
+        report["selfcheck"] = {
+            fam: [f.to_dict() for f in fs] for fam, fs in selfcheck.items()}
+        missing = [fam for fam, fs in selfcheck.items() if not fs]
+        report["selfcheck_families_fired"] = len(
+            [1 for fs in selfcheck.values() if fs])
+        if missing:
+            print(f"SELFCHECK FAILED: rule families {missing} produced "
+                  "no findings on broken specimens", file=sys.stderr)
+            rc = 9
+
+    if findings:
+        print(f"graph doctor: {len(findings)} finding(s) on the "
+              f"{args.model} config")
+        print(analysis.format_findings(findings))
+        rc = rc or 8
+    else:
+        fams = report.get("selfcheck_families_fired", 0)
+        print(f"graph doctor: {args.model} config clean "
+              f"({extras.get('jaxpr_eqns', 0)} jaxpr eqns, "
+              f"{len(report['selfcheck']) if 'selfcheck' in report else 0} "
+              f"rule families, {fams} demonstrated on broken specimens)")
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"report: {args.report}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,12 +21,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def check_metrics_jsonl(path):
-    """Returns (n_records, problems)."""
+    """Returns (n_records, n_step_records, problems).
+
+    An empty or record-free metrics file is a FAILURE, not a vacuous
+    pass: a validator that says OK about a file no step ever wrote
+    would green-light a run whose telemetry silently broke."""
     from paddle_tpu.telemetry.sink import validate_step_record
 
     problems = []
     records = []
     try:
+        if os.path.getsize(path) == 0:
+            return 0, 0, [f"{path}: empty metrics file (0 bytes): no "
+                          "step was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -37,13 +44,15 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
         for p in validate_step_record(rec):
             problems.append(f"{path}:{i + 1}: {p}")
-    return len(records), problems
+    n_steps = sum(1 for r in records
+                  if isinstance(r, dict) and r.get("kind") == "step")
+    return len(records), n_steps, problems
 
 
 def check_chrome_trace(path):
@@ -83,8 +92,9 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, problems = check_metrics_jsonl(jsonl_path)
-    stats = {"n_records": n_rec, "n_events": 0, "ranks": set()}
+    n_rec, n_steps, problems = check_metrics_jsonl(jsonl_path)
+    stats = {"n_records": n_rec, "n_steps": n_steps, "n_events": 0,
+             "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -96,10 +106,17 @@ def check_pair(jsonl_path, trace_path=None):
                 if isinstance(trace, dict) else trace
             steps = [e for e in events if isinstance(e, dict)
                      and e.get("cat") == "step" and e.get("ph") == "X"]
-            if steps and n_rec and len(steps) > n_rec:
+            # cross-check against STEP records only: phase-only JSONL
+            # next to a stepped trace used to vacuously pass (the phase
+            # lines inflated the record count)
+            if steps and n_steps == 0:
+                problems.append(
+                    f"{trace_path}: {len(steps)} step spans but "
+                    f"{jsonl_path} has zero step records")
+            elif steps and len(steps) > n_steps:
                 problems.append(
                     f"{trace_path}: {len(steps)} step spans but only "
-                    f"{n_rec} JSONL records")
+                    f"{n_steps} JSONL step records")
     return problems, stats
 
 
