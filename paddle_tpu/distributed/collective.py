@@ -40,12 +40,22 @@ def _comm_span(name, tensor=None, axis_name=None):
     from .. import telemetry
     from ..analysis import collective_order as _corder
     monitor.incr(f"comm.{name}")
+    v = getattr(tensor, "_value", tensor)
     if _corder._ACTIVE is not None:
-        v = getattr(tensor, "_value", tensor)
         _corder.note(name, axis=axis_name,
                      shape=getattr(v, "shape", None),
                      dtype=getattr(v, "dtype", None))
-    return telemetry.span(f"collective.{name}", cat="collective")
+    # axis/shape ride as span attrs: the hang watchdog's black-box dump
+    # then names not just WHICH collective a stalled step is inside but
+    # over which mesh axis and payload shape (the first question a
+    # pod-hang postmortem asks)
+    attrs = {}
+    if axis_name is not None:
+        attrs["axis"] = str(axis_name)
+    shape = getattr(v, "shape", None)
+    if shape is not None:
+        attrs["shape"] = str(tuple(shape))
+    return telemetry.span(f"collective.{name}", cat="collective", **attrs)
 
 
 def _traced_collective(name, fn, t, axis_name=None):

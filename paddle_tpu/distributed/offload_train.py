@@ -66,11 +66,15 @@ class OffloadTrainStep:
         dev = jax.devices()[0]
         self._dev_sh = SingleDeviceSharding(dev)
         self._offload = True
-        self._host_sh = SingleDeviceSharding(dev, memory_kind="pinned_host")
         try:
             # the backend must support pinned_host placement and compiled
             # cross-memory-space transfers in BOTH directions (the CPU
-            # backend accepts H2D but cannot compile the D2H annotation)
+            # backend accepts H2D but cannot compile the D2H annotation;
+            # newer jax CPU backends reject the memory kind already in
+            # the SingleDeviceSharding constructor, hence it sits inside
+            # this try too)
+            self._host_sh = SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
             probe = jax.jit(
                 lambda x: jax.device_put(
                     jax.device_put(x, self._dev_sh) + 1, self._host_sh),

@@ -761,6 +761,28 @@ class PipelineParallel(Layer):
         self._pipe_step_raw = None
         self._pipe_lint_key = None
         self.lint_findings = None
+        # training health monitor: assign True/dict/HealthConfig/
+        # HealthMonitor (see telemetry.health). train_batch then arms
+        # the hang watchdog per batch and taps loss nan/inf + grad-norm
+        # (eager accumulation path) as device-side values, fetched every
+        # every_k batches
+        self.health = None
+        self._health_mon = None
+        self._health_key = None
+        self._last_health = None
+
+    def _health_monitor(self):
+        """Normalize+cache self.health (attribute-style like self.lint,
+        so existing construction sites don't change signature)."""
+        if self.health is None or self.health is False:
+            self._health_mon = None
+            self._health_key = self.health
+            return None
+        if self._health_mon is None or self._health_key is not self.health:
+            from ..telemetry import health as _health
+            self._health_mon = _health.as_monitor(self.health)
+            self._health_key = self.health
+        return self._health_mon
 
     def _maybe_lint_pipeline(self, args, mesh):
         """Jaxpr-lint the pipelined step (one extra trace, nothing
@@ -1299,8 +1321,24 @@ class PipelineParallel(Layer):
         from .. import monitor, telemetry
         monitor.incr("pipeline.train_batches")
         with telemetry.auto_step() as _tw:
-            out = self._train_batch_impl(data, optimizer, lr_scheduler,
-                                         scaler)
+            hm = self._health_monitor()
+            if hm is not None:
+                with hm.guard(_tw) as g:
+                    out = self._train_batch_impl(data, optimizer,
+                                                 lr_scheduler, scaler)
+                    # eager (non-jit) path: only build the tap values on
+                    # fetch batches — non-fetch batches would discard
+                    # them, and here each is a real dispatch, not a
+                    # fused part of a compiled step
+                    if hm.will_fetch():
+                        from ..telemetry.health import device_health_stats
+                        grads = self._last_health or []
+                        g.stage(device_health_stats(
+                            out._value, grads, [], []))
+                    self._last_health = None
+            else:
+                out = self._train_batch_impl(data, optimizer, lr_scheduler,
+                                             scaler)
             _tw.note(loss=out)
             return out
 
@@ -1337,6 +1375,13 @@ class PipelineParallel(Layer):
             else:
                 loss.backward()
             total = loss if total is None else total + loss
+        if self._health_mon is not None and self._health_mon.will_fetch():
+            # raw device grad values for the health taps (still lazy;
+            # the every-k fetch in step_close is the only sync). Only
+            # on fetch batches — elsewhere the stats would be discarded
+            self._last_health = [
+                p.grad._value for p in (optimizer._parameter_list or [])
+                if p.grad is not None]
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

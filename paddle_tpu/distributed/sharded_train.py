@@ -85,7 +85,7 @@ class ShardedTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=None,
                  seq_shard_batch=False, donate=True, offload=None,
-                 lint=False):
+                 lint=False, health=None):
         self.mesh = mesh or env.current_mesh()
         self.model = model
         self.loss_fn = loss_fn
@@ -125,6 +125,13 @@ class ShardedTrainStep:
         self._donate = donate
         self._lint = lint
         self.lint_findings = None
+        # health taps (see jit.TrainStep): the device-side stats reduce
+        # over the SHARDED grads/params inside the pjit'd program — the
+        # GSPMD partitioner inserts the cross-device reductions, so the
+        # fetched scalars are already global
+        from ..telemetry import health as _health
+        self.health = _health.as_monitor(health)
+        self._last_health = None
         if self.offload:
             # static per instance: precompute both memory-kind variants
             # so the per-step H2D/D2H hops don't rebuild NamedShardings
@@ -171,7 +178,7 @@ class ShardedTrainStep:
         self.lint_findings = emit(findings, mode=self._lint,
                                   title="graph doctor [ShardedTrainStep]")
 
-    def _build_step_fn(self, check_nan_inf=False):
+    def _build_step_fn(self, check_nan_inf=False, health_taps=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
 
@@ -193,6 +200,8 @@ class ShardedTrainStep:
                               jnp.stack([jnp.all(jnp.isfinite(g))
                                          for g in grads])
                               if grads else jnp.ones((0,), jnp.bool_))
+                # health taps see the raw (pre-clip) grads
+                raw_grads = grads if health_taps else None
                 with autograd.no_grad():
                     if opt._grad_clip is not None:
                         pg = opt._grad_clip(
@@ -207,12 +216,18 @@ class ShardedTrainStep:
                     new_states = jax.tree_util.tree_map(
                         lambda n, o: jnp.where(ok, n, o),
                         new_states, opt_states)
+                hstats = None
+                if health_taps:
+                    from ..telemetry.health import device_health_stats
+                    hstats = device_health_stats(
+                        loss._value, raw_grads, new_vals, param_vals)
                 new_buf = [b._value for b in buffers]
-                return loss._value, new_vals, new_states, new_buf, checks
+                return (loss._value, new_vals, new_states, new_buf,
+                        checks, hstats)
 
         return step
 
-    def _make_step(self, check_nan_inf=False):
+    def _make_step(self, check_nan_inf=False, health_taps=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         mesh = self.mesh
         param_sh = [self._param_sharding(p) for p in params]
@@ -228,9 +243,10 @@ class ShardedTrainStep:
         buf_sh = [env.replicated(mesh)] * len(buffers)
         rep = env.replicated(mesh)
         in_sh = (param_sh, state_sh, buf_sh, rep, rep, None)
-        out_sh = (rep, param_sh, state_sh, buf_sh, None)
+        out_sh = (rep, param_sh, state_sh, buf_sh, None, None)
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf),
+        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf,
+                                           health_taps=health_taps),
                        in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
@@ -239,7 +255,12 @@ class ShardedTrainStep:
         # context-active TelemetryRecorder records this step too
         from .. import telemetry
         with telemetry.auto_step() as _tw:
-            out = self._run_step(*batch)
+            if self.health is not None:
+                with self.health.guard(_tw) as g:
+                    out = self._run_step(*batch)
+                    g.stage(self._last_health)
+            else:
+                out = self._run_step(*batch)
             _tw.note(loss=out)
             return out
 
@@ -247,10 +268,13 @@ class ShardedTrainStep:
         from .. import telemetry
         from ..flags import get_flag
         check = get_flag("check_nan_inf")
-        if self._jitted is None or getattr(self, "_check_key", None) != check:
+        taps = self.health is not None
+        key = (check, taps)
+        if self._jitted is None or getattr(self, "_check_key", None) != key:
             self._maybe_lint(batch)
-            self._jitted = self._make_step(check_nan_inf=check)
-            self._check_key = check
+            self._jitted = self._make_step(check_nan_inf=check,
+                                           health_taps=taps)
+            self._check_key = key
         with telemetry.span("sharded.shard_batch", cat="h2d"):
             batch_vals = shard_batch(batch, self.mesh, self.seq_shard)
         param_vals = [p._value for p in self.params]
@@ -270,8 +294,10 @@ class ShardedTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
         with telemetry.span("sharded.step_dispatch", cat="dispatch"):
-            loss, new_vals, new_states, new_buf, checks = self._jitted(
+            (loss, new_vals, new_states, new_buf, checks,
+             hstats) = self._jitted(
                 param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        self._last_health = hstats
         if self.offload:
             # async D2H: evict the updated states back to pinned_host so
             # HBM is free of them between steps

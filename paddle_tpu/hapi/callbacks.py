@@ -250,10 +250,20 @@ class TelemetryCallback(Callback):
     cb = TelemetryCallback("run.jsonl", tokens_per_step=B*S,
                            flops_per_token=telemetry.model_flops_per_token(...))
     model.fit(..., callbacks=[cb]); cb.recorder.records / cb.export(path)
+
+    health: True | dict | telemetry.HealthConfig | HealthMonitor wires
+    the training health monitor at RECORD level: every batch's loss and
+    wall time run through the anomaly rules (loss spikes, NaN, step-time
+    regression) with the configured warn/record/raise action, and the
+    hang watchdog (config.hang_deadline_s) is armed around each batch —
+    a Model.fit loop gets black-box hang dumps with zero extra code.
+    (Device-side grad taps need the step object; use TrainStep/
+    ShardedTrainStep health= for those.)
     """
 
     def __init__(self, path=None, tokens_per_step=None, flops_per_step=None,
-                 flops_per_token=None, peak_flops=None, recorder=None):
+                 flops_per_token=None, peak_flops=None, recorder=None,
+                 health=None):
         super().__init__()
         if recorder is None:
             from .. import telemetry
@@ -262,7 +272,10 @@ class TelemetryCallback(Callback):
                 flops_per_step=flops_per_step,
                 flops_per_token=flops_per_token, peak_flops=peak_flops)
         self.recorder = recorder
+        from ..telemetry import health as _health
+        self.health = _health.as_monitor(health)
         self._activated = False
+        self._batch_t0 = None
 
     def on_train_begin(self, logs=None):
         # context-activate the recorder for the whole fit: collective /
@@ -277,6 +290,9 @@ class TelemetryCallback(Callback):
     def on_train_batch_begin(self, step, logs=None):
         if not self.recorder._open:
             self.recorder.start_step()
+        if self.health is not None:
+            self.health.step_open()
+            self._batch_t0 = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         if self.recorder._open:
@@ -284,11 +300,21 @@ class TelemetryCallback(Callback):
             if isinstance(loss, (list, tuple)) and loss:
                 loss = loss[0]
             loss = np.ravel(loss)[0] if loss is not None else None
-            self.recorder.end_step(loss=loss)
+            fields = {}
+            if self.health is not None:
+                step_ms = None
+                if self._batch_t0 is not None:
+                    step_ms = (time.perf_counter() - self._batch_t0) * 1000.0
+                lv = None if loss is None else float(loss)
+                fields = self.health.step_close(
+                    loss=lv, step_ms=step_ms) or {}
+            self.recorder.end_step(loss=loss, **fields)
 
     def on_train_end(self, logs=None):
         if self.recorder._open:   # tail window from an aborted batch
             self.recorder.end_step()
+        if self.health is not None:
+            self.health.close()   # stop the watchdog thread
         if self._activated:
             self.recorder.__exit__(None, None, None)
             self._activated = False

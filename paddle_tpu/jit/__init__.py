@@ -227,9 +227,20 @@ class TrainStep:
     lint: False (default) | True (run the graph-doctor jaxpr lint at
     trace time and warn on findings) | "strict" (raise GraphDoctorError
     on error-severity findings) — see paddle_tpu.analysis.
+
+    health: None (default) | True | dict | telemetry.HealthConfig |
+    telemetry.HealthMonitor — in-flight numerics monitoring. When on,
+    the traced step also computes global grad-norm, update/param ratio
+    and NaN/Inf counts as DEVICE-SIDE auxiliary outputs (no host sync;
+    one small fetch every `every_k` steps), feeds them through the
+    anomaly detector (loss spikes, grad explosions, step-time
+    regressions, hard NaN/Inf) with the configured warn/record/raise
+    action, arms the hang watchdog around each step, and lands the
+    fields in the step's JSONL record — see paddle_tpu.telemetry.health.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True, lint=False):
+    def __init__(self, model, loss_fn, optimizer, donate=True, lint=False,
+                 health=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -244,6 +255,9 @@ class TrainStep:
         self._donate = donate
         self._lint = lint
         self.lint_findings = None
+        from ..telemetry import health as _health
+        self.health = _health.as_monitor(health)
+        self._last_health = None
 
     def _maybe_lint(self, batch):
         """Pre-flight static analysis of the step (one extra trace, no
@@ -256,7 +270,7 @@ class TrainStep:
             lint_train_step(self, *batch), mode=self._lint,
             title=f"graph doctor [{type(self).__name__}]")
 
-    def _build_step_fn(self, check_nan_inf=False):
+    def _build_step_fn(self, check_nan_inf=False, health_taps=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
 
@@ -280,6 +294,9 @@ class TrainStep:
                               jnp.stack([jnp.all(jnp.isfinite(g))
                                          for g in grads])
                               if grads else jnp.ones((0,), jnp.bool_))
+                # health taps judge the RAW grads (an explosion the clip
+                # would mask is exactly what the detector must see)
+                raw_grads = grads if health_taps else None
                 with autograd.no_grad():
                     if opt._grad_clip is not None:
                         pg = opt._grad_clip(
@@ -298,14 +315,21 @@ class TrainStep:
                     new_states = jax.tree_util.tree_map(
                         lambda n, o: jnp.where(ok, n, o),
                         new_states, opt_states)
+                hstats = None
+                if health_taps:
+                    from ..telemetry.health import device_health_stats
+                    hstats = device_health_stats(
+                        loss._value, raw_grads, new_vals, param_vals)
                 new_buf = [b._value for b in buffers]
-                return loss._value, new_vals, new_states, new_buf, checks
+                return (loss._value, new_vals, new_states, new_buf,
+                        checks, hstats)
 
         return step
 
-    def _make_step(self, check_nan_inf=False):
+    def _make_step(self, check_nan_inf=False, health_taps=False):
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf),
+        return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf,
+                                           health_taps=health_taps),
                        donate_argnums=donate)
 
     def __call__(self, *batch):
@@ -315,7 +339,15 @@ class TrainStep:
         # call-site changes; inert (one stack peek) when no recorder is on
         from .. import telemetry
         with telemetry.auto_step() as _tw:
-            out = self._run_step(*batch)
+            if self.health is not None:
+                # guard: watchdog armed around the step, black-box dump
+                # on an escaping exception, taps fetched every k and
+                # noted into the step record
+                with self.health.guard(_tw) as g:
+                    out = self._run_step(*batch)
+                    g.stage(self._last_health)
+            else:
+                out = self._run_step(*batch)
             _tw.note(loss=out)
             return out
 
@@ -324,10 +356,13 @@ class TrainStep:
         from .. import flags
         st = amp_state()
         check = flags.get_flag("check_nan_inf")
-        amp_key = (st.enabled, str(st.dtype) if st.enabled else "", check)
+        taps = self.health is not None
+        amp_key = (st.enabled, str(st.dtype) if st.enabled else "", check,
+                   taps)
         if self._jitted is None or getattr(self, "_amp_key", None) != amp_key:
             self._maybe_lint(batch)
-            self._jitted = self._make_step(check_nan_inf=check)
+            self._jitted = self._make_step(check_nan_inf=check,
+                                           health_taps=taps)
             self._amp_key = amp_key
         from .. import monitor
         monitor.incr("jit.train_steps")
@@ -338,8 +373,9 @@ class TrainStep:
         buffer_vals = [b._value for b in self.buffers]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
-        loss, new_vals, new_states, new_buf, checks = self._jitted(
+        loss, new_vals, new_states, new_buf, checks, hstats = self._jitted(
             param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        self._last_health = hstats
         # reassign state FIRST: the inputs were donated, so the tensors must
         # point at the fresh buffers even when the finite check fires (the
         # step itself was skipped on device in that case)

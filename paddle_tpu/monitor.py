@@ -9,18 +9,54 @@ can be triaged from `paddle_tpu.monitor.snapshot()` alone:
 - ``jit.train_steps``      — TrainStep executions
 - ``io.batches``           — DataLoader batches delivered
 - ``ps.pulls`` / ``ps.pushes`` — DistributedEmbedding traffic
-"""
-import threading
+- ``health.anomalies`` / ``health.nan_steps`` — training health monitor
 
-__all__ = ["incr", "set_value", "get", "snapshot", "reset", "StatRegistry"]
+Two stat kinds (Prometheus-compatible semantics, exported verbatim by
+`telemetry.metrics_http`):
+
+- counters (`incr`) are MONOTONIC — they only move forward; a negative
+  delta raises instead of silently corrupting a rate() over the scrape;
+- gauges (`set_gauge`) are point-in-time values that may move both ways
+  (loss, grad norm, queue depth).
+
+`snapshot()` merges both plus process identity (``process.uptime_s``,
+``process.rank``) so one scrape/dump is self-describing;
+`snapshot_typed()` keeps the kinds separate for the /metrics exporter.
+"""
+import os
+import threading
+import time
+
+__all__ = ["incr", "set_value", "set_gauge", "get", "get_gauge",
+           "snapshot", "snapshot_typed", "set_rank", "reset",
+           "StatRegistry"]
+
+_START_TIME = time.monotonic()
+
+
+def _default_rank():
+    for var in ("PADDLE_TRAINER_ID", "RANK"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
 
 
 class StatRegistry:
     def __init__(self):
         self._mu = threading.Lock()
         self._stats = {}
+        self._gauges = {}
+        self._rank = None
 
     def incr(self, name, delta=1):
+        if delta < 0:
+            raise ValueError(
+                f"monitor counter {name!r} is monotonic; use set_gauge() "
+                f"for values that can decrease (got delta={delta})")
         with self._mu:
             self._stats[name] = self._stats.get(name, 0) + delta
             return self._stats[name]
@@ -29,26 +65,64 @@ class StatRegistry:
         with self._mu:
             self._stats[name] = value
 
+    def set_gauge(self, name, value):
+        with self._mu:
+            self._gauges[name] = float(value)
+
     def get(self, name, default=0):
         with self._mu:
             return self._stats.get(name, default)
 
-    def snapshot(self):
+    def get_gauge(self, name, default=0.0):
         with self._mu:
-            return dict(self._stats)
+            return self._gauges.get(name, default)
+
+    def set_rank(self, rank):
+        with self._mu:
+            self._rank = int(rank)
+
+    def _identity(self):
+        # call with self._mu held
+        rank = self._rank if self._rank is not None else _default_rank()
+        return {"process.uptime_s": round(time.monotonic() - _START_TIME, 3),
+                "process.rank": rank}
+
+    def snapshot(self):
+        """One flat dict: counters + gauges + process identity. Counter
+        names win on collision (they existed first; don't reuse names)."""
+        with self._mu:
+            out = dict(self._gauges)
+            out.update(self._stats)
+            out.update(self._identity())
+            return out
+
+    def snapshot_typed(self):
+        """{'counter': {...}, 'gauge': {...}} — the kind split the
+        Prometheus text exposition needs for its # TYPE lines. Process
+        identity (uptime, rank) rides with the gauges."""
+        with self._mu:
+            gauges = dict(self._gauges)
+            gauges.update(self._identity())
+            return {"counter": dict(self._stats), "gauge": gauges}
 
     def reset(self, name=None):
         with self._mu:
             if name is None:
                 self._stats.clear()
+                self._gauges.clear()
             else:
                 self._stats.pop(name, None)
+                self._gauges.pop(name, None)
 
 
 _registry = StatRegistry()
 
 incr = _registry.incr
 set_value = _registry.set_value
+set_gauge = _registry.set_gauge
 get = _registry.get
+get_gauge = _registry.get_gauge
+set_rank = _registry.set_rank
 snapshot = _registry.snapshot
+snapshot_typed = _registry.snapshot_typed
 reset = _registry.reset

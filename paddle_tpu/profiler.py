@@ -37,14 +37,30 @@ class ProfilerTarget:
 
 class RecordEvent:
     """Host span: `with RecordEvent("name"):` or start()/end()
-    (reference `platform/profiler.h:130`)."""
+    (reference `platform/profiler.h:130`).
+
+    Bridged INTO the telemetry span stack: while open, the event sits in
+    telemetry's open-span table (so the hang watchdog's black-box dump
+    names legacy-instrumented regions too), and on end() it lands in the
+    context-active TelemetryRecorder — legacy profiler spans and
+    flight-recorder/health spans merge into ONE Chrome trace. Spans
+    created BY `telemetry.span` (which wraps RecordEvent when this
+    profiler is enabled) carry `_from_telemetry` and skip the bridge so
+    nothing records twice."""
 
     def __init__(self, name):
         self.name = name
         self._t0 = None
+        self._from_telemetry = False
+        self._open_entry = None
 
     def begin(self):
         self._t0 = time.perf_counter()
+        if not self._from_telemetry:
+            from .telemetry import recorder as _trec
+            self._open_entry = _trec._push_open_span(
+                self.name, "host", self._t0,
+                rec=_trec.current_recorder())
         return self
 
     start = begin
@@ -55,6 +71,15 @@ class RecordEvent:
             return
         dt = time.perf_counter() - t0
         self._t0 = None
+        if self._open_entry is not None:
+            from .telemetry import recorder as _trec
+            _trec._pop_open_span(self._open_entry)
+            self._open_entry = None
+        if not self._from_telemetry:
+            from .telemetry import recorder as _trec
+            rec = _trec.current_recorder()
+            if rec is not None:
+                rec.add_span(self.name, t0, dt, cat="host")
         if _GLOBAL["enabled"]:
             with _GLOBAL["lock"]:
                 rec = _GLOBAL["events"][self.name]

@@ -21,21 +21,37 @@ Entry points:
 - StepTimer — explicit jax.stages AOT compile-cache wrapper.
 - hapi.callbacks.TelemetryCallback — Model.fit integration.
 - sink.export_chrome_tracing / tools/trace_check.py — trace tooling.
+- health.HealthConfig / HealthMonitor — jit-safe numerics taps +
+  anomaly detection (`health=` on the train steps); watchdog.HangWatchdog
+  — stall detection with black-box dumps; metrics_http.MetricsServer —
+  live /healthz, /metrics (Prometheus), /steps scrape endpoint;
+  tools/healthwatch.py replays the same anomaly rules offline.
 """
+from . import health  # noqa: F401
+from . import metrics_http  # noqa: F401
 from . import mfu  # noqa: F401
 from . import sink  # noqa: F401
+from . import watchdog  # noqa: F401
+from .health import (  # noqa: F401
+    Anomaly, AnomalyDetector, HealthConfig, HealthError, HealthMonitor)
+from .metrics_http import MetricsServer  # noqa: F401
 from .mfu import (  # noqa: F401
     device_peak_flops, model_flops_per_token, train_step_flops)
 from .recorder import (  # noqa: F401
-    StepTimer, TelemetryRecorder, auto_step, current_recorder, span)
+    StepTimer, TelemetryRecorder, auto_step, current_recorder, open_spans,
+    span)
 from .sink import (  # noqa: F401
     JsonlSink, export_chrome_tracing, make_phase_record, make_step_record,
     read_jsonl, validate_step_record)
+from .watchdog import HangWatchdog, dump_black_box  # noqa: F401
 
 __all__ = [
     "TelemetryRecorder", "StepTimer", "span", "auto_step",
-    "current_recorder", "JsonlSink", "read_jsonl", "make_step_record",
-    "make_phase_record", "validate_step_record", "export_chrome_tracing",
+    "current_recorder", "open_spans", "JsonlSink", "read_jsonl",
+    "make_step_record", "make_phase_record", "validate_step_record",
+    "export_chrome_tracing",
     "device_peak_flops", "model_flops_per_token", "train_step_flops",
-    "mfu", "sink",
+    "HealthConfig", "HealthMonitor", "HealthError", "Anomaly",
+    "AnomalyDetector", "HangWatchdog", "dump_black_box", "MetricsServer",
+    "mfu", "sink", "health", "watchdog", "metrics_http",
 ]

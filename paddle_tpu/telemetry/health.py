@@ -1,0 +1,575 @@
+"""Training health monitor: jit-safe numerics taps + anomaly detection.
+
+The flight recorder (recorder.py) tells you what a step COST and the
+graph doctor (paddle_tpu/analysis) rejects programs that are wrong
+before dispatch; this module watches a job that is RUNNING WRONG —
+NaN'd grads silently poisoning weights, a loss spike three hours in, a
+step-time regression after a topology change — and a sibling watchdog
+(watchdog.py) catches the job that stops running at all.
+
+Three pieces:
+
+- **Numerics taps** (`device_health_stats`) — global grad-norm,
+  update/param ratio, and NaN/Inf counts computed as auxiliary
+  DEVICE-SIDE outputs inside the traced train step (TrainStep /
+  ShardedTrainStep `health=`). Nothing syncs per step: the step returns
+  one extra (5,) f32 array that stays on device; `HealthMonitor`
+  fetches it every `every_k` steps (one tiny transfer that doubles as
+  the window sync), so `k > 1` adds zero per-step host traffic. Under a
+  GSPMD mesh the norms reduce over sharded arrays inside the compiled
+  program — the partitioner inserts whatever collectives that needs.
+
+- **Anomaly detector** (`AnomalyDetector`) — rolling-window z-score
+  rules over the fetched stats and/or recorded step JSONL: hard NaN/Inf
+  (`nan`), `loss_spike`, `grad_explosion`, `step_time_regression`,
+  plus `phase_error` for failed bench phases. The same rules run
+  in-flight (HealthMonitor) and offline (tools/healthwatch.py replays a
+  metrics JSONL), so what pages you in production is exactly what CI
+  gates on.
+
+- **HealthMonitor** — ties taps + detector + watchdog together behind
+  the `health=` hook: normalizes config, applies the configured action
+  (`warn` / `record` / `raise` HealthError), advances the
+  `health.anomalies` / `health.nan_steps` monitor counters, exports
+  last-seen values as monitor gauges (scraped verbatim by
+  `telemetry.metrics_http`), and arms/disarms the hang watchdog around
+  each step.
+
+Reference analogs: FLAGS_check_nan_inf (`nan_inf_utils_detail.cc`) is
+the hard-stop ancestor of the `nan` rule; the incubate
+TensorCheckerConfig ("check_nan_inf + debug mode") is the config-object
+shape `HealthConfig` follows; MegaScale/PaLM-style loss-spike skip
+logic motivates the rolling-window rules.
+"""
+import collections
+import contextlib
+import math
+import threading
+import time
+import warnings
+
+from .. import monitor
+
+__all__ = ["HealthConfig", "HealthError", "Anomaly", "AnomalyDetector",
+           "HealthMonitor", "as_monitor", "device_health_stats",
+           "HEALTH_STAT_FIELDS"]
+
+# layout of the stacked device stats array (one (5,) f32 per step)
+HEALTH_STAT_FIELDS = ("grad_norm", "update_ratio", "nan_count",
+                      "inf_count", "loss")
+
+_ACTIONS = ("warn", "record", "raise")
+
+
+class HealthError(RuntimeError):
+    """Raised by action='raise' when an anomaly fires (after counters
+    and gauges are advanced, so the crash is still triagable)."""
+
+    def __init__(self, anomalies):
+        self.anomalies = list(anomalies)
+        super().__init__("; ".join(a.message for a in self.anomalies))
+
+
+class HealthConfig:
+    """Knobs for the in-flight health monitor.
+
+    every_k           fetch the device stats every k-th step (k>1: zero
+                      per-step host transfer; the fetch is the only sync)
+    action            'warn' (default) | 'record' | 'raise' on anomaly
+    window            rolling-window length for the z-score rules
+    min_points        points required before a z-rule may fire
+    z_loss/z_grad     z-score thresholds for spike/explosion rules
+    z_step_time       z threshold for the step-time regression rule
+    rel_step_time     AND-guard: step time must also exceed this multiple
+                      of the window median (kills micro-jitter flags)
+    hang_deadline_s   arm a HangWatchdog with this deadline (None: off)
+    dump_dir          where black-box dumps go ('.' default)
+    dump_on_exception fire the black-box dump when an exception escapes
+                      a train step (default True)
+    ring_size         last-N step-record ring kept for dumps / /steps
+    """
+
+    def __init__(self, every_k=8, action="warn", window=64, min_points=8,
+                 z_loss=8.0, z_grad=8.0, z_step_time=8.0,
+                 rel_step_time=1.5, hang_deadline_s=None, dump_dir=".",
+                 dump_on_exception=True, ring_size=64):
+        if action not in _ACTIONS:
+            raise ValueError(f"health action must be one of {_ACTIONS}, "
+                             f"got {action!r}")
+        if every_k < 1:
+            raise ValueError(f"every_k must be >= 1, got {every_k}")
+        self.every_k = int(every_k)
+        self.action = action
+        self.window = int(window)
+        self.min_points = int(min_points)
+        self.z_loss = float(z_loss)
+        self.z_grad = float(z_grad)
+        self.z_step_time = float(z_step_time)
+        self.rel_step_time = float(rel_step_time)
+        self.hang_deadline_s = hang_deadline_s
+        self.dump_dir = dump_dir
+        self.dump_on_exception = bool(dump_on_exception)
+        self.ring_size = int(ring_size)
+
+    def __repr__(self):
+        return (f"HealthConfig(every_k={self.every_k}, "
+                f"action={self.action!r}, window={self.window})")
+
+
+class Anomaly:
+    """One detected anomaly: kind + where + how far out of band."""
+
+    def __init__(self, kind, step, value, message, expected=None, z=None):
+        self.kind = kind
+        self.step = step
+        self.value = value
+        self.message = message
+        self.expected = expected
+        self.z = z
+
+    def to_dict(self):
+        d = {"kind": self.kind, "step": self.step,
+             "value": _json_safe(self.value), "message": self.message}
+        if self.expected is not None:
+            d["expected"] = _json_safe(self.expected)
+        if self.z is not None:
+            d["z"] = _json_safe(self.z)
+        return d
+
+    def __repr__(self):
+        return f"Anomaly({self.kind} @ step {self.step}: {self.message})"
+
+
+def _json_safe(v):
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)
+    return v
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+class _Window:
+    """Rolling mean/std/median window with a relative std floor (a
+    near-constant series must not turn noise into infinite z-scores)."""
+
+    def __init__(self, size):
+        self._buf = collections.deque(maxlen=size)
+
+    def __len__(self):
+        return len(self._buf)
+
+    def add(self, v):
+        self._buf.append(float(v))
+
+    def stats(self):
+        n = len(self._buf)
+        mean = sum(self._buf) / n
+        var = sum((v - mean) ** 2 for v in self._buf) / n
+        std = max(math.sqrt(var), abs(mean) * 0.01, 1e-9)
+        med = sorted(self._buf)[n // 2]
+        return mean, std, med
+
+    def z(self, v):
+        mean, std, _ = self.stats()
+        return (v - mean) / std
+
+
+class AnomalyDetector:
+    """Stateful rule engine over a stream of step records.
+
+    `observe(record)` takes one step-record dict (the JSONL schema, or
+    the partial dict HealthMonitor assembles in flight — only keys that
+    are present are judged) and returns the anomalies it triggered.
+    Rules:
+
+    - nan                  nan_count/inf_count > 0, or a non-finite
+                           loss/grad_norm/update_ratio value
+    - loss_spike           loss z-score above z_loss vs the rolling
+                           window (upward only — a falling loss is the
+                           point of training)
+    - grad_explosion       grad_norm z-score above z_grad (upward)
+    - step_time_regression step time z above z_step_time AND above
+                           rel_step_time x window median; records with
+                           compile_ms > 0 are exempt (recompiles are
+                           legitimately slow) and never enter the window
+    - phase_error          a bench phase record carrying an 'error' key
+                           or a non-finite metric value
+
+    Clean values enter their windows AFTER judgment, so a spike does not
+    vaccinate the window against itself; anomalous values are excluded
+    from the windows entirely.
+    """
+
+    def __init__(self, config=None):
+        self.config = config or HealthConfig()
+        c = self.config
+        self._loss = _Window(c.window)
+        self._grad = _Window(c.window)
+        self._step_t = _Window(c.window)
+        self.anomalies = []
+        self._n = 0
+
+    # -- helpers ------------------------------------------------------------
+    def _z_rule(self, win, value, z_thresh, step, kind, label,
+                rel_guard=None):
+        if not _finite(value):
+            return None
+        fired = None
+        if len(win) >= self.config.min_points:
+            mean, std, med = win.stats()
+            z = (value - mean) / std
+            rel_ok = True if rel_guard is None else \
+                value > rel_guard * max(med, 1e-9)
+            if z > z_thresh and rel_ok:
+                fired = Anomaly(
+                    kind, step, value,
+                    f"{label} {value:.6g} is {z:.1f} sigma above the "
+                    f"rolling mean {mean:.6g} (window {len(win)})",
+                    expected=mean, z=round(z, 2))
+        if fired is None:
+            win.add(value)
+        return fired
+
+    # -- the rule pass ------------------------------------------------------
+    def observe(self, record):
+        """Judge one record; returns [Anomaly, ...] ([] == healthy)."""
+        self._n += 1
+        rec = record or {}
+        if rec.get("kind") == "phase":
+            found = self._observe_phase(rec)
+            self.anomalies.extend(found)
+            return found
+        step = rec.get("step", self._n - 1)
+        found = []
+
+        # hard NaN/Inf first: a poisoned step must not feed the windows
+        nan_n = rec.get("nan_count") or 0
+        inf_n = rec.get("inf_count") or 0
+        bad_vals = [k for k in ("loss", "grad_norm", "update_ratio")
+                    if isinstance(rec.get(k), float)
+                    and not math.isfinite(rec[k])]
+        if nan_n or inf_n or bad_vals:
+            parts = []
+            if nan_n:
+                parts.append(f"{int(nan_n)} NaN value(s)")
+            if inf_n:
+                parts.append(f"{int(inf_n)} Inf value(s)")
+            if bad_vals:
+                parts.append("non-finite " + "/".join(bad_vals))
+            found.append(Anomaly(
+                "nan", step, float(nan_n + inf_n) or float("nan"),
+                f"step {step}: " + ", ".join(parts)
+                + " in loss/grads — updates from this step are suspect"))
+            self.anomalies.extend(found)
+            return found   # no window feeding, no further rules
+
+        a = self._z_rule(self._loss, rec.get("loss"),
+                         self.config.z_loss, step, "loss_spike", "loss")
+        if a:
+            found.append(a)
+        a = self._z_rule(self._grad, rec.get("grad_norm"),
+                         self.config.z_grad, step, "grad_explosion",
+                         "grad norm")
+        if a:
+            found.append(a)
+
+        st = rec.get("step_time_ms")
+        if st is None:
+            st = rec.get("execute_ms")
+        if st is None:
+            st = rec.get("step_ms")
+        if st is not None and not rec.get("compile_ms"):
+            a = self._z_rule(self._step_t, st, self.config.z_step_time,
+                             step, "step_time_regression", "step time (ms)",
+                             rel_guard=self.config.rel_step_time)
+            if a:
+                found.append(a)
+        self.anomalies.extend(found)
+        return found
+
+    def _observe_phase(self, rec):
+        name = rec.get("phase", "?")
+        found = []
+        metrics = rec.get("metrics") or {}
+        if "error" in metrics or "error" in rec:
+            found.append(Anomaly(
+                "phase_error", name, None,
+                f"phase {name!r} recorded an error: "
+                f"{metrics.get('error') or rec.get('error')}"))
+        bad = [k for k, v in metrics.items()
+               if isinstance(v, float) and not math.isfinite(v)]
+        if bad:
+            found.append(Anomaly(
+                "phase_error", name, None,
+                f"phase {name!r} carries non-finite metric(s): {bad}"))
+        return found
+
+    def kinds(self):
+        """Distinct anomaly kinds seen so far (healthwatch --expect)."""
+        return sorted({a.kind for a in self.anomalies})
+
+
+class HealthMonitor:
+    """In-flight glue: taps -> detector -> action, plus the watchdog.
+
+    A train step with `health=` brackets its body with `guard()`:
+
+        with mon.guard(window) as g:     # arms the hang watchdog
+            out = dispatch(...)          # raise -> black-box dump
+            g.stage(stats_dev)           # device stats, still lazy
+        # on success guard ran step_close: disarm + fetch every k +
+        # note the fetched fields into the telemetry step window
+
+    `stats_dev` is the device-side (5,) array from
+    `device_health_stats` (or None for record-only integrations, e.g.
+    the hapi callback, which passes host values via `loss=`).
+    `step_close` returns None on non-fetch steps, else the dict of
+    health fields merged into the step's JSONL record; the watchdog is
+    disarmed even when action='raise' turns an anomaly into a
+    HealthError mid-close.
+    """
+
+    def __init__(self, config=None):
+        if isinstance(config, dict):
+            config = HealthConfig(**config)
+        self.config = config or HealthConfig()
+        self.detector = AnomalyDetector(self.config)
+        self.ring = collections.deque(maxlen=self.config.ring_size)
+        self.watchdog = None
+        self._wd_started = False
+        self._mu = threading.Lock()
+        self._step = 0
+        self._pending = None          # latest un-fetched device stats
+        self._staged = None           # stats handed over via guard/stage
+        self._t_last_fetch = None
+        self._steps_since_fetch = 0
+        if self.config.hang_deadline_s:
+            from .watchdog import HangWatchdog
+            self.watchdog = HangWatchdog(
+                deadline_s=self.config.hang_deadline_s,
+                dump_dir=self.config.dump_dir, ring=self.ring)
+
+    # -- step lifecycle -----------------------------------------------------
+    @contextlib.contextmanager
+    def guard(self, window=None):
+        """Bracket one train step. Arms the watchdog; an escaping
+        exception triggers the black-box dump (then re-raises); on
+        success runs step_close with whatever the body `stage()`d and
+        notes the fetched fields into `window` (a telemetry step
+        window with .note, e.g. from auto_step). The single wrapper
+        shared by TrainStep / ShardedTrainStep / PipelineParallel."""
+        self.step_open()
+        try:
+            yield self
+        except Exception as e:
+            self.on_exception(e)
+            raise
+        else:
+            stats, self._staged = self._staged, None
+            fields = self.step_close(stats)
+            if fields and window is not None:
+                window.note(**fields)
+
+    def stage(self, stats_dev):
+        """Hand the step's device-side stats array to the enclosing
+        guard() (kept lazy; fetched on the every_k cadence)."""
+        self._staged = stats_dev
+
+    def will_fetch(self):
+        """True when the NEXT step_close will fetch+judge — lets eager
+        (non-jit) integrations skip building tap values that would
+        only be discarded on non-fetch steps."""
+        return self._steps_since_fetch + 1 >= self.config.every_k
+
+    def step_open(self):
+        if self.watchdog is not None:
+            if not self._wd_started:
+                self.watchdog.start()
+                self._wd_started = True
+            self.watchdog.step_opened()
+
+    def step_close(self, stats_dev=None, loss=None, step_ms=None):
+        """Close one step. Fetches + judges every `every_k`-th call;
+        otherwise just rotates the pending device handle (no sync).
+        The watchdog is disarmed even when action='raise' escalates an
+        anomaly to HealthError out of the judge."""
+        self._step += 1
+        self._steps_since_fetch += 1
+        fields = None
+        if stats_dev is not None:
+            self._pending = stats_dev
+        if self._pending is not None:
+            # device stats pending: honor the every_k fetch cadence (the
+            # fetch is the only host transfer the taps ever make)
+            fetch = self._steps_since_fetch >= self.config.every_k
+        else:
+            # record-level integration (host values only): judging is
+            # free, so every step goes through the rules
+            fetch = loss is not None or step_ms is not None
+        try:
+            if fetch:
+                fields = self._fetch_and_judge(loss=loss, step_ms=step_ms)
+        finally:
+            if self.watchdog is not None:
+                # ring is shared with the watchdog, so no record= here —
+                # _fetch_and_judge already appended the full record
+                self.watchdog.step_closed()
+        return fields
+
+    def observe_record(self, record):
+        """Record-level entry (hapi callback / offline replay through a
+        live monitor): judge a full step-record dict immediately."""
+        self.ring.append(record)
+        found = self.detector.observe(record)
+        if found:
+            self._act(found)
+        return found
+
+    def on_exception(self, exc):
+        """An exception escaped the train step: count it, dump the
+        black box (same dump the hang watchdog writes), disarm."""
+        monitor.incr("health.step_exceptions")
+        path = None
+        if self.config.dump_on_exception:
+            from . import watchdog as _wd
+            path = _wd.dump_black_box(
+                reason=f"exception escaped train step: "
+                       f"{type(exc).__name__}: {exc}",
+                dump_dir=self.config.dump_dir, ring=list(self.ring))
+        if self.watchdog is not None:
+            self.watchdog.step_closed()
+        return path
+
+    def close(self):
+        if self.watchdog is not None and self._wd_started:
+            self.watchdog.stop()
+            self._wd_started = False
+
+    # -- internals ----------------------------------------------------------
+    def _fetch_and_judge(self, loss=None, step_ms=None):
+        import numpy as np
+        now = time.perf_counter()
+        fields = {}
+        if self._pending is not None:
+            vals = np.asarray(self._pending)   # the every-k host transfer
+            self._pending = None
+            monitor.incr("health.fetches")
+            fields = {
+                "grad_norm": float(vals[0]),
+                "update_ratio": float(vals[1]),
+                "nan_count": int(vals[2]) if math.isfinite(
+                    float(vals[2])) else 1,
+                "inf_count": int(vals[3]) if math.isfinite(
+                    float(vals[3])) else 1,
+            }
+            if loss is None:
+                loss = float(vals[4])
+        rec = dict(fields)
+        rec["step"] = self._step - 1
+        if loss is not None:
+            rec["loss"] = float(loss)
+            fields["loss"] = float(loss)
+        if step_ms is not None:
+            rec["step_time_ms"] = float(step_ms)
+        elif self._t_last_fetch is not None:
+            # the fetch synced the device, so wall time since the LAST
+            # fetch covers every step in the window; the average is an
+            # honest per-step time with zero extra syncs. The first
+            # window is skipped (it pays compile).
+            rec["step_time_ms"] = ((now - self._t_last_fetch) * 1000.0
+                                   / max(1, self._steps_since_fetch))
+        self._t_last_fetch = now
+        self._steps_since_fetch = 0
+
+        for k, v in fields.items():
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                monitor.set_gauge(f"health.{k}", float(v))
+        self.ring.append(rec)
+        found = self.detector.observe(rec)
+        if found:
+            self._act(found)
+        # loss rode along only for the detector; the recorder already
+        # owns the loss field of the JSONL record
+        fields.pop("loss", None)
+        return fields or None
+
+    def _act(self, anomalies):
+        monitor.incr("health.anomalies", len(anomalies))
+        nan_hits = [a for a in anomalies if a.kind == "nan"]
+        if nan_hits:
+            monitor.incr("health.nan_steps", len(nan_hits))
+        if self.config.action == "record":
+            return
+        if self.config.action == "warn":
+            for a in anomalies:
+                warnings.warn(f"[health] {a.message}", RuntimeWarning,
+                              stacklevel=3)
+            return
+        raise HealthError(anomalies)
+
+    @property
+    def anomalies(self):
+        return self.detector.anomalies
+
+
+def as_monitor(health):
+    """Normalize the `health=` argument of TrainStep/ShardedTrainStep/
+    PipelineParallel: None/False -> None, True -> default HealthMonitor,
+    dict/HealthConfig -> wrapped, HealthMonitor -> itself (shared across
+    steps so the windows/watchdog are one per job)."""
+    if health is None or health is False:
+        return None
+    if isinstance(health, HealthMonitor):
+        return health
+    if health is True:
+        return HealthMonitor()
+    if isinstance(health, (dict, HealthConfig)):
+        return HealthMonitor(health)
+    raise TypeError(
+        f"health= expects True/dict/HealthConfig/HealthMonitor, "
+        f"got {type(health).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# device-side taps (called INSIDE the traced step — jnp only, no host)
+# ---------------------------------------------------------------------------
+
+def device_health_stats(loss_val, grads, new_vals, param_vals):
+    """Build the (5,) f32 health stats array inside a traced train step:
+    [global grad-norm, update/param norm ratio, NaN count, Inf count,
+    loss]. Pure jnp on tracers — no `.item()`, no `device_get`, no
+    callbacks — so it fuses into the step's XLA program and costs a few
+    tiny reductions; under GSPMD the partitioner lowers the norms over
+    sharded arrays with its own collectives."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    if grads:
+        sq = [jnp.sum(jnp.square(g.astype(f32))) for g in grads]
+        grad_norm = jnp.sqrt(jnp.stack(sq).sum())
+        nan_count = jnp.stack(
+            [jnp.sum(jnp.isnan(g)) for g in grads]).sum()
+        inf_count = jnp.stack(
+            [jnp.sum(jnp.isinf(g)) for g in grads]).sum()
+    else:
+        grad_norm = jnp.zeros((), f32)
+        nan_count = jnp.zeros((), jnp.int32)
+        inf_count = jnp.zeros((), jnp.int32)
+    nan_count = nan_count + jnp.sum(jnp.isnan(loss_val))
+    inf_count = inf_count + jnp.sum(jnp.isinf(loss_val))
+
+    if new_vals and param_vals:
+        upd_sq = [jnp.sum(jnp.square(n.astype(f32) - o.astype(f32)))
+                  for n, o in zip(new_vals, param_vals)]
+        par_sq = [jnp.sum(jnp.square(o.astype(f32))) for o in param_vals]
+        upd = jnp.sqrt(jnp.stack(upd_sq).sum())
+        par = jnp.sqrt(jnp.stack(par_sq).sum())
+        update_ratio = upd / jnp.maximum(par, 1e-12)
+    else:
+        update_ratio = jnp.zeros((), f32)
+
+    return jnp.stack([grad_norm.astype(f32), update_ratio.astype(f32),
+                      nan_count.astype(f32), inf_count.astype(f32),
+                      jnp.asarray(loss_val, f32).reshape(())])

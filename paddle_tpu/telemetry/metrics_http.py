@@ -1,0 +1,221 @@
+"""Live scrape surface for a training job: /healthz, /metrics, /steps.
+
+Stdlib-only (http.server) so a production run carries no serving
+dependency: point Prometheus (or curl) at the port and a silent job
+becomes inspectable without touching its stdout or attaching anything.
+
+- **GET /metrics** — Prometheus text exposition (0.0.4): every
+  `paddle_tpu.monitor` counter as a monotonic `counter`, every gauge
+  (including the health taps' last-seen grad_norm/update_ratio and
+  process uptime/rank) as a `gauge`, plus the last step record's
+  numeric fields as `paddle_tpu_last_step_*` gauges when a recorder or
+  health monitor is attached.
+- **GET /healthz** — one JSON object: status ("ok" | "stalled" |
+  "anomalous"), uptime, steps, anomaly/nan counters, watchdog state.
+  Status "stalled" answers 503 so a dumb HTTP prober doubles as a hang
+  alarm.
+- **GET /steps[?n=50]** — JSON tail of the most recent step records
+  (the health ring buffer, else the recorder's records list).
+
+Bind is loopback by default; pass host="0.0.0.0" deliberately for a
+pod-visible scrape. port=0 picks a free port (tests, multi-job hosts).
+
+    srv = MetricsServer(recorder=rec, health=mon, port=9464).start()
+    ... train ...
+    srv.stop()
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .. import monitor
+
+__all__ = ["MetricsServer", "prometheus_text"]
+
+_PREFIX = "paddle_tpu_"
+
+
+def _prom_name(name):
+    out = []
+    for ch in str(name):
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    sanitized = "".join(out)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return _PREFIX + sanitized
+
+
+def _prom_value(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if not float(f).is_integer() else str(int(f))
+
+
+def prometheus_text(last_record=None):
+    """Render monitor.snapshot_typed() (+ optionally the last step
+    record) as Prometheus exposition text. Counters keep their
+    monotonic `# TYPE` so rate() works on the scrape."""
+    typed = monitor.snapshot_typed()
+    lines = []
+    for kind in ("counter", "gauge"):
+        for name in sorted(typed[kind]):
+            val = _prom_value(typed[kind][name])
+            if val is None:
+                continue
+            pname = _prom_name(name)
+            lines.append(f"# TYPE {pname} {kind}")
+            lines.append(f"{pname} {val}")
+    if last_record:
+        for key in sorted(last_record):
+            v = last_record[key]
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            val = _prom_value(v)
+            if val is None:
+                continue
+            pname = _prom_name(f"last_step_{key}")
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {val}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-health/1"
+
+    # the ThreadingHTTPServer instance carries .metrics (MetricsServer)
+    def _send(self, code, body, ctype="application/json"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        ms = self.server.metrics
+        url = urlparse(self.path)
+        if url.path in ("/", "/healthz"):
+            status, body = ms.healthz()
+            self._send(503 if body["status"] == "stalled" else 200,
+                       json.dumps(body, indent=2, default=repr))
+        elif url.path == "/metrics":
+            self._send(200, prometheus_text(ms.last_record()),
+                       ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif url.path == "/steps":
+            q = parse_qs(url.query)
+            try:
+                n = int(q.get("n", ["50"])[0])
+            except ValueError:
+                n = 50
+            self._send(200, json.dumps(ms.steps_tail(n), default=repr))
+        else:
+            self._send(404, json.dumps(
+                {"error": f"unknown path {url.path!r}",
+                 "endpoints": ["/healthz", "/metrics", "/steps?n=50"]}))
+
+    def log_message(self, fmt, *args):   # silence per-request stderr spam
+        pass
+
+
+class MetricsServer:
+    """Threaded HTTP scrape endpoint over the process's monitor
+    registry, an optional TelemetryRecorder, and an optional
+    HealthMonitor. start() is non-blocking (daemon serve thread)."""
+
+    def __init__(self, recorder=None, health=None, host="127.0.0.1",
+                 port=0):
+        self.recorder = recorder
+        self.health = health
+        self.host = host
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    # -- data plumbing ------------------------------------------------------
+    def steps_tail(self, n=50):
+        n = max(1, min(int(n), 10000))
+        if self.health is not None and len(self.health.ring):
+            return list(self.health.ring)[-n:]
+        if self.recorder is not None:
+            return list(self.recorder.records[-n:])
+        return []
+
+    def last_record(self):
+        tail = self.steps_tail(1)
+        return tail[-1] if tail else None
+
+    def healthz(self):
+        snap = monitor.snapshot()
+        body = {
+            "status": "ok",
+            "uptime_s": snap.get("process.uptime_s"),
+            "rank": snap.get("process.rank"),
+            "steps": snap.get("telemetry.steps", 0),
+            "train_steps": snap.get("jit.train_steps", 0),
+            "anomalies": snap.get("health.anomalies", 0),
+            "nan_steps": snap.get("health.nan_steps", 0),
+            "watchdog_fires": snap.get("health.watchdog_fires", 0),
+        }
+        h = self.health
+        if h is not None:
+            body["anomaly_kinds"] = h.detector.kinds()
+            wd = h.watchdog
+            if wd is not None:
+                overdue = wd.overdue_s()
+                body["watchdog"] = {
+                    "armed": wd.armed,
+                    "deadline_s": wd.deadline_s,
+                    "overdue_s": round(max(0.0, overdue), 3),
+                    "dumps": list(wd.dumps),
+                }
+                if overdue > 0:
+                    body["status"] = "stalled"
+            if body["status"] == "ok" and h.anomalies:
+                body["status"] = "anomalous"
+        last = self.last_record()
+        if last:
+            body["last_step"] = last
+        return 200, body
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        httpd.daemon_threads = True
+        httpd.metrics = self
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="paddle-tpu-metrics-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -39,6 +39,7 @@ from .sink import JsonlSink, make_step_record
 _LOCK = threading.Lock()
 _RECORDER_STACK = []          # active (context-entered) recorders
 _OPEN_STEPS = []              # open _StepWindow objects (compile sink)
+_OPEN_SPANS = []              # spans entered but not yet exited (any thread)
 _LISTENER_INSTALLED = False
 
 # jax.monitoring events that constitute "compile" for the split; all
@@ -124,25 +125,71 @@ class _InertWindow:
         return self
 
 
+def _push_open_span(name, cat, t0, rec=None, rank=None, attrs=None):
+    """Register a just-entered span in the module-wide open-span table.
+    The hang watchdog reads this table to NAME what a stalled step is
+    stuck inside (e.g. `collective.all_reduce`), and chrome export
+    closes these instead of dropping them. Returns the entry (identity
+    is the removal token)."""
+    entry = {"name": name, "cat": cat, "t0": t0,
+             "tid": threading.get_ident(),
+             "thread": threading.current_thread().name,
+             "rec": rec, "rank": rank, "attrs": dict(attrs or {})}
+    with _LOCK:
+        _OPEN_SPANS.append(entry)
+    return entry
+
+
+def _pop_open_span(entry):
+    with _LOCK:
+        try:
+            _OPEN_SPANS.remove(entry)
+        except ValueError:
+            pass
+
+
+def open_spans():
+    """Snapshot of every currently-open telemetry span (all threads):
+    [{name, cat, age_s, thread, rank, attrs}], oldest first. This is
+    what the watchdog black-box dump records, so a hang inside an
+    instrumented region is attributable without a debugger."""
+    now = time.perf_counter()
+    with _LOCK:
+        entries = list(_OPEN_SPANS)
+    return [{"name": e["name"], "cat": e["cat"],
+             "age_s": round(now - e["t0"], 4), "thread": e["thread"],
+             "rank": e["rank"], "attrs": e["attrs"]} for e in entries]
+
+
 @contextlib.contextmanager
-def span(name, cat="host", rank=None):
+def span(name, cat="host", rank=None, **attrs):
     """Record a named host span into the active recorder (and bridge it
     into paddle_tpu.profiler's table when that profiler is enabled, so
-    existing RecordEvent consumers keep seeing one merged view)."""
+    existing RecordEvent consumers keep seeing one merged view). Extra
+    keyword attrs (e.g. axis/shape on collectives) ride into the span
+    dict, the chrome-trace `args`, and the watchdog's open-span dump.
+    While the body runs the span sits in the open-span table, so a hang
+    inside it is named in black-box dumps."""
     rec = current_recorder()
     from .. import profiler as _profiler
     ev = _profiler.RecordEvent(name) if _profiler._GLOBAL["enabled"] else None
     t0 = time.perf_counter()
     if ev is not None:
         ev._t0 = t0
+        ev._from_telemetry = True   # span() owns recorder routing here
+    entry = _push_open_span(name, cat, t0, rec=rec,
+                            rank=rank if rank is not None
+                            else (rec.rank if rec is not None else None),
+                            attrs=attrs)
     try:
         yield
     finally:
         dur = time.perf_counter() - t0
+        _pop_open_span(entry)
         if ev is not None:
             ev.end()
         if rec is not None:
-            rec.add_span(name, t0, dur, cat=cat, rank=rank)
+            rec.add_span(name, t0, dur, cat=cat, rank=rank, args=attrs)
 
 
 class StepTimer:
@@ -230,7 +277,8 @@ class TelemetryRecorder:
     def __init__(self, sink=None, rank=0, tokens_per_step=None,
                  flops_per_step=None, flops_per_token=None,
                  peak_flops=None, n_devices=None, track_memory=True):
-        self.sink = JsonlSink(sink) if isinstance(sink, str) else sink
+        self._owns_sink = isinstance(sink, str)
+        self.sink = JsonlSink(sink) if self._owns_sink else sink
         self.rank = int(rank)
         self.tokens_per_step = tokens_per_step
         self.flops_per_step = flops_per_step
@@ -249,11 +297,31 @@ class TelemetryRecorder:
         _install_listener()
 
     # -- span API ----------------------------------------------------------
-    def add_span(self, name, t0, dur, cat="host", rank=None, tid=None):
-        self.spans.append({
+    def add_span(self, name, t0, dur, cat="host", rank=None, tid=None,
+                 args=None):
+        sp = {
             "name": name, "t0": float(t0), "dur": float(dur),
             "cat": cat, "rank": self.rank if rank is None else int(rank),
-            "tid": threading.get_ident() % 1000 if tid is None else tid})
+            "tid": threading.get_ident() % 1000 if tid is None else tid}
+        if args:
+            sp["args"] = {k: (v if isinstance(v, (int, float, str, bool))
+                              else repr(v)) for k, v in args.items()}
+        self.spans.append(sp)
+
+    def open_span_dicts(self):
+        """Spans currently open under this recorder, synthesized as
+        closed span dicts ending 'now' and tagged args={'open': True} —
+        chrome export includes them instead of dropping them."""
+        now = time.perf_counter()
+        with _LOCK:
+            entries = [e for e in _OPEN_SPANS if e["rec"] is self]
+        return [{"name": e["name"], "t0": float(e["t0"]),
+                 "dur": float(now - e["t0"]), "cat": e["cat"],
+                 "rank": self.rank if e["rank"] is None else e["rank"],
+                 "tid": e["tid"] % 1000,
+                 "args": {"open": True, **{k: repr(v) for k, v
+                                           in e["attrs"].items()}}}
+                for e in entries]
 
     # -- step lifecycle ----------------------------------------------------
     @property
@@ -365,12 +433,29 @@ class TelemetryRecorder:
         _RECORDER_STACK.append(self)
         return self
 
-    def __exit__(self, *exc):
-        if self._win is not None:   # abandoned window (step raised)
-            with _LOCK:
-                _OPEN_STEPS.remove(self._win)
-            self._win = None
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if self._win is not None:
+            # abandoned window (the step raised): close it as an aborted
+            # record instead of dropping the measurements — the crash
+            # file and the JSONL then agree on when the run died
+            try:
+                self._win.loss = None   # likely poisoned; don't fetch
+                self.end_step(aborted=True,
+                              abort_reason=(exc_type.__name__
+                                            if exc_type else "unknown"))
+            except Exception:
+                with _LOCK:
+                    if self._win in _OPEN_STEPS:
+                        _OPEN_STEPS.remove(self._win)
+                self._win = None
         _RECORDER_STACK.remove(self)
+        if self.sink is not None:
+            if self._owns_sink:
+                # we opened this file handle; release it (a later write
+                # through this recorder transparently reopens append)
+                self.sink.close()
+            elif hasattr(self.sink, "flush"):
+                self.sink.flush()
         return False
 
     # -- helpers -----------------------------------------------------------

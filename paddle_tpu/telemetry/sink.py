@@ -10,9 +10,22 @@ Reference analogs: the profiler's `profiler.proto` serialized output and
 XPlane->TensorBoard path covers device-side detail, this covers the
 host-side step ledger.
 """
+import atexit
 import json
 import os
 import threading
+import weakref
+
+# one process-wide atexit hook over weak refs: sinks stay collectable
+# (a per-instance atexit.register would pin every sink + its fd for the
+# process lifetime) while anything still alive at exit gets flushed
+_LIVE_SINKS = weakref.WeakSet()
+_ATEXIT_INSTALLED = False
+
+
+def _close_live_sinks():
+    for sink in list(_LIVE_SINKS):
+        sink.close()
 
 SCHEMA_VERSION = 1
 
@@ -21,13 +34,19 @@ STEP_RECORD_KEYS = ("schema", "kind", "rank", "step", "step_ms",
                     "compile_ms", "execute_ms")
 # optional, present when the recorder has the inputs to compute them
 STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
-                      "cache_hits", "cache_misses", "collectives", "extra")
+                      "cache_hits", "cache_misses", "collectives",
+                      "grad_norm", "update_ratio", "nan_count",
+                      "inf_count", "extra")
+# health-tap fields (telemetry.health numerics taps; None until a fetch
+# step lands them — they appear every k-th record when taps are on)
+HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
 
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
                      cache_hits=None, cache_misses=None, collectives=None,
-                     **extra):
+                     grad_norm=None, update_ratio=None, nan_count=None,
+                     inf_count=None, **extra):
     """Normalize one step's measurements into the schema dict."""
     rec = {
         "schema": SCHEMA_VERSION,
@@ -50,6 +69,17 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
         rec["cache_hits"] = int(cache_hits)
     if cache_misses is not None:
         rec["cache_misses"] = int(cache_misses)
+    # health taps: keep non-finite values AS IS (NaN round-trips through
+    # json.loads) — a poisoned grad_norm is the signal, not noise; the
+    # paired nan/inf counts make it machine-checkable regardless
+    if grad_norm is not None:
+        rec["grad_norm"] = float(grad_norm)
+    if update_ratio is not None:
+        rec["update_ratio"] = float(update_ratio)
+    if nan_count is not None:
+        rec["nan_count"] = int(nan_count)
+    if inf_count is not None:
+        rec["inf_count"] = int(inf_count)
     if collectives:
         rec["collectives"] = {
             str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
@@ -77,23 +107,53 @@ def make_phase_record(phase, metrics, rank=0):
 
 
 class JsonlSink:
-    """Append-only JSONL metrics file, one record per line. Thread-safe;
-    flushes per record so a killed run keeps everything written."""
+    """Append-only JSONL metrics file, one record per line. Thread-safe.
+
+    Crash durability: the file handle is held open and every record is
+    flushed to the OS as it is written, and live sinks are closed by a
+    process-wide `atexit` hook (weak refs — a sink is still collectable
+    the moment its owner drops it) — records buffered at the moment of
+    an exception (or a SystemExit tearing the interpreter down) are on
+    disk, not lost in a dead buffer. A write after close() transparently
+    reopens (append), so a closed sink still works."""
 
     def __init__(self, path):
+        global _ATEXIT_INSTALLED
         self.path = os.fspath(path)
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         self._mu = threading.Lock()
         self._n = 0
+        self._f = open(self.path, "a")
+        if not _ATEXIT_INSTALLED:
+            atexit.register(_close_live_sinks)
+            _ATEXIT_INSTALLED = True
+        _LIVE_SINKS.add(self)
 
     def write(self, record):
         line = json.dumps(record, sort_keys=True)
         with self._mu:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+            if self._f is None or self._f.closed:
+                self._f = open(self.path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
             self._n += 1
         return record
+
+    def flush(self):
+        with self._mu:
+            if self._f is not None and not self._f.closed:
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+
+    def close(self):
+        with self._mu:
+            if self._f is not None and not self._f.closed:
+                self._f.flush()
+                self._f.close()
 
     def __len__(self):
         return self._n
@@ -135,6 +195,13 @@ def validate_step_record(rec):
         if isinstance(v, float) and (v != v or v in (float("inf"),
                                                      float("-inf"))):
             problems.append(f"'{key}' non-finite: {v!r}")
+    for key in HEALTH_KEYS:
+        # numeric when present; non-finite is ALLOWED here — a NaN
+        # grad_norm is the health taps reporting a poisoned step, and
+        # the paired nan/inf counts stay machine-checkable integers
+        v = rec.get(key)
+        if v is not None and not isinstance(v, (int, float)):
+            problems.append(f"'{key}' not numeric: {v!r}")
     return problems
 
 
@@ -150,12 +217,15 @@ def spans_to_trace_events(spans, default_rank=0):
     for sp in spans:
         rank = int(sp.get("rank", default_rank))
         ranks.add(rank)
-        events.append({
+        ev = {
             "name": sp["name"], "ph": "X",
             "pid": rank, "tid": int(sp.get("tid", 0)),
             "ts": float(sp["t0"]) * 1e6, "dur": float(sp["dur"]) * 1e6,
             "cat": sp.get("cat", "host"),
-        })
+        }
+        if sp.get("args"):
+            ev["args"] = sp["args"]
+        events.append(ev)
     meta = [{"name": "process_name", "ph": "M", "pid": r,
              "args": {"name": f"rank {r}"}} for r in sorted(ranks)]
     return meta + events
@@ -171,12 +241,20 @@ def export_chrome_tracing(path, sources, align_on=None):
     whose start is declared t=0 per rank (the `__sync__`-marker recipe
     from tools/merge_profiles.py).
 
+    Spans still OPEN at export time (a stuck collective, an aborted
+    step) are closed at 'now' and tagged args={'open': True} rather
+    than dropped — an export made from a crash handler must show what
+    the program was inside, not pretend it was idle.
+
     Returns the number of spans written. Output loads in chrome://tracing
     or Perfetto.
     """
     all_spans = []
     for i, src in enumerate(sources):
         spans = getattr(src, "spans", src)
+        open_fn = getattr(src, "open_span_dicts", None)
+        if open_fn is not None:
+            spans = list(spans) + list(open_fn())
         rank = getattr(src, "rank", None)
         for sp in spans:
             sp = dict(sp)

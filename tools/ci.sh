@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] native build =="
+echo "== [1/6] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +37,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/5] api-surface audit =="
+echo "== [2/6] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/5] graph doctor + framework lint =="
+echo "== [3/6] graph doctor + framework lint =="
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -56,12 +56,30 @@ JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
     --report /tmp/graphdoctor_ci.json
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 
-echo "== [4/5] test suite =="
+echo "== [4/6] training health gate =="
+# the health monitor's offline analyzer (tools/healthwatch.py) replays
+# the SAME anomaly rules the in-flight monitor runs:
+#   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
+#      back clean — a recorded phase error or non-finite metric fails
+#      the build;
+#   b) the checked-in broken specimen must trip EVERY anomaly family
+#      (NaN step, loss spike, grad explosion, step-time regression) —
+#      proof the watcher can still see what it gates on (the
+#      graphdoctor selfcheck pattern).
+rm -f /tmp/bench_health_ci.jsonl   # the sink appends; stale phases lie
+JAX_PLATFORMS=cpu python bench.py --cpu \
+    --telemetry /tmp/bench_health_ci.jsonl > /tmp/bench_health_ci.json
+JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
+JAX_PLATFORMS=cpu python tools/healthwatch.py \
+    tools/specimens/health_anomalous.jsonl \
+    --expect nan,loss_spike,grad_explosion,step_time_regression
+
+echo "== [5/6] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [5/5] op benchmark gate =="
+echo "== [6/6] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Offline training-health analyzer: replay a metrics JSONL through the
+SAME anomaly rules the in-flight monitor runs (paddle_tpu.telemetry.
+health.AnomalyDetector) and exit nonzero on findings.
+
+The point of sharing the rule engine: what pages you in production is
+exactly what CI gates on. Two modes:
+
+    # gate mode (default): a clean run must stay clean
+    python tools/healthwatch.py bench_telemetry.jsonl run.jsonl
+
+    # selfcheck mode: a broken specimen must trip EVERY listed family —
+    # proof the rules can still see the defects they gate on (the
+    # graphdoctor selfcheck pattern)
+    python tools/healthwatch.py tools/specimens/health_anomalous.jsonl \
+        --expect nan,loss_spike,grad_explosion,step_time_regression
+
+Step records (kind=step) run the rolling-window rules (NaN/Inf, loss
+spike, grad explosion, step-time regression — compile steps exempt);
+phase records (kind=phase, bench.py output) are checked for recorded
+errors and non-finite metrics. Detector knobs (--window, --z-loss,
+--z-grad, --z-step-time, --min-points) mirror HealthConfig.
+
+Exit codes: 0 clean / all expected families fired; 5 findings in gate
+mode; 9 an expected family did NOT fire (the watcher itself is broken).
+Distinct from trace_check's 7 and graphdoctor's 8/9 family so CI logs
+disambiguate. Used by tools/ci.sh against the smoke-bench JSONL and the
+checked-in anomalous specimen.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def analyze_file(path, config):
+    """Replay one JSONL through a fresh detector. Returns (anomalies,
+    n_step, n_phase, problems)."""
+    from paddle_tpu.telemetry.health import AnomalyDetector
+    from paddle_tpu.telemetry.sink import read_jsonl
+
+    problems = []
+    try:
+        records = read_jsonl(path)
+    except (OSError, json.JSONDecodeError) as e:
+        return [], 0, 0, [f"{path}: unreadable: {e}"]
+    if not records:
+        # same stance as trace_check: a file nothing ever wrote must
+        # not green-light the run it claims to describe
+        return [], 0, 0, [f"{path}: no records — telemetry never wrote"]
+    det = AnomalyDetector(config)
+    n_step = n_phase = 0
+    for rec in records:
+        kind = rec.get("kind") if isinstance(rec, dict) else None
+        if kind == "phase":
+            n_phase += 1
+        elif kind == "step":
+            n_step += 1
+        else:
+            continue
+        det.observe(rec)
+    return det.anomalies, n_step, n_phase, problems
+
+
+def main(argv=None):
+    from paddle_tpu.telemetry.health import HealthConfig
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
+    ap.add_argument("--expect", default=None,
+                    help="comma-separated anomaly kinds that MUST fire "
+                         "(selfcheck mode): nan,loss_spike,"
+                         "grad_explosion,step_time_regression")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the findings report here")
+    ap.add_argument("--window", type=int, default=64)
+    ap.add_argument("--min-points", type=int, default=8)
+    ap.add_argument("--z-loss", type=float, default=8.0)
+    ap.add_argument("--z-grad", type=float, default=8.0)
+    ap.add_argument("--z-step-time", type=float, default=8.0)
+    args = ap.parse_args(argv)
+
+    config = HealthConfig(
+        action="record", window=args.window, min_points=args.min_points,
+        z_loss=args.z_loss, z_grad=args.z_grad,
+        z_step_time=args.z_step_time)
+
+    all_anoms, all_problems = [], []
+    per_file = {}
+    for path in args.paths:
+        anoms, n_step, n_phase, problems = analyze_file(path, config)
+        all_anoms += anoms
+        all_problems += problems
+        per_file[path] = {
+            "n_step_records": n_step, "n_phase_records": n_phase,
+            "anomalies": [a.to_dict() for a in anoms],
+            "problems": problems,
+        }
+        tag = f"{len(anoms)} finding(s)" if anoms else "clean"
+        print(f"healthwatch: {path}: {n_step} step + {n_phase} phase "
+              f"record(s), {tag}")
+        for a in anoms:
+            print(f"  [{a.kind}] {a.message}")
+        for p in problems:
+            print(f"  [invalid] {p}")
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"tool": "healthwatch", "files": per_file},
+                      f, indent=2, sort_keys=True)
+        print(f"report: {args.json_out}")
+
+    if args.expect is not None:
+        expected = {k.strip() for k in args.expect.split(",") if k.strip()}
+        fired = {a.kind for a in all_anoms}
+        missing = sorted(expected - fired)
+        if missing:
+            print(f"SELFCHECK FAILED: expected anomaly families "
+                  f"{missing} did not fire on the specimen", file=sys.stderr)
+            return 9
+        print(f"selfcheck OK: all {len(expected)} expected families "
+              f"fired ({sorted(expected)})")
+        return 0
+
+    if all_problems:
+        return 5
+    if all_anoms:
+        kinds = sorted({a.kind for a in all_anoms})
+        print(f"healthwatch: {len(all_anoms)} anomaly(ies) across "
+              f"{len(args.paths)} file(s): {kinds}", file=sys.stderr)
+        return 5
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
