@@ -179,23 +179,30 @@ def main():
         tsink.write(telemetry.make_phase_record(name, result))
         return result
 
-    resnet = phase(bench_resnet50, on_tpu, peak,
-                   images_per_sec=0.0, mfu=0.0,
-                   pipelined_images_per_sec=0.0,
-                   loader_images_per_sec=0.0)
-    layer13 = phase(bench_gpt1_3b_layer, on_tpu, peak,
-                    tokens_per_sec=0.0, mfu=0.0)
-    full13 = phase(bench_gpt1_3b_full, on_tpu, peak,
-                   tokens_per_sec=0.0, mfu=0.0, n_params=0)
-    full13_4k = phase(lambda t, p: bench_gpt1_3b_full(t, p, seq_len=4096),
-                      on_tpu, peak, tokens_per_sec=0.0, mfu=0.0, n_params=0)
-    decode = phase(bench_decode_wo8, on_tpu,
-                   bf16_tokens_per_sec=0.0, wo8_tokens_per_sec=0.0,
-                   speedup=0.0)
-    bert = phase(bench_bert, on_tpu, tokens_per_sec=0.0)
-    attn16k = phase(bench_attn_16k, on_tpu, fwd_ms=0.0, bwd_ms=0.0,
-                    ms=0.0, tflops=0.0, d64_fwd_ms=0.0, d64_bwd_ms=0.0,
-                    d64_ms=0.0, d64_tflops=0.0)
+    # the compile observatory shares the phase sink: every TrainStep
+    # (re)compile in the phases below lands in the same JSONL with its
+    # cause diff + HBM/cost analysis, and tools/compile_report.py gates
+    # the file in CI (a clean bench must have no retrace storm)
+    with telemetry.CompileObservatory(sink=tsink, action="record"):
+        resnet = phase(bench_resnet50, on_tpu, peak,
+                       images_per_sec=0.0, mfu=0.0,
+                       pipelined_images_per_sec=0.0,
+                       loader_images_per_sec=0.0)
+        layer13 = phase(bench_gpt1_3b_layer, on_tpu, peak,
+                        tokens_per_sec=0.0, mfu=0.0)
+        full13 = phase(bench_gpt1_3b_full, on_tpu, peak,
+                       tokens_per_sec=0.0, mfu=0.0, n_params=0)
+        full13_4k = phase(lambda t, p: bench_gpt1_3b_full(t, p,
+                                                          seq_len=4096),
+                          on_tpu, peak, tokens_per_sec=0.0, mfu=0.0,
+                          n_params=0)
+        decode = phase(bench_decode_wo8, on_tpu,
+                       bf16_tokens_per_sec=0.0, wo8_tokens_per_sec=0.0,
+                       speedup=0.0)
+        bert = phase(bench_bert, on_tpu, tokens_per_sec=0.0)
+        attn16k = phase(bench_attn_16k, on_tpu, fwd_ms=0.0, bwd_ms=0.0,
+                        ms=0.0, tflops=0.0, d64_fwd_ms=0.0,
+                        d64_bwd_ms=0.0, d64_ms=0.0, d64_tflops=0.0)
     for name, result in (("resnet50", resnet), ("gpt1_3b_layer", layer13),
                          ("gpt1_3b_full", full13),
                          ("gpt1_3b_full_4k", full13_4k),

@@ -218,3 +218,33 @@ def project_hbm(model_or_named, mesh, zero_stage=0, optimizer_slots=2,
                 suggestion="raise zero_stage, enable offload, or grow "
                            "the mesh"))
     return report, findings
+
+
+def project_train_step_hbm(step, mesh=None, optimizer_slots=2,
+                           hbm_bytes=None):
+    """`project_hbm` over a live trainer (jit.TrainStep /
+    distributed.ShardedTrainStep: anything carrying `param_names` /
+    `params`, and optionally `mesh` / `zero_stage`). This is the
+    projection the compile observatory cross-checks against the
+    executable's measured `memory_analysis()` — the SH206 pre-flight
+    number versus what XLA actually allocated. Returns (report,
+    findings) like project_hbm; mesh falls back to the step's, then the
+    process mesh."""
+    if mesh is None:
+        mesh = getattr(step, "mesh", None)
+    if mesh is None:
+        from ..distributed import env
+        mesh = env.current_mesh()
+    if mesh is None:
+        # no mesh (plain single-program TrainStep): a trivial 1-device
+        # mesh makes every fraction 1 — the projection is then simply
+        # params + grads + optimizer slots, which is what one device
+        # must hold
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    named = list(zip(step.param_names, step.params))
+    return project_hbm(named, mesh,
+                       zero_stage=getattr(step, "zero_stage", 0),
+                       optimizer_slots=optimizer_slots,
+                       hbm_bytes=hbm_bytes)

@@ -27,6 +27,31 @@ def _safe_cost_analysis(compiled):
         return {}
 
 
+def profile_hlo_text(hlo, top_k=20):
+    """Per-opcode breakdown of an optimized-HLO text dump: count
+    instructions by opcode (fusions appear as 'fusion' — XLA's own unit
+    of scheduling), skipping pure plumbing. The parsing half of
+    `ProgramCostModel.instruction_profile`, split out so callers that
+    already hold a compiled executable (telemetry.compile_obs) can
+    profile `compiled.as_text()` without recompiling."""
+    import collections
+    import re
+
+    counts = collections.Counter()
+    for m in re.finditer(
+            r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{}_,:\s/]*?"
+            r"\b([a-z][\w\-]*)\(", hlo, re.M):
+        op = m.group(1)
+        if op in ("parameter", "constant", "tuple", "get-tuple-element",
+                  "bitcast"):
+            continue
+        counts[op] += 1
+    total = sum(counts.values())
+    table = [{"op": op, "count": n, "share": round(n / total, 6)}
+             for op, n in counts.most_common(top_k)]
+    return {"n_instructions": total, "by_op": table}
+
+
 class CostModel:
     """Profile a jittable function (or hapi Model-style Layer forward).
 
@@ -92,25 +117,7 @@ class ProgramCostModel(CostModel):
     instructions XLA fused away."""
 
     def instruction_profile(self, fn, example_args, top_k=20):
-        import collections
-        import re
-
         import jax
 
         compiled = jax.jit(fn).lower(*example_args).compile()
-        hlo = compiled.as_text()
-        # count optimized-HLO instructions by opcode (fusions appear as
-        # 'fusion' — XLA's own unit of scheduling)
-        counts = collections.Counter()
-        for m in re.finditer(
-                r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{}_,:\s/]*?"
-                r"\b([a-z][\w\-]*)\(", hlo, re.M):
-            op = m.group(1)
-            if op in ("parameter", "constant", "tuple", "get-tuple-element",
-                      "bitcast"):
-                continue
-            counts[op] += 1
-        total = sum(counts.values())
-        table = [{"op": op, "count": n, "share": n / total}
-                 for op, n in counts.most_common(top_k)]
-        return {"n_instructions": total, "by_op": table}
+        return profile_hlo_text(compiled.as_text(), top_k=top_k)

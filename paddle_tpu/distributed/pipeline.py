@@ -1149,13 +1149,22 @@ class PipelineParallel(Layer):
             front_vals = [p._value for p in plan["front_params"]]
             tail_vals = [p._value for p in plan["tail_params"]]
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-            self._maybe_lint_pipeline(
-                (front_vals, cache["vals"], list(cache["states"]),
-                 tail_vals, xv, yv, lr, rng), mesh)
+            step_args = (front_vals, cache["vals"], list(cache["states"]),
+                         tail_vals, xv, yv, lr, rng)
+            self._maybe_lint_pipeline(step_args, mesh)
+            # compile observatory (see jit.TrainStep._run_step): a
+            # context-active observatory records each 1F1B (re)compile
+            # with its cause diff + memory/cost analysis
+            from ..telemetry import compile_obs
             with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
-                loss, gfront, gtail, new_vals, new_states = self._pipe_step(
-                    front_vals, cache["vals"], list(cache["states"]),
-                    tail_vals, xv, yv, lr, rng)
+                (loss, gfront, gtail, new_vals,
+                 new_states) = compile_obs.dispatch(
+                    "PipelineParallel.train_batch", self._pipe_step,
+                    step_args,
+                    arg_names=("front", "blocks", "block_states",
+                               "tail", "x", "y", "lr", "rng"),
+                    static={"n_micro": n_micro, "fused": True},
+                    donate=(1, 2))
             cache["vals"] = new_vals
             cache["states"] = new_states
             self._scatter_block_views(plan, optimizer, cache)
@@ -1188,11 +1197,15 @@ class PipelineParallel(Layer):
                 jax.device_put(jnp.stack([r[j]._value for r in rows]),
                                _stacked_sharding(tp, mesh))
                 for j, tp in enumerate(plan["template_params"])]
-        self._maybe_lint_pipeline(
-            (front_vals, stack_vals, tail_vals, xv, yv, rng), mesh)
+        step_args = (front_vals, stack_vals, tail_vals, xv, yv, rng)
+        self._maybe_lint_pipeline(step_args, mesh)
+        from ..telemetry import compile_obs
         with telemetry.span("pipeline.1f1b_dispatch", cat="pipeline"):
-            loss, gfront, gstack, gtail = self._pipe_step(
-                front_vals, stack_vals, tail_vals, xv, yv, rng)
+            loss, gfront, gstack, gtail = compile_obs.dispatch(
+                "PipelineParallel.train_batch", self._pipe_step,
+                step_args,
+                arg_names=("front", "blocks", "tail", "x", "y", "rng"),
+                static={"n_micro": n_micro, "fused": False})
         for p, g in zip(plan["front_params"], gfront):
             add(p, g)
         for i, row in enumerate(rows):

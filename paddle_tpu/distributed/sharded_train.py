@@ -293,10 +293,21 @@ class ShardedTrainStep:
                     for dsh, st in zip(self._dev_state_sh, opt_states)]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
+        # compile observatory (see jit.TrainStep._run_step): records
+        # every pjit (re)compile with cause diff + memory/cost analysis
+        from ..telemetry import compile_obs
         with telemetry.span("sharded.step_dispatch", cat="dispatch"):
             (loss, new_vals, new_states, new_buf, checks,
-             hstats) = self._jitted(
-                param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+             hstats) = compile_obs.dispatch(
+                f"{type(self).__name__}[{type(self.model).__name__}]",
+                self._jitted,
+                (param_vals, opt_states, buffer_vals, lr, rng, batch_vals),
+                arg_names=("params", "opt_states", "buffers", "lr",
+                           "rng", "batch"),
+                static={"check_nan_inf": check, "health_taps": taps,
+                        "zero_stage": self.zero_stage,
+                        "offload": self.offload},
+                donate=(0, 1, 2) if self._donate else ())
         self._last_health = hstats
         if self.offload:
             # async D2H: evict the updated states back to pinned_host so

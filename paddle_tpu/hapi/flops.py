@@ -106,8 +106,9 @@ def flops_compiled(net_or_fn, input_spec, backprop=False, net=None):
     else:
         fn = fwd
     comp = jax.jit(fn).lower(param_vals, *examples).compile()
-    ca = comp.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0]
+    # cost_analysis() raises on some backends (e.g. the axon plugin);
+    # degrade to zeros like every other caller rather than failing
+    from ..cost_model import _safe_cost_analysis
+    ca = _safe_cost_analysis(comp)
     return {"flops": float(ca.get("flops", 0.0)),
             "bytes_accessed": float(ca.get("bytes accessed", 0.0))}

@@ -373,8 +373,24 @@ class TrainStep:
         buffer_vals = [b._value for b in self.buffers]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = default_generator().split()
-        loss, new_vals, new_states, new_buf, checks, hstats = self._jitted(
-            param_vals, opt_states, buffer_vals, lr, rng, batch_vals)
+        # compile observatory: while one is context-active, dispatch
+        # goes through its signature-keyed AOT cache, so every
+        # (re)compile is recorded with a cause diff + memory/cost
+        # analysis; inert (one stack peek) otherwise. The family
+        # carries the model class: two TrainSteps over different
+        # models are different programs, not recompiles.
+        from ..telemetry import compile_obs
+        loss, new_vals, new_states, new_buf, checks, hstats = \
+            compile_obs.dispatch(
+                f"{type(self).__name__}[{type(self.model).__name__}]",
+                self._jitted,
+                (param_vals, opt_states, buffer_vals, lr, rng, batch_vals),
+                arg_names=("params", "opt_states", "buffers", "lr", "rng",
+                           "batch"),
+                static={"check_nan_inf": check, "amp": st.enabled,
+                        "amp_dtype": str(st.dtype) if st.enabled else "",
+                        "health_taps": taps},
+                donate=(0, 1, 2) if self._donate else ())
         self._last_health = hstats
         # reassign state FIRST: the inputs were donated, so the tensors must
         # point at the fresh buffers even when the finite check fires (the

@@ -26,7 +26,13 @@ Entry points:
   — stall detection with black-box dumps; metrics_http.MetricsServer —
   live /healthz, /metrics (Prometheus), /steps scrape endpoint;
   tools/healthwatch.py replays the same anomaly rules offline.
+- compile_obs.CompileObservatory — the compile observatory: context-
+  activate it and every train-step (re)compile is recorded with a
+  cause diff, compiled-HBM breakdown (`memory_analysis()`), cost-model
+  cross-checks and a recompile-storm rule; tools/compile_report.py
+  renders/replays the JSONL offline.
 """
+from . import compile_obs  # noqa: F401
 from . import health  # noqa: F401
 from . import metrics_http  # noqa: F401
 from . import mfu  # noqa: F401
@@ -34,6 +40,10 @@ from . import sink  # noqa: F401
 from . import watchdog  # noqa: F401
 from .health import (  # noqa: F401
     Anomaly, AnomalyDetector, HealthConfig, HealthError, HealthMonitor)
+from .compile_obs import (  # noqa: F401
+    CompileObservatory, CompileSignature, RecompileTracker,
+    current_observatory, diff_signatures, signature_of)
+from .compile_obs import dispatch as observed_dispatch  # noqa: F401
 from .metrics_http import MetricsServer  # noqa: F401
 from .mfu import (  # noqa: F401
     device_peak_flops, model_flops_per_token, train_step_flops)
@@ -53,5 +63,8 @@ __all__ = [
     "device_peak_flops", "model_flops_per_token", "train_step_flops",
     "HealthConfig", "HealthMonitor", "HealthError", "Anomaly",
     "AnomalyDetector", "HangWatchdog", "dump_black_box", "MetricsServer",
-    "mfu", "sink", "health", "watchdog", "metrics_http",
+    "CompileObservatory", "CompileSignature", "RecompileTracker",
+    "current_observatory", "diff_signatures", "signature_of",
+    "observed_dispatch",
+    "mfu", "sink", "health", "watchdog", "metrics_http", "compile_obs",
 ]

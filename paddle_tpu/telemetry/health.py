@@ -82,6 +82,16 @@ class HealthConfig:
     z_step_time       z threshold for the step-time regression rule
     rel_step_time     AND-guard: step time must also exceed this multiple
                       of the window median (kills micro-jitter flags)
+    storm_compiles    recompile-storm rule: this many RECOMPILES (compile
+                      records with n_compiles > 1) ...
+    storm_window_steps ... within this many steps fire `recompile_storm`
+    hbm_drift_tol     relative drift between a compile record's measured
+                      hbm.total_bytes and its hbm_projected_bytes (the
+                      sharding_lint SH206 projection) that fires
+                      `hbm_projection_drift`
+    flops_drift_tol   relative drift between a compile record's
+                      cost.flops and its analytic_flops (the peak-FLOPs
+                      table MFU claims ride on) that fires `flops_drift`
     hang_deadline_s   arm a HangWatchdog with this deadline (None: off)
     dump_dir          where black-box dumps go ('.' default)
     dump_on_exception fire the black-box dump when an exception escapes
@@ -91,7 +101,9 @@ class HealthConfig:
 
     def __init__(self, every_k=8, action="warn", window=64, min_points=8,
                  z_loss=8.0, z_grad=8.0, z_step_time=8.0,
-                 rel_step_time=1.5, hang_deadline_s=None, dump_dir=".",
+                 rel_step_time=1.5, storm_compiles=5, storm_window_steps=32,
+                 hbm_drift_tol=0.15, flops_drift_tol=0.25,
+                 hang_deadline_s=None, dump_dir=".",
                  dump_on_exception=True, ring_size=64):
         if action not in _ACTIONS:
             raise ValueError(f"health action must be one of {_ACTIONS}, "
@@ -106,6 +118,10 @@ class HealthConfig:
         self.z_grad = float(z_grad)
         self.z_step_time = float(z_step_time)
         self.rel_step_time = float(rel_step_time)
+        self.storm_compiles = int(storm_compiles)
+        self.storm_window_steps = int(storm_window_steps)
+        self.hbm_drift_tol = float(hbm_drift_tol)
+        self.flops_drift_tol = float(flops_drift_tol)
         self.hang_deadline_s = hang_deadline_s
         self.dump_dir = dump_dir
         self.dump_on_exception = bool(dump_on_exception)
@@ -196,6 +212,18 @@ class AnomalyDetector:
                            legitimately slow) and never enter the window
     - phase_error          a bench phase record carrying an 'error' key
                            or a non-finite metric value
+    - recompile_storm      compile records (kind='compile',
+                           telemetry.compile_obs): storm_compiles
+                           RECOMPILES (n_compiles > 1 — first compiles
+                           of distinct programs are legitimate) within
+                           storm_window_steps steps
+    - hbm_projection_drift a compile record whose measured
+                           hbm.total_bytes drifts more than
+                           hbm_drift_tol from its hbm_projected_bytes
+                           (the sharding_lint SH206 static projection)
+    - flops_drift          a compile record whose cost.flops drifts more
+                           than flops_drift_tol from its analytic_flops
+                           (the MFU peak-FLOPs accounting)
 
     Clean values enter their windows AFTER judgment, so a spike does not
     vaccinate the window against itself; anomalous values are excluded
@@ -208,6 +236,9 @@ class AnomalyDetector:
         self._loss = _Window(c.window)
         self._grad = _Window(c.window)
         self._step_t = _Window(c.window)
+        self._recompiles = {}         # fn -> deque of (step, cause)
+        self._storm_muzzle = {}       # fn -> muzzled-until step
+        self._drift_latched = set()   # (kind, fn) already flagged
         self.anomalies = []
         self._n = 0
 
@@ -239,6 +270,10 @@ class AnomalyDetector:
         rec = record or {}
         if rec.get("kind") == "phase":
             found = self._observe_phase(rec)
+            self.anomalies.extend(found)
+            return found
+        if rec.get("kind") == "compile":
+            found = self._observe_compile(rec)
             self.anomalies.extend(found)
             return found
         step = rec.get("step", self._n - 1)
@@ -304,6 +339,82 @@ class AnomalyDetector:
             found.append(Anomaly(
                 "phase_error", name, None,
                 f"phase {name!r} carries non-finite metric(s): {bad}"))
+        return found
+
+    def _observe_compile(self, rec):
+        """Rules over one compile-event record (telemetry.compile_obs):
+        the storm window plus the two static-vs-compiled cross-checks.
+        The record carries everything the rules need (measured AND
+        projected/analytic values), so the same pass runs in-flight and
+        in offline replays (tools/compile_report.py)."""
+        c = self.config
+        found = []
+        step = rec.get("step", self._n - 1)
+        fn = rec.get("fn", "?")
+
+        # recompile storm: only RECOMPILES count — the first compile of
+        # each distinct program (and untracked jax-stream events, which
+        # cannot tell first from Nth) is legitimate work, not thrash.
+        # Windows and muzzles are PER FAMILY: a planned bump that
+        # recompiles several distinct programs at once is not a storm,
+        # and one family's storm must not silence another's.
+        if not rec.get("untracked") and rec.get("n_compiles", 1) > 1:
+            win = self._recompiles.get(fn)
+            if win is None:
+                win = self._recompiles[fn] = collections.deque(
+                    maxlen=c.storm_compiles)
+            win.append((step, rec.get("cause")))
+            span = step - win[0][0]
+            muzzled = step <= self._storm_muzzle.get(fn, -1)
+            if (len(win) >= c.storm_compiles
+                    and span <= c.storm_window_steps and not muzzled):
+                causes = [cc for _, cause in win for cc in (cause or [])]
+                hint = f"; last cause: {causes[-1]}" if causes else ""
+                found.append(Anomaly(
+                    "recompile_storm", step, float(len(win)),
+                    f"{fn}: {len(win)} recompiles within "
+                    f"{span} step(s) (threshold {c.storm_compiles} in "
+                    f"{c.storm_window_steps}){hint}",
+                    expected=c.storm_compiles))
+                self._storm_muzzle[fn] = step + c.storm_window_steps
+
+        # drift rules are LATCHED per family: a drifting program fires
+        # once (it recompiles many times in a storm — one page, not N),
+        # and re-arms only after a compile comes back inside tolerance
+        hbm = rec.get("hbm") or {}
+        actual = hbm.get("total_bytes")
+        projected = rec.get("hbm_projected_bytes")
+        if actual and projected:
+            drift = (float(actual) - float(projected)) / float(projected)
+            if abs(drift) <= c.hbm_drift_tol:
+                self._drift_latched.discard(("hbm_projection_drift", fn))
+            elif ("hbm_projection_drift", fn) not in self._drift_latched:
+                self._drift_latched.add(("hbm_projection_drift", fn))
+                found.append(Anomaly(
+                    "hbm_projection_drift", step, float(actual),
+                    f"{fn}: compiled HBM {actual / 1e6:.2f} MB is "
+                    f"{drift * 100:+.0f}% off the static projection "
+                    f"{projected / 1e6:.2f} MB (tolerance "
+                    f"{c.hbm_drift_tol * 100:.0f}%) — the SH206 "
+                    "pre-flight budget no longer describes this program",
+                    expected=projected, z=round(drift, 3)))
+
+        compiled_flops = (rec.get("cost") or {}).get("flops")
+        analytic = rec.get("analytic_flops")
+        from .mfu import flops_drift
+        drift = flops_drift(compiled_flops, analytic)
+        if drift is not None:
+            if abs(drift) <= c.flops_drift_tol:
+                self._drift_latched.discard(("flops_drift", fn))
+            elif ("flops_drift", fn) not in self._drift_latched:
+                self._drift_latched.add(("flops_drift", fn))
+                found.append(Anomaly(
+                    "flops_drift", step, float(compiled_flops),
+                    f"{fn}: compiled FLOPs {float(compiled_flops):.3e} "
+                    f"drift {drift * 100:+.0f}% from the analytic "
+                    f"{float(analytic):.3e} the MFU accounting assumes "
+                    f"(tolerance {c.flops_drift_tol * 100:.0f}%)",
+                    expected=analytic, z=round(drift, 3)))
         return found
 
     def kinds(self):

@@ -162,6 +162,11 @@ class MetricsServer:
             "anomalies": snap.get("health.anomalies", 0),
             "nan_steps": snap.get("health.nan_steps", 0),
             "watchdog_fires": snap.get("health.watchdog_fires", 0),
+            # compile observatory (telemetry.compile_obs): a probe can
+            # spot a retrace storm without parsing the JSONL
+            "compiles": snap.get("compile.count", 0),
+            "recompiles": snap.get("compile.recompiles", 0),
+            "compile_storms": snap.get("compile.storms", 0),
         }
         h = self.health
         if h is not None:

@@ -59,6 +59,23 @@ def mfu(flops_per_step, step_time_s, peak_flops=None, n_devices=1):
         / (float(peak_flops) * max(1, int(n_devices)))
 
 
+def flops_drift(compiled_flops, analytic_flops):
+    """Relative drift of the compiled program's cost-analysis FLOPs from
+    the analytic number the MFU accounting multiplies by: (compiled -
+    analytic) / analytic. MFU reports analytic_flops / (time * peak), so
+    positive drift = the analytic table UNDERCOUNTS and the reported MFU
+    UNDERSTATES real utilization; negative drift = the table overcounts
+    and the reported MFU is inflated. None when either side is
+    missing/zero (no cross-check possible)."""
+    try:
+        c, a = float(compiled_flops), float(analytic_flops)
+    except (TypeError, ValueError):
+        return None
+    if c <= 0 or a <= 0:
+        return None
+    return (c - a) / a
+
+
 def train_step_flops(loss_fn, example_batch, model=None):
     """EXACT per-step FLOPs: lower loss_fn through XLA with backprop (the
     `hapi.flops.flops_compiled` feedback loop — fusion and the dL/dW

@@ -221,16 +221,30 @@ class StepTimer:
             for x in leaves)
 
     def __call__(self, *args):
+        from . import compile_obs
         key = self._key(args)
         compiled = self._cache.get(key)
+        obs = compile_obs.current_observatory()
         if compiled is None:
-            t0 = time.perf_counter()
-            compiled = jax.jit(self._fn).lower(*args).compile()
-            self.last_compile_ms = (time.perf_counter() - t0) * 1000.0
+            with (obs.compiling() if obs is not None
+                  else contextlib.nullcontext()):
+                t0 = time.perf_counter()
+                compiled = jax.jit(self._fn).lower(*args).compile()
+                self.last_compile_ms = (time.perf_counter() - t0) * 1000.0
             self._cache[key] = compiled
             self._last_compiled = compiled
             self.cache_misses += 1
             monitor.incr("telemetry.aot_cache_misses")
+            if obs is not None:
+                # attribute this compile to the observatory's ledger
+                # (cause diffs, memory/cost, storm rule) instead of the
+                # unattributed jax-event stream; the timer's own call
+                # count is the step clock for its records
+                obs.observe(
+                    f"StepTimer:{getattr(self._fn, '__name__', 'fn')}",
+                    compile_obs.signature_of(args), self.last_compile_ms,
+                    compiled=compiled,
+                    step=self.cache_hits + self.cache_misses - 1)
         else:
             self.last_compile_ms = 0.0
             self.cache_hits += 1
@@ -240,9 +254,18 @@ class StepTimer:
         jax.block_until_ready(out)
         self.last_execute_ms = (time.perf_counter() - t0) * 1000.0
         if self.recorder is not None:
+            extra = {}
+            mem = self.memory_analysis_dict()
+            if mem is not None:
+                # last-compiled HBM breakdown rides the step record, so
+                # AOT-cache behaviour is visible in the JSONL, not just
+                # in in-process counters
+                extra["hbm"] = mem
             self.recorder.record_external_step(
                 step_ms=self.last_compile_ms + self.last_execute_ms,
-                compile_ms=self.last_compile_ms)
+                compile_ms=self.last_compile_ms,
+                cache_hits=self.cache_hits,
+                cache_misses=self.cache_misses, **extra)
         return out
 
     def memory_analysis(self):
@@ -254,6 +277,14 @@ class StepTimer:
             return self._last_compiled.memory_analysis()
         except Exception:
             return None
+
+    def memory_analysis_dict(self):
+        """Same, flattened to plain byte counts (the form the step
+        record and compile observatory carry), None when unavailable."""
+        if self._last_compiled is None:
+            return None
+        from .compile_obs import memory_analysis_dict
+        return memory_analysis_dict(self._last_compiled)
 
 
 class TelemetryRecorder:
@@ -383,12 +414,17 @@ class TelemetryRecorder:
         mem_bytes = self._live_bytes() if self.track_memory else None
         coll = self._collect_collectives(win.span_start)
 
+        # an external step source (StepTimer) reports its OWN AOT cache
+        # counters; they override the recorder's listener-derived ones
+        extra = dict(win.extra)
+        cache_hits = extra.pop("cache_hits", self.cache_hits)
+        cache_misses = extra.pop("cache_misses", self.cache_misses)
         rec = make_step_record(
             step=self._step_idx, step_ms=step_s * 1000.0,
             compile_ms=compile_ms, rank=self.rank, loss=loss_val,
             tokens_per_sec=tokens_per_sec, mfu=mfu_val, mem_bytes=mem_bytes,
-            cache_hits=self.cache_hits, cache_misses=self.cache_misses,
-            collectives=coll, **win.extra)
+            cache_hits=cache_hits, cache_misses=cache_misses,
+            collectives=coll, **extra)
         # the whole step is also a span, so the JSONL ledger and the
         # chrome trace describe the same intervals
         self.add_span(f"step {self._step_idx}", win.t0, step_s, cat="step")

@@ -41,6 +41,13 @@ STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
 # step lands them — they appear every k-th record when taps are on)
 HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
 
+# required keys of a compile-event record (telemetry.compile_obs); the
+# optional attachments are hbm (memory_analysis breakdown), cost
+# (XLA cost analysis), hlo_ops (top-K opcode table), cause (recompile
+# diff strings), signature, hbm_projected_bytes, analytic_flops
+COMPILE_RECORD_KEYS = ("schema", "kind", "rank", "fn", "step",
+                      "compile_ms", "n_compiles")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -85,6 +92,52 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
             str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
             if isinstance(v, (tuple, list)) else v
             for k, v in collectives.items()}
+    if extra:
+        rec["extra"] = extra
+    return rec
+
+
+def make_compile_record(fn, step, compile_ms, rank=0, n_compiles=1,
+                        backend=None, cause=None, signature=None,
+                        hbm=None, cost=None, hlo_ops=None,
+                        hbm_projected_bytes=None, analytic_flops=None,
+                        untracked=False, **extra):
+    """One trace/compile event as a first-class record (kind='compile').
+
+    `cause` is the recompile diff (list of human-readable strings) —
+    None/absent on the FIRST compile of a signature family, required on
+    every later one (tools/trace_check.py enforces this). `untracked`
+    marks compiles seen only through the jax.monitoring event stream
+    (no signature, so no cause is derivable)."""
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "compile",
+        "rank": int(rank),
+        "fn": str(fn),
+        "step": int(step),
+        "compile_ms": round(float(compile_ms), 4),
+        "n_compiles": int(n_compiles),
+    }
+    if backend is not None:
+        rec["backend"] = str(backend)
+    if cause:
+        rec["cause"] = [str(c) for c in cause]
+    if signature is not None:
+        rec["signature"] = signature
+    if hbm:
+        rec["hbm"] = {k: int(v) for k, v in hbm.items()
+                      if isinstance(v, (int, float))}
+    if cost:
+        rec["cost"] = {k: float(v) for k, v in cost.items()
+                       if isinstance(v, (int, float))}
+    if hlo_ops:
+        rec["hlo_ops"] = hlo_ops
+    if hbm_projected_bytes is not None:
+        rec["hbm_projected_bytes"] = int(hbm_projected_bytes)
+    if analytic_flops is not None:
+        rec["analytic_flops"] = float(analytic_flops)
+    if untracked:
+        rec["untracked"] = True
     if extra:
         rec["extra"] = extra
     return rec
@@ -180,6 +233,21 @@ def validate_step_record(rec):
         for key in ("schema", "phase", "metrics"):
             if key not in rec:
                 problems.append(f"phase record missing '{key}'")
+        return problems
+    if kind == "compile":
+        for key in COMPILE_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"compile record missing '{key}'")
+        v = rec.get("compile_ms")
+        if v is not None and (not isinstance(v, (int, float)) or v < 0):
+            problems.append(f"'compile_ms' not a non-negative number: {v!r}")
+        n = rec.get("n_compiles")
+        if n is not None and (not isinstance(n, int) or n < 1):
+            problems.append(f"'n_compiles' not a positive int: {n!r}")
+        cause = rec.get("cause")
+        if cause is not None and (not isinstance(cause, list) or
+                                  not all(isinstance(c, str) for c in cause)):
+            problems.append(f"'cause' not a list of strings: {cause!r}")
         return problems
     for key in STEP_RECORD_KEYS:
         if key not in rec:

@@ -56,7 +56,7 @@ JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
     --report /tmp/graphdoctor_ci.json
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 
-echo "== [4/6] training health gate =="
+echo "== [4/6] training health + compile observatory gate =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
@@ -73,6 +73,15 @@ JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \
     --expect nan,loss_spike,grad_explosion,step_time_regression
+# compile observatory (tools/compile_report.py), same two-sided gate:
+#   a) the smoke-bench compile log (bench.py phases run under a
+#      CompileObservatory sharing the telemetry sink) must come back
+#      clean — a retrace storm or a cause-less recompile fails;
+#   b) the checked-in thrash specimen must trip the storm rule AND the
+#      causes must name the thrashing argument.
+JAX_PLATFORMS=cpu python tools/compile_report.py /tmp/bench_health_ci.jsonl
+JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
+    tools/specimens/compile_thrash.jsonl --expect-arg batch
 
 echo "== [5/6] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):

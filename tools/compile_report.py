@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Offline compile-observatory report: replay a metrics JSONL through
+the SAME rules the in-flight observatory runs (paddle_tpu.telemetry —
+recompile storm, HBM-projection drift, FLOPs drift) and render what the
+compiler did to the run: recompile causes, compiled-HBM breakdown,
+roofline position, top-K optimized-HLO ops.
+
+    # gate mode (default): the file must carry at least one compile
+    # record (a dead observatory must not green-light), no storms or
+    # drift, and every recompile must carry its cause
+    python tools/compile_report.py bench_telemetry.jsonl
+
+    # selfcheck mode: the planted thrash specimen must trip the storm
+    # rule AND name the changing argument (the graphdoctor/healthwatch
+    # selfcheck pattern — proof the watcher still sees what it gates on)
+    python tools/compile_report.py --selfcheck \
+        tools/specimens/compile_thrash.jsonl --expect-arg batch
+
+Exit codes: 0 clean / selfcheck passed; 6 findings in gate mode
+(storm, drift, or invalid compile records); 9 selfcheck miss. Distinct
+from trace_check's 7, healthwatch's 5/9-on-health, and graphdoctor's
+8/9 family so CI logs disambiguate. Used by tools/ci.sh against the
+smoke-bench JSONL and the checked-in thrash specimen.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def analyze(records, config):
+    """Replay compile records through the detector + the trace_check
+    structural rules. Returns (anomalies, problems, compiles)."""
+    from paddle_tpu.telemetry.health import AnomalyDetector
+    from trace_check import check_compile_records
+
+    det = AnomalyDetector(config)
+    compiles = [r for r in records
+                if isinstance(r, dict) and r.get("kind") == "compile"]
+    for rec in compiles:
+        det.observe(rec)
+    problems = check_compile_records(records, "<records>")
+    return det.anomalies, problems, compiles
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def render(compiles, anomalies, problems, peak_flops=None, out=print):
+    """Human-readable report over the compile ledger."""
+    by_fam = {}
+    for rec in compiles:
+        by_fam.setdefault(rec.get("fn", "?"), []).append(rec)
+
+    out(f"== compile summary: {len(compiles)} compile event(s), "
+        f"{len(by_fam)} family(ies) ==")
+    for fam in sorted(by_fam):
+        recs = by_fam[fam]
+        total_ms = sum(r.get("compile_ms", 0.0) for r in recs)
+        recompiles = sum(1 for r in recs if r.get("n_compiles", 1) > 1)
+        out(f"  {fam}: {len(recs)} compile(s), {recompiles} recompile(s), "
+            f"{total_ms:.0f} ms total compile time")
+        for r in recs:
+            for cause in r.get("cause") or []:
+                out(f"    step {r.get('step')}: {cause}")
+
+    hbm_last = [(fam, recs[-1]) for fam, recs in sorted(by_fam.items())
+                if recs[-1].get("hbm")]
+    if hbm_last:
+        out("== compiled HBM (last executable per family) ==")
+        for fam, r in hbm_last:
+            h = r["hbm"]
+            line = (f"  {fam}: total {_fmt_bytes(h.get('total_bytes'))} "
+                    f"(args {_fmt_bytes(h.get('arg_bytes'))}, "
+                    f"temps {_fmt_bytes(h.get('temp_bytes'))}, "
+                    f"out {_fmt_bytes(h.get('out_bytes'))}, "
+                    f"code {_fmt_bytes(h.get('code_bytes'))})")
+            proj = r.get("hbm_projected_bytes")
+            if proj:
+                drift = (h.get("total_bytes", 0) - proj) / proj
+                line += (f"; SH206 projection {_fmt_bytes(proj)} "
+                         f"(drift {drift * 100:+.0f}%)")
+            out(line)
+
+    cost_last = [(fam, recs[-1]) for fam, recs in sorted(by_fam.items())
+                 if recs[-1].get("cost")]
+    if cost_last:
+        out("== roofline (XLA cost analysis, last executable) ==")
+        for fam, r in cost_last:
+            c = r["cost"]
+            flops, byts = c.get("flops", 0.0), c.get("bytes_accessed", 0.0)
+            ai = flops / byts if byts else 0.0
+            line = f"  {fam}: {flops:.3e} FLOPs, " \
+                   f"{_fmt_bytes(byts)} accessed, intensity {ai:.1f}"
+            if peak_flops:
+                # time lower bound at peak: the roofline's compute leg
+                line += f", >= {flops / peak_flops * 1e3:.2f} ms at peak"
+            af = r.get("analytic_flops")
+            if af:
+                line += (f"; analytic {af:.3e} "
+                         f"(drift {(flops - af) / af * 100:+.0f}%)")
+            out(line)
+
+    ops_last = [(fam, recs[-1]) for fam, recs in sorted(by_fam.items())
+                if recs[-1].get("hlo_ops")]
+    if ops_last:
+        out("== top optimized-HLO ops (last executable) ==")
+        for fam, r in ops_last:
+            row = ", ".join(f"{o['op']} x{o['count']} "
+                            f"({o['share'] * 100:.0f}%)"
+                            for o in r["hlo_ops"][:8])
+            out(f"  {fam}: {row}")
+
+    if anomalies:
+        out(f"== {len(anomalies)} finding(s) ==")
+        for a in anomalies:
+            out(f"  [{a.kind}] {a.message}")
+    if problems:
+        out(f"== {len(problems)} invalid record(s) ==")
+        for p in problems:
+            out(f"  [invalid] {p}")
+
+
+def main(argv=None):
+    from paddle_tpu.telemetry.health import HealthConfig
+    from paddle_tpu.telemetry.sink import read_jsonl
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="specimen mode: the recompile storm MUST fire "
+                         "and a cause MUST name the changing argument")
+    ap.add_argument("--expect-arg", default=None,
+                    help="selfcheck: argument name the causes must "
+                         "mention (e.g. 'batch')")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the findings report here")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="device peak FLOP/s for the roofline lines")
+    ap.add_argument("--storm-compiles", type=int, default=5)
+    ap.add_argument("--storm-window", type=int, default=32)
+    ap.add_argument("--hbm-drift-tol", type=float, default=0.15)
+    ap.add_argument("--flops-drift-tol", type=float, default=0.25)
+    args = ap.parse_args(argv)
+
+    config = HealthConfig(
+        action="record", storm_compiles=args.storm_compiles,
+        storm_window_steps=args.storm_window,
+        hbm_drift_tol=args.hbm_drift_tol,
+        flops_drift_tol=args.flops_drift_tol)
+
+    all_anoms, all_problems, all_compiles = [], [], []
+    per_file = {}
+    for path in args.paths:
+        try:
+            records = read_jsonl(path)
+        except (OSError, json.JSONDecodeError) as e:
+            all_problems.append(f"{path}: unreadable: {e}")
+            continue
+        anoms, problems, compiles = analyze(records, config)
+        problems = [p.replace("<records>", path) for p in problems]
+        if not args.selfcheck and not compiles:
+            # same stance as trace_check on empty metrics files: a gate
+            # that says OK about a log the observatory never wrote
+            # would green-light a run whose compile telemetry is dead
+            problems.append(f"{path}: no compile records — was a "
+                            "CompileObservatory active?")
+        print(f"compile_report: {path}: {len(compiles)} compile "
+              f"event(s), {len(anoms)} finding(s), "
+              f"{len(problems)} invalid")
+        render(compiles, anoms, problems,
+               peak_flops=args.peak_flops)
+        all_anoms += anoms
+        all_problems += problems
+        all_compiles += compiles
+        per_file[path] = {
+            "n_compile_records": len(compiles),
+            "anomalies": [a.to_dict() for a in anoms],
+            "problems": problems,
+        }
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"tool": "compile_report", "files": per_file},
+                      f, indent=2, sort_keys=True)
+        print(f"report: {args.json_out}")
+
+    if args.selfcheck:
+        # the specimen must prove the watcher can still see the storm
+        # AND that the causes name the thrashing argument
+        storms = [a for a in all_anoms if a.kind == "recompile_storm"]
+        causes = [c for r in all_compiles for c in (r.get("cause") or [])]
+        named = [c for c in causes if "arg `" in c]
+        if args.expect_arg:
+            named = [c for c in named
+                     if f"`{args.expect_arg}" in c]
+        missing = []
+        if not storms:
+            missing.append("recompile_storm did not fire")
+        if not named:
+            want = (f"naming `{args.expect_arg}`" if args.expect_arg
+                    else "naming an argument")
+            missing.append(f"no recompile cause {want}")
+        if missing:
+            print("SELFCHECK FAILED: " + "; ".join(missing),
+                  file=sys.stderr)
+            return 9
+        print(f"selfcheck OK: storm fired ({len(storms)}), "
+              f"{len(named)} cause(s) name the changing arg "
+              f"(e.g. {named[0]!r})")
+        return 0
+
+    if all_problems or all_anoms:
+        kinds = sorted({a.kind for a in all_anoms})
+        print(f"compile_report: {len(all_anoms)} finding(s) "
+              f"{kinds} + {len(all_problems)} invalid across "
+              f"{len(args.paths)} file(s)", file=sys.stderr)
+        return 6
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

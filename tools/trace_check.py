@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def check_metrics_jsonl(path):
-    """Returns (n_records, n_step_records, problems).
+    """Returns (n_records, n_step_records, n_compile_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -32,8 +32,8 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, [f"{path}: empty metrics file (0 bytes): no "
-                          "step was ever recorded"]
+            return 0, 0, 0, [f"{path}: empty metrics file (0 bytes): no "
+                             "step was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -44,15 +44,68 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
         for p in validate_step_record(rec):
             problems.append(f"{path}:{i + 1}: {p}")
+    problems += check_compile_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
-    return len(records), n_steps, problems
+    n_compiles = sum(1 for r in records
+                     if isinstance(r, dict) and r.get("kind") == "compile")
+    return len(records), n_steps, n_compiles, problems
+
+
+def check_compile_records(records, path):
+    """Cross-record rules for compile events (telemetry.compile_obs):
+
+    - per signature family AND rank (a merged multi-rank file carries
+      every rank's independent clock), steps must be monotonic
+      non-decreasing;
+    - every RECOMPILE (n_compiles > 1) must carry a non-empty cause —
+      a compile ledger that cannot say WHY it recompiled is exactly the
+      black box the observatory exists to remove;
+    - a family recompiling with zero causes anywhere fails even if the
+      producer forgot the n_compiles ordinal.
+
+    Untracked records (jax.monitoring stream — no signature, so no
+    cause is derivable) are exempt from the cause rules.
+    """
+    problems = []
+    last_step = {}
+    fam_counts = {}
+    fam_causes = {}
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "compile":
+            continue
+        fam = rec.get("fn", "?")
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            clock = (rec.get("rank", 0), fam)
+            prev = last_step.get(clock)
+            if prev is not None and step < prev:
+                problems.append(
+                    f"{path}:{i + 1}: compile record for {fam!r} "
+                    f"(rank {clock[0]}) at step {step} after one at "
+                    f"step {prev} (non-monotonic)")
+            last_step[clock] = step
+        if rec.get("untracked"):
+            continue
+        fam_counts[fam] = fam_counts.get(fam, 0) + 1
+        if rec.get("cause"):
+            fam_causes[fam] = fam_causes.get(fam, 0) + 1
+        if rec.get("n_compiles", 1) > 1 and not rec.get("cause"):
+            problems.append(
+                f"{path}:{i + 1}: recompile of {fam!r} "
+                f"(n_compiles={rec.get('n_compiles')}) carries no cause")
+    for fam, n in fam_counts.items():
+        if n > 1 and fam_causes.get(fam, 0) == 0:
+            problems.append(
+                f"{path}: {n} compile events for {fam!r} but no cause "
+                "on any of them — the recompile diff is missing")
+    return problems
 
 
 def check_chrome_trace(path):
@@ -92,9 +145,9 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, n_steps, problems = check_metrics_jsonl(jsonl_path)
-    stats = {"n_records": n_rec, "n_steps": n_steps, "n_events": 0,
-             "ranks": set()}
+    n_rec, n_steps, n_compiles, problems = check_metrics_jsonl(jsonl_path)
+    stats = {"n_records": n_rec, "n_steps": n_steps,
+             "n_compiles": n_compiles, "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -132,6 +185,8 @@ def main(argv):
             print(f"INVALID: {p}")
         return 7
     msg = f"OK: {stats['n_records']} records in {jsonl_path}"
+    if stats.get("n_compiles"):
+        msg += f" ({stats['n_compiles']} compile events)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
