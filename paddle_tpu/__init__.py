@@ -88,6 +88,7 @@ from . import enforce  # noqa: F401
 from . import monitor  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import resilience  # noqa: F401
 
 from .framework import CPUPlace, TPUPlace, CUDAPlace, get_flags, set_flags  # noqa: F401
 from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401

@@ -28,16 +28,48 @@ def _state_pytree(model, optimizer=None):
     return tree
 
 
+# ONE async checkpointer for the process: each AsyncCheckpointer owns a
+# background commit thread pool, so the old per-call construction leaked
+# a thread set per save over a long run. orbax serializes saves on the
+# instance (a second save waits for the first to finalize), which is
+# exactly the at-most-one-in-flight discipline the callers already keep.
+_ASYNC_CKPTR = None
+
+
+def _shared_async_checkpointer():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        import orbax.checkpoint as ocp
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
 def save_checkpoint(path, model, optimizer=None, step=None, async_save=True):
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     tree = _state_pytree(model, optimizer)
-    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) \
-        if async_save else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-    ckptr.save(path, tree, force=True)
     if async_save:
+        ckptr = _shared_async_checkpointer()
+        ckptr.save(path, tree, force=True)
         return ckptr  # caller may .wait_until_finished()
+    ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+        path, tree, force=True)
     return None
+
+
+def _is_sharding_error(e):
+    """Classify a restore failure: True only for errors about PLACEMENT
+    (shardings/mesh/devices) — the one family where falling back to an
+    unsharded restore is a fix rather than a cover-up."""
+    if isinstance(e, (FileNotFoundError, PermissionError)):
+        return False
+    text = f"{type(e).__name__}: {e}".lower()
+    if any(t in text for t in ("corrupt", "truncat", "checksum", "digest",
+                               "no such file", "not found", "missing")):
+        return False
+    return any(t in text for t in ("sharding", "mesh", "device",
+                                   "partition", "memory kind",
+                                   "restore_args", "restoretype"))
 
 
 def load_checkpoint(path, model, optimizer=None):
@@ -56,6 +88,13 @@ def load_checkpoint(path, model, optimizer=None):
             path, args=ocp.args.PyTreeRestore(
                 item=target, restore_args=restore_args))
     except Exception as e:
+        # fall back to an unsharded restore ONLY for placement errors
+        # (mesh changed, shardings unresolvable): those the fallback
+        # actually fixes. Corruption / missing files must PROPAGATE —
+        # the old blanket fallback would re-read the same broken bytes
+        # and silently restore garbage (or full per-host arrays).
+        if not _is_sharding_error(e):
+            raise
         import warnings
         warnings.warn(
             f"sharded checkpoint restore failed ({type(e).__name__}: {e}); "
@@ -144,13 +183,14 @@ class TrainEpochRange:
         """Durably record `epoch` as completed. Only called once the
         checkpoint for `epoch` is fully on disk — a crash between the
         array write and this rename resumes from the PREVIOUS epoch, never
-        from a half-written one."""
-        import json
+        from a half-written one. The tmp file AND the directory are
+        fsync'd around the rename: os.replace alone is atomic against
+        crashes of this process but not against power loss — an
+        unsynced rename can come back as the OLD status pointing at a
+        GC'd checkpoint, or a zero-length file."""
+        from ..resilience.ckpt import _atomic_write_json
         self._status = {"epoch_no": epoch}
-        tmp = self._status_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._status, f)
-        os.replace(tmp, self._status_path)
+        _atomic_write_json(self._status_path, self._status)
 
     def _drain_pending(self):
         if self._pending is not None:
@@ -172,13 +212,56 @@ class TrainEpochRange:
                 return
         self._commit_status(epoch)
 
+    def _epoch_checkpoint_valid(self, epoch):
+        """Is `epoch_{N}` present and restorable? A manifest-bearing
+        checkpoint (resilience protocol) is verified against its
+        digests; a plain orbax one must at least carry the orbax
+        metadata its committed rename always includes."""
+        path = os.path.join(self.dir, f"epoch_{epoch}")
+        if not os.path.isdir(path):
+            return False
+        from ..resilience.ckpt import MANIFEST_NAME, verify_checkpoint
+        if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            return not verify_checkpoint(path)
+        return os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")) \
+            and os.path.exists(os.path.join(path, "_METADATA"))
+
     def __iter__(self):
         start = self.epoch_no + 1
         if start > 0 and self.model is not None:
-            ckpt = os.path.join(self.dir, f"epoch_{self.epoch_no}")
-            if os.path.exists(ckpt):
-                load_checkpoint(ckpt, self.model, self.optimizer)
-                self.restored_from = ckpt
+            import warnings
+            # the status file points at the newest COMMITTED epoch, but
+            # the checkpoint it references may have been lost/corrupted
+            # since (partial delete, storage rot): walk BACK to the
+            # newest epoch whose checkpoint actually verifies instead
+            # of resuming epoch N+1 on fresh weights
+            restored_epoch = None
+            for e in range(self.epoch_no, -1, -1):
+                if self._epoch_checkpoint_valid(e):
+                    ckpt = os.path.join(self.dir, f"epoch_{e}")
+                    load_checkpoint(ckpt, self.model, self.optimizer)
+                    self.restored_from = ckpt
+                    restored_epoch = e
+                    break
+                if e == self.epoch_no or \
+                        os.path.isdir(os.path.join(self.dir, f"epoch_{e}")):
+                    # silent skip for epochs a save_interval > 1 never
+                    # checkpointed; loud for ones that should exist
+                    warnings.warn(
+                        f"auto-checkpoint: epoch_{e} checkpoint is "
+                        "missing or invalid; walking back to the "
+                        "previous committed epoch", RuntimeWarning,
+                        stacklevel=2)
+            if restored_epoch is None:
+                warnings.warn(
+                    "auto-checkpoint: no valid epoch checkpoint found; "
+                    "restarting from epoch 0 with current weights",
+                    RuntimeWarning, stacklevel=2)
+                self._status = {"epoch_no": -1}
+                start = 0
+            elif restored_epoch != self.epoch_no:
+                self._status = {"epoch_no": restored_epoch}
+                start = restored_epoch + 1
         try:
             for epoch in range(start, self.max_epoch_num):
                 yield epoch

@@ -44,7 +44,8 @@ class ElasticManager:
 
     def __init__(self, registry_dir=None, np=None, host_id=None,  # noqa: A002
                  heartbeat_interval=1.0, timeout=5.0,
-                 fault_tolerance_level=None, store=None):
+                 fault_tolerance_level=None, store=None, clock=None,
+                 sleep=None, backoff=1.5, max_interval=None):
         if (registry_dir is None) == (store is None):
             raise ValueError("ElasticManager: pass exactly one of "
                              "registry_dir or store")
@@ -62,6 +63,14 @@ class ElasticManager:
                 "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
         self.level = fault_tolerance_level
         self._stop = False
+        # staleness is judged on OUR monotonic clock (see alive_hosts);
+        # clock/sleep are injectable so tests pin the schedule exactly
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self.backoff = float(backoff)
+        self.max_interval = float(max_interval) if max_interval is not None \
+            else max(float(heartbeat_interval), float(timeout) / 2.0)
+        self._seen = {}     # host -> (last payload ts, our clock at change)
 
     # ---- registry ----
     def _path(self, host_id):
@@ -117,15 +126,35 @@ class ElasticManager:
                     continue
 
     def alive_hosts(self):
-        now = time.time()
+        """Hosts with a fresh heartbeat.
+
+        Staleness is clock-skew-proof: a record's wall-clock `ts` is
+        only compared against ITSELF. The first sighting of a (host,
+        ts) pair stamps OUR monotonic clock; the host goes stale when
+        its payload hasn't CHANGED for `timeout` seconds of our time.
+        A peer whose wall clock runs minutes ahead or behind (the
+        failure mode of the old `now - ts` check: either permanently
+        "stale" or immortally "fresh") is judged exactly like a
+        well-synced one."""
+        now = self._clock()
         alive = []
+        present = set()
         for raw in self._records():
             try:
                 rec = json.loads(raw)
             except ValueError:
                 continue
-            if now - rec.get("ts", 0) <= self.timeout:
-                alive.append(str(rec["host"]))
+            host = str(rec["host"])
+            ts = rec.get("ts", 0)
+            present.add(host)
+            seen = self._seen.get(host)
+            if seen is None or seen[0] != ts:
+                self._seen[host] = (ts, now)    # fresh payload
+                alive.append(host)
+            elif now - seen[1] <= self.timeout:
+                alive.append(host)
+        # a deregistered host must not resurrect with its old ts later
+        self._seen = {h: v for h, v in self._seen.items() if h in present}
         return sorted(alive)
 
     # ---- watch ----
@@ -139,8 +168,16 @@ class ElasticManager:
         return ElasticStatus.RESTART
 
     def watch(self, max_checks=None):
-        """Heartbeat + check loop; returns the first non-HOLD status."""
+        """Heartbeat + check loop; returns the first non-HOLD status.
+
+        Sleeps with multiplicative backoff (interval * backoff^n,
+        capped at max_interval <= timeout/2 so our own heartbeat can
+        never age past the staleness window) instead of the old tight
+        fixed-interval poll — a large idle pod stops hammering the
+        registry while still detecting membership changes in bounded
+        time."""
         checks = 0
+        interval = self.interval
         while not self._stop:
             self.heartbeat()
             status = self.check()
@@ -149,7 +186,8 @@ class ElasticManager:
             checks += 1
             if max_checks is not None and checks >= max_checks:
                 return ElasticStatus.HOLD
-            time.sleep(self.interval)
+            self._sleep(interval)
+            interval = min(interval * self.backoff, self.max_interval)
         return ElasticStatus.COMPLETED
 
     def stop(self):

@@ -135,13 +135,32 @@ class LocalFS(FS):
         return self.ls_dir(path)[0]
 
 
+# stderr shapes that must NOT be retried: the answer won't change, and
+# retrying only turns a clear error into a slow one
+_HDFS_PERMANENT = ("no such file", "file exists", "permission denied",
+                   "does not exist", "not a directory", "is a directory")
+
+
+def _hdfs_transient(stderr):
+    low = (stderr or "").lower()
+    return not any(t in low for t in _HDFS_PERMANENT)
+
+
 class HDFSClient(FS):
     """HDFS via the hadoop CLI (reference `fs.py:214`).  Requires a hadoop
     binary; constructor fails fast when one is absent (this image has
-    none) rather than erroring on first use."""
+    none) rather than erroring on first use.
+
+    Every non-probe command runs under `resilience.retry.with_retry`:
+    transient failures (namenode hiccup, CLI timeout, network blips —
+    anything whose stderr doesn't say the path itself is the problem)
+    back off exponentially with full jitter instead of failing the
+    checkpoint on first touch. The reference's `sleep_inter` (ms)
+    becomes the base backoff delay. Probe commands (`-test`) never
+    retry: a nonzero rc there IS the answer."""
 
     def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
-                 sleep_inter=1000):
+                 sleep_inter=1000, retry_policy=None):
         self._base = os.path.join(hadoop_home, "bin", "hadoop")
         if not os.path.exists(self._base):
             raise ExecuteError(
@@ -151,14 +170,44 @@ class HDFSClient(FS):
         for k, v in (configs or {}).items():
             self._cfg += ["-D", f"{k}={v}"]
         self._timeout = time_out / 1000.0
+        if retry_policy is None:
+            from ..resilience.retry import RetryPolicy
+            retry_policy = RetryPolicy(max_attempts=3,
+                                       base_delay_s=sleep_inter / 1000.0,
+                                       max_delay_s=30.0)
+        self._retry = retry_policy
 
-    def _run(self, *args):
-        cmd = [self._base, "fs"] + self._cfg + list(args)
+    def _run_once(self, cmd):
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=self._timeout)
         if proc.returncode != 0:
-            raise ExecuteError(f"{' '.join(cmd)}: {proc.stderr}")
+            err = ExecuteError(f"{' '.join(cmd)}: {proc.stderr}")
+            err.transient = _hdfs_transient(proc.stderr)
+            raise err
         return proc.stdout
+
+    def _run(self, *args, _retry=True):
+        from ..resilience.retry import with_retry
+        cmd = [self._base, "fs"] + self._cfg + list(args)
+        if not _retry:
+            # probes bypass chaos injection too: an injected OSError
+            # would blow through the `except ExecuteError` answer
+            # handling, which no real CLI failure can do
+            return self._run_once(cmd)
+
+        def attempt():
+            from ..resilience import chaos
+            chaos.inject("fs")
+            return self._run_once(cmd)
+
+        try:
+            return with_retry(attempt, policy=self._retry,
+                              label=f"hdfs {args[0]}")
+        except Exception as e:
+            last = getattr(e, "last", None)
+            if last is not None:
+                raise last from e     # keep the ExecuteError contract
+            raise
 
     def ls_dir(self, path):
         out = self._run("-ls", path)
@@ -173,14 +222,16 @@ class HDFSClient(FS):
 
     def is_exist(self, path):
         try:
-            self._run("-test", "-e", path)
+            # probe: rc 1 means "no" — retrying would turn every miss
+            # into max_attempts slow misses
+            self._run("-test", "-e", path, _retry=False)
             return True
         except ExecuteError:
             return False
 
     def is_dir(self, path):
         try:
-            self._run("-test", "-d", path)
+            self._run("-test", "-d", path, _retry=False)
             return True
         except ExecuteError:
             return False

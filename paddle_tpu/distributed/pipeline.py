@@ -770,6 +770,27 @@ class PipelineParallel(Layer):
         self._health_mon = None
         self._health_key = None
         self._last_health = None
+        # fault tolerance: assign a ResilienceManager/CheckpointManager/
+        # checkpoint-dir/kwargs (see paddle_tpu.resilience) and every
+        # train_batch ends with a step_boundary — periodic atomic
+        # checkpoints + preemption-aware graceful exit (attribute-style
+        # like self.lint/self.health)
+        self.resilience = None
+        self._resilience_mgr = None
+        self._resilience_key = None
+
+    def _resilience_manager(self):
+        """Normalize+cache self.resilience (attribute-style hook)."""
+        if self.resilience is None or self.resilience is False:
+            self._resilience_mgr = None
+            self._resilience_key = self.resilience
+            return None
+        if self._resilience_mgr is None or \
+                self._resilience_key is not self.resilience:
+            from ..resilience.preempt import as_resilience
+            self._resilience_mgr = as_resilience(self.resilience)
+            self._resilience_key = self.resilience
+        return self._resilience_mgr
 
     def _health_monitor(self):
         """Normalize+cache self.health (attribute-style like self.lint,
@@ -1353,7 +1374,12 @@ class PipelineParallel(Layer):
                 out = self._train_batch_impl(data, optimizer, lr_scheduler,
                                              scaler)
             _tw.note(loss=out)
-            return out
+        res = self._resilience_manager()
+        if res is not None:
+            if res.ckpt.model is None:
+                res.attach(self._layers, optimizer)
+            res.step_boundary(loss=out)
+        return out
 
     def _train_batch_impl(self, data, optimizer, lr_scheduler=None,
                           scaler=None):

@@ -85,7 +85,7 @@ class ShardedTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=None,
                  seq_shard_batch=False, donate=True, offload=None,
-                 lint=False, health=None):
+                 lint=False, health=None, resilience=None):
         self.mesh = mesh or env.current_mesh()
         self.model = model
         self.loss_fn = loss_fn
@@ -132,6 +132,14 @@ class ShardedTrainStep:
         from ..telemetry import health as _health
         self.health = _health.as_monitor(health)
         self._last_health = None
+        # fault tolerance (see jit.TrainStep): step_boundary after every
+        # completed step — periodic checkpoints + preemption exits.
+        # restore() re-places arrays onto each live array's sharding, so
+        # a ZeRO-3 resume comes back dp-sharded, not inflated
+        from ..resilience.preempt import as_resilience
+        self.resilience = as_resilience(resilience)
+        if self.resilience is not None:
+            self.resilience.attach(model, optimizer)
         if self.offload:
             # static per instance: precompute both memory-kind variants
             # so the per-step H2D/D2H hops don't rebuild NamedShardings
@@ -262,7 +270,9 @@ class ShardedTrainStep:
             else:
                 out = self._run_step(*batch)
             _tw.note(loss=out)
-            return out
+        if self.resilience is not None:
+            self.resilience.step_boundary(loss=out)
+        return out
 
     def _run_step(self, *batch):
         from .. import telemetry

@@ -237,10 +237,18 @@ class TrainStep:
     regressions, hard NaN/Inf) with the configured warn/record/raise
     action, arms the hang watchdog around each step, and lands the
     fields in the step's JSONL record — see paddle_tpu.telemetry.health.
+
+    resilience: None (default) | resilience.ResilienceManager |
+    CheckpointManager | checkpoint-dir str | kwargs dict — fault
+    tolerance. When on, every completed step calls the manager's
+    step_boundary: periodic atomic step checkpoints (async, at most one
+    in flight), and on an armed SIGTERM/preemption request a final
+    synchronous checkpoint + black-box dump + SystemExit with the
+    resumable exit code — see paddle_tpu.resilience.
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True, lint=False,
-                 health=None):
+                 health=None, resilience=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -258,6 +266,10 @@ class TrainStep:
         from ..telemetry import health as _health
         self.health = _health.as_monitor(health)
         self._last_health = None
+        from ..resilience.preempt import as_resilience
+        self.resilience = as_resilience(resilience)
+        if self.resilience is not None:
+            self.resilience.attach(model, optimizer)
 
     def _maybe_lint(self, batch):
         """Pre-flight static analysis of the step (one extra trace, no
@@ -349,7 +361,12 @@ class TrainStep:
             else:
                 out = self._run_step(*batch)
             _tw.note(loss=out)
-            return out
+        # resilience boundary AFTER the step record closes: periodic
+        # checkpoint, and an armed preemption request drains + commits
+        # + exits resumable here — never mid-step
+        if self.resilience is not None:
+            self.resilience.step_boundary(loss=out)
+        return out
 
     def _run_step(self, *batch):
         from ..amp import amp_state

@@ -1,0 +1,719 @@
+"""Step-granular checkpointing with an atomic commit protocol.
+
+The reference framework's HDFS auto-checkpoint subsystem
+(`fluid/incubate/checkpoint/auto_checkpoint.py`) survived preemptions by
+job-keyed checkpoint dirs and a serialized train status; its TPU-build
+descendant (`distributed/checkpoint.py` TrainEpochRange) is epoch-
+granular and trusts the filesystem. At pod scale neither is enough: a
+GPT-3-class run loses real money per replayed epoch, and "trusts the
+filesystem" means a crash mid-save leaves a half-written directory the
+next boot happily restores. This module is the step-granular, paranoid
+version:
+
+**Atomic commit protocol.** A save writes arrays into
+`step_N.tmp/arrays/` (orbax, each host its shards), then a
+`run_state.json` (step/epoch/data-position/RNG — what bit-identical
+resume needs beyond arrays), then a `manifest.json` carrying per-leaf
+shapes/dtypes/byte-sizes and a per-file content digest of EVERYTHING
+else in the directory. Files and directory are fsync'd, then ONE
+`os.replace(step_N.tmp -> step_N)` commits, then the `latest` marker is
+atomically updated. A crash anywhere before the rename leaves only a
+`.tmp` husk that restore ignores and GC reaps; a crash after it leaves
+a fully-verifiable checkpoint.
+
+**Restore-time integrity.** `verify_checkpoint` replays the manifest:
+missing, truncated, or digest-mismatched files are reported with the
+offending LEAF named (the orbax layout keys each parameter's directory
+by its flattened name). `CheckpointManager.restore()` walks newest ->
+oldest, skipping invalid checkpoints (counted as `ckpt.fallbacks`)
+instead of crashing or silently restoring garbage.
+
+**At most one async save in flight.** One `AsyncCheckpointer` lives for
+the manager's lifetime (fixing the per-call checkpointer/thread leak in
+`save_checkpoint`); a new save drains (commits) the previous one first.
+
+**Retention.** keep_last-K plus keep-every-N survivors; everything else
+— including uncommitted `.tmp` husks from crashed runs — is GC'd after
+each commit.
+
+All I/O goes through `resilience.retry.with_retry` (transient storage
+errors back off and retry, counted in `ckpt.retries`) and the chaos
+injection points (`resilience.chaos.inject`), so the drill harness
+exercises exactly the production code path.
+"""
+import hashlib
+import json
+import os
+import shutil
+import time
+import warnings
+
+import numpy as np
+
+from .. import monitor
+from . import chaos
+from .retry import RetryError, RetryPolicy, with_retry
+
+__all__ = ["CheckpointManager", "RunState", "CheckpointError",
+           "CheckpointCorruptError", "build_manifest", "load_manifest",
+           "verify_checkpoint", "checkpoint_bytes"]
+
+MANIFEST_NAME = "manifest.json"
+RUN_STATE_NAME = "run_state.json"
+ARRAYS_SUBDIR = "arrays"
+LATEST_NAME = "latest"
+MANIFEST_SCHEMA = 1
+_STEP_PREFIX = "step_"
+_TMP_SUFFIX = ".tmp"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint operation failed permanently (retries exhausted or a
+    non-transient error)."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """Integrity verification rejected a checkpoint. `problems` lists
+    the findings, each naming the offending file (and leaf when the
+    file maps to one)."""
+
+    def __init__(self, path, problems):
+        self.path = path
+        self.problems = list(problems)
+        super().__init__(
+            f"checkpoint {path} failed integrity verification: "
+            + "; ".join(self.problems[:4])
+            + (f" (+{len(self.problems) - 4} more)"
+               if len(self.problems) > 4 else ""))
+
+
+# ---------------------------------------------------------------------------
+# run state: everything beyond arrays that bit-identical resume needs
+# ---------------------------------------------------------------------------
+
+class RunState:
+    """Training-position record saved inside every checkpoint.
+
+    step           completed-steps count == next step index to run
+    epoch          current epoch
+    data_position  opaque loader cursor (sample/batch offset, shard id —
+                   whatever the data pipeline needs to seek back)
+    rng_state      `core/random` default generator key (captured at save,
+                   re-seeded on restore, so post-resume dropout masks /
+                   data shuffles replay the uninterrupted run exactly)
+    extra          user dict (JSON-serializable)
+    """
+
+    def __init__(self, step=0, epoch=0, data_position=None, rng_state=None,
+                 extra=None):
+        self.step = int(step)
+        self.epoch = int(epoch)
+        self.data_position = data_position
+        self.rng_state = rng_state
+        self.extra = dict(extra or {})
+
+    def capture_rng(self):
+        """Record the live `core/random` generator key."""
+        from ..core.random import default_generator
+        key = default_generator().get_state()
+        self.rng_state = [int(v) for v in np.asarray(key).ravel()]
+        return self
+
+    def restore_rng(self):
+        """Re-seed the live generator from the captured key (no-op when
+        none was captured)."""
+        if self.rng_state is None:
+            return self
+        import jax.numpy as jnp
+        from ..core.random import default_generator
+        key = jnp.asarray(np.asarray(self.rng_state, dtype=np.uint32))
+        default_generator().set_state(key)
+        return self
+
+    def snapshot(self):
+        """Copy with the CURRENT rng state captured — what a save should
+        persist (the live object keeps mutating afterwards)."""
+        return RunState(step=self.step, epoch=self.epoch,
+                        data_position=self.data_position,
+                        extra=dict(self.extra)).capture_rng()
+
+    def to_dict(self):
+        return {"schema": MANIFEST_SCHEMA, "step": self.step,
+                "epoch": self.epoch, "data_position": self.data_position,
+                "rng_state": self.rng_state, "extra": self.extra}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(step=d.get("step", 0), epoch=d.get("epoch", 0),
+                   data_position=d.get("data_position"),
+                   rng_state=d.get("rng_state"),
+                   extra=d.get("extra"))
+
+    def __repr__(self):
+        return (f"RunState(step={self.step}, epoch={self.epoch}, "
+                f"data_position={self.data_position!r})")
+
+
+# ---------------------------------------------------------------------------
+# durability + manifest primitives
+# ---------------------------------------------------------------------------
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:          # pragma: no cover - non-POSIX fallback
+        return
+    try:
+        os.fsync(fd)
+    except OSError:          # pragma: no cover - some FSes refuse dir fsync
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path, obj):
+    """tmp-write + fsync + rename + dir fsync: the file is either absent
+    or complete, never half-written."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def flatten_leaves(tree, prefix=""):
+    """Dotted-path -> array metadata for every leaf of a state pytree —
+    the names match the orbax (use_ocdbt=False) on-disk directory names,
+    which is what lets a corrupt FILE be reported as a corrupt LEAF."""
+    out = {}
+    for k, v in tree.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_leaves(v, prefix=name + "."))
+        else:
+            arr = np.asarray(v) if not hasattr(v, "dtype") else v
+            out[name] = {"shape": [int(s) for s in getattr(arr, "shape", ())],
+                         "dtype": str(getattr(arr, "dtype", "?")),
+                         "nbytes": int(getattr(arr, "nbytes", 0))}
+    return out
+
+
+def _walk_files(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            yield os.path.join(dirpath, name)
+
+
+def build_manifest(ckpt_dir, leaves=None, step=None, digest="sha256"):
+    """Manifest dict over every file currently in `ckpt_dir` (except the
+    manifest itself): relative path -> {size, sha256}. `leaves` is the
+    per-leaf shape/dtype/nbytes metadata captured from the in-memory
+    tree at save time."""
+    files = {}
+    for path in _walk_files(ckpt_dir):
+        rel = os.path.relpath(path, ckpt_dir)
+        if rel == MANIFEST_NAME:
+            continue
+        entry = {"size": os.path.getsize(path)}
+        if digest == "sha256":
+            entry["sha256"] = _sha256(path)
+        files[rel.replace(os.sep, "/")] = entry
+    return {"schema": MANIFEST_SCHEMA, "kind": "ckpt_manifest",
+            "step": step, "time_unix": time.time(), "digest": digest,
+            "leaves": leaves or {}, "files": files}
+
+
+def load_manifest(ckpt_dir):
+    with open(os.path.join(ckpt_dir, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
+def _leaf_for(rel, leaf_names):
+    """Map a manifest file path to the leaf whose shard it holds. The
+    orbax use_ocdbt=False layout keys each leaf's directory by its
+    dotted name (`arrays/model.fc.weight/0.0`); longest-prefix match
+    handles leaf names that are themselves dotted."""
+    if not rel.startswith(ARRAYS_SUBDIR + "/"):
+        return None
+    sub = rel[len(ARRAYS_SUBDIR) + 1:]
+    best = None
+    for name in leaf_names:
+        if (sub == name or sub.startswith(name + "/")) and \
+                (best is None or len(name) > len(best)):
+            best = name
+    return best
+
+
+def verify_checkpoint(ckpt_dir, deep=True):
+    """Integrity-check one committed checkpoint against its manifest.
+
+    Returns a list of problem strings ([] == valid); each names the
+    offending file, and the leaf it belongs to when the orbax layout
+    makes that mapping possible. `deep=False` skips content digests
+    (size/presence only — the cheap scan a boot-time walk-back uses
+    before committing to a full verify)."""
+    problems = []
+    if not os.path.isdir(ckpt_dir):
+        return [f"{ckpt_dir}: not a directory"]
+    mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return [f"{MANIFEST_NAME} missing — checkpoint was never "
+                "committed (or predates the manifest protocol)"]
+    try:
+        manifest = load_manifest(ckpt_dir)
+    except (OSError, ValueError) as e:
+        return [f"{MANIFEST_NAME} unreadable: {e}"]
+    files = manifest.get("files")
+    if not isinstance(files, dict) or not files:
+        return [f"{MANIFEST_NAME} carries no file table"]
+    leaf_names = list((manifest.get("leaves") or {}).keys())
+    use_digest = deep and manifest.get("digest") == "sha256"
+    for rel in sorted(files):
+        meta = files[rel]
+        path = os.path.join(ckpt_dir, *rel.split("/"))
+        leaf = _leaf_for(rel, leaf_names)
+        tag = f" (leaf {leaf})" if leaf else ""
+        if not os.path.exists(path):
+            problems.append(f"{rel}: missing{tag}")
+            continue
+        size = os.path.getsize(path)
+        if size != meta.get("size"):
+            problems.append(
+                f"{rel}: truncated or resized — {size} bytes on disk vs "
+                f"{meta.get('size')} in manifest{tag}")
+            continue
+        if use_digest and meta.get("sha256"):
+            actual = _sha256(path)
+            if actual != meta["sha256"]:
+                problems.append(
+                    f"{rel}: content digest mismatch — shard bytes were "
+                    f"corrupted after write{tag}")
+    return problems
+
+
+def checkpoint_bytes(manifest):
+    """Total payload bytes a manifest accounts for."""
+    return sum(int(e.get("size", 0))
+               for e in (manifest.get("files") or {}).values())
+
+
+class _OcdbtNoiseFilter:
+    """Drop orbax's per-save 'Skipping merge of OCDBT checkpoints'
+    warning (expected under use_ocdbt=False; not actionable)."""
+
+    def filter(self, record):
+        try:
+            return "Skipping merge of OCDBT" not in record.getMessage()
+        except Exception:          # pragma: no cover - defensive
+            return True
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Atomic, retrying, self-verifying step-checkpoint store.
+
+        mgr = CheckpointManager(dir, model, optimizer, keep_last=3)
+        ...
+        mgr.save(step, run_state=rs)     # async kickoff; previous save
+                                         # drains+commits first
+        ...
+        rs = mgr.restore()               # newest VALID checkpoint (auto
+                                         # fallback past corrupt ones)
+
+    keep_last    committed checkpoints retained (>=1)
+    keep_every   additionally keep every N-th step forever (None: off)
+    async_save   orbax AsyncCheckpointer (one instance, reused) vs sync
+    retry        RetryPolicy for every I/O op (default: 4 attempts,
+                 0.5s..30s full-jitter backoff)
+    digest       'sha256' (default) or 'none' (size-only manifests)
+    health       optional telemetry.HealthMonitor — every emitted
+                 kind=ckpt record is also judged by its AnomalyDetector
+                 (checkpoint_stall / checkpoint_failed rules)
+    sink         optional JsonlSink or path for kind=ckpt records; when
+                 absent, records ride the context-active recorder's sink
+    """
+
+    def __init__(self, directory, model=None, optimizer=None, keep_last=3,
+                 keep_every=None, async_save=True, retry=None, rank=0,
+                 digest="sha256", health=None, sink=None):
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.model = model
+        self.optimizer = optimizer
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every) if keep_every else None
+        self.async_save = bool(async_save)
+        self.retry = retry or RetryPolicy()
+        self.rank = int(rank)
+        self.digest = digest
+        self.health = health
+        from ..telemetry.sink import JsonlSink
+        self._owns_sink = isinstance(sink, str)
+        self.sink = JsonlSink(sink) if self._owns_sink else sink
+        self.records = []
+        self._ckptr = None
+        self._pending = None      # (step, tmp_dir, leaves, run_state, t0)
+        self._gc_husks()
+
+    # -- naming -------------------------------------------------------------
+    def step_dir(self, step):
+        return os.path.join(self.dir, f"{_STEP_PREFIX}{int(step)}")
+
+    def _tmp_dir(self, step):
+        return self.step_dir(step) + _TMP_SUFFIX
+
+    def steps(self):
+        """Committed (manifest-bearing) step numbers, ascending."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith(_STEP_PREFIX) or name.endswith(_TMP_SUFFIX):
+                continue
+            try:
+                step = int(name[len(_STEP_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.exists(os.path.join(self.dir, name, MANIFEST_NAME)):
+                out.append(step)
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest committed step. The directory scan is AUTHORITATIVE:
+        the atomic rename — not the `latest` marker — is the commit
+        point, so a crash between the rename and the marker write must
+        not make restore discard the just-committed step. The marker
+        exists as a cheap hint for humans and external tooling; it is
+        rewritten on every commit and never trusted over the scan."""
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # -- checkpointer (ONE instance — fixes the per-call leak) --------------
+    def _checkpointer(self):
+        if self._ckptr is None:
+            import logging
+            import orbax.checkpoint as ocp
+            # use_ocdbt=False so each leaf owns a directory NAMED by its
+            # flattened key — that naming is what lets verify_checkpoint
+            # report a corrupt FILE as a corrupt LEAF. orbax logs a
+            # harmless "skipping merge of OCDBT" warning per async save
+            # in this mode; filter that one line, keep the rest.
+            logging.getLogger("absl").addFilter(_OcdbtNoiseFilter())
+            handler = ocp.PyTreeCheckpointHandler(use_ocdbt=False)
+            self._ckptr = (ocp.AsyncCheckpointer(handler) if self.async_save
+                           else ocp.Checkpointer(handler))
+        return self._ckptr
+
+    def _on_retry(self, attempt, exc, delay):
+        monitor.incr("ckpt.retries")
+        warnings.warn(
+            f"[ckpt] transient I/O error (attempt {attempt}): "
+            f"{type(exc).__name__}: {exc}; retrying in {delay:.2f}s",
+            RuntimeWarning, stacklevel=4)
+
+    def _io(self, fn, label):
+        return with_retry(fn, policy=self.retry, on_retry=self._on_retry,
+                          label=label)
+
+    # -- save / commit ------------------------------------------------------
+    def save(self, step, run_state=None, block=False):
+        """Checkpoint the model (+optimizer) at `step`. Kicks off an
+        async save and returns; the previous in-flight save is drained
+        (committed) first, so at most one save is ever in flight.
+        `block=True` (or async_save=False) commits before returning."""
+        if self.model is None:
+            raise CheckpointError("CheckpointManager has no model attached")
+        self.drain()
+        step = int(step)
+        t0 = time.perf_counter()
+        from ..distributed.checkpoint import _state_pytree
+        tree = _state_pytree(self.model, self.optimizer)
+        leaves = flatten_leaves(tree)
+        if run_state is None:
+            run_state = RunState(step=step).capture_rng()
+        elif run_state.rng_state is None:
+            run_state = run_state.snapshot()
+        tmp = self._tmp_dir(step)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        # NOTE: an already-committed step_N (a restart replayed this
+        # step) is NOT touched here — the async save can fail or the
+        # process can die before commit, and the committed checkpoint
+        # must survive that. _commit moves it aside only at the moment
+        # the replacement lands.
+
+        def _kickoff():
+            chaos.inject("save")
+            self._checkpointer().save(
+                os.path.join(tmp, ARRAYS_SUBDIR), tree, force=True)
+
+        try:
+            self._io(_kickoff, f"ckpt.save(step={step})")
+        except Exception as e:
+            self._failed(step, "save", e)
+            raise (e if isinstance(e, CheckpointError) else
+                   CheckpointError(f"checkpoint save at step {step} "
+                                   f"failed: {e}")) from e
+        monitor.incr("ckpt.saves")
+        self._pending = (step, tmp, leaves, run_state, t0)
+        self._emit("save", step)
+        if block or not self.async_save:
+            self.drain()
+        return self
+
+    def drain(self):
+        """Wait out the in-flight async save and COMMIT it (manifest,
+        fsync, atomic rename, latest marker, retention GC). A crash
+        before drain loses only the uncommitted step — never corrupts a
+        committed one."""
+        if self._pending is None:
+            return
+        step, tmp, leaves, run_state, t0 = self._pending
+        try:
+            if self.async_save:
+                self._io(self._checkpointer().wait_until_finished,
+                         f"ckpt.wait(step={step})")
+            self._commit(step, tmp, leaves, run_state, t0)
+        except Exception as e:
+            self._pending = None
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._failed(step, "commit", e)
+            raise (e if isinstance(e, CheckpointError) else
+                   CheckpointError(f"checkpoint commit at step {step} "
+                                   f"failed: {e}")) from e
+        self._pending = None
+
+    def _commit(self, step, tmp, leaves, run_state, t0):
+        def _do_commit():
+            chaos.inject("commit")
+            _atomic_write_json(os.path.join(tmp, RUN_STATE_NAME),
+                               run_state.to_dict())
+            manifest = build_manifest(tmp, leaves=leaves, step=step,
+                                      digest=self.digest)
+            _atomic_write_json(os.path.join(tmp, MANIFEST_NAME), manifest)
+            for path in _walk_files(tmp):
+                _fsync_file(path)
+            _fsync_dir(tmp)
+            final = self.step_dir(step)
+            # a restart that replayed this step supersedes the old
+            # committed copy — but only NOW, with the replacement fully
+            # written and verified-by-construction: move it aside (the
+            # `.tmp` suffix puts a crash leftover under husk GC), land
+            # the new one, then reap. The exposure window is two
+            # renames, not the whole async save.
+            aside = None
+            if os.path.exists(final):
+                aside = final + ".superseded" + _TMP_SUFFIX
+                if os.path.exists(aside):
+                    shutil.rmtree(aside, ignore_errors=True)
+                os.replace(final, aside)
+            os.replace(tmp, final)
+            _fsync_dir(self.dir)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+            return manifest
+
+        manifest = self._io(_do_commit, f"ckpt.commit(step={step})")
+        self._write_latest(step)
+        save_ms = (time.perf_counter() - t0) * 1000.0
+        nbytes = checkpoint_bytes(manifest)
+        monitor.incr("ckpt.commits")
+        monitor.set_gauge("ckpt.save_ms", save_ms)
+        monitor.set_gauge("ckpt.bytes", float(nbytes))
+        monitor.set_gauge("ckpt.last_step", float(step))
+        self._emit("commit", step, save_ms=save_ms, bytes=nbytes)
+        self._gc()
+
+    def _write_latest(self, step):
+        path = os.path.join(self.dir, LATEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(int(step)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.dir)
+
+    def _failed(self, step, op, exc):
+        monitor.incr("ckpt.failures")
+        self._emit("failed", step, op=op,
+                   error=f"{type(exc).__name__}: {exc}")
+
+    # -- retention ----------------------------------------------------------
+    def _gc(self):
+        steps = self.steps()
+        keep = set(steps[-self.keep_last:])
+        if self.keep_every:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        removed = 0
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self.step_dir(s), ignore_errors=True)
+                removed += 1
+        removed += self._gc_husks()
+        if removed:
+            monitor.incr("ckpt.gc_removed", removed)
+            self._emit("gc", steps[-1] if steps else 0, removed=removed)
+        return removed
+
+    def _gc_husks(self):
+        """Reap uncommitted `.tmp` husks (crashed saves), sparing the
+        one currently in flight."""
+        live = self._pending[1] if self._pending is not None else None
+        removed = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            if not (name.startswith(_STEP_PREFIX)
+                    and name.endswith(_TMP_SUFFIX)):
+                continue
+            path = os.path.join(self.dir, name)
+            if path == live or not os.path.isdir(path):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed += 1
+        return removed
+
+    # -- verify / restore ---------------------------------------------------
+    def verify(self, step, deep=True):
+        return verify_checkpoint(self.step_dir(step), deep=deep)
+
+    def restore(self, step=None, model=None, optimizer=None):
+        """Restore model(+optimizer+RNG) in place; returns the RunState.
+
+        step=None: newest VALID checkpoint — invalid ones (failed
+        manifest verification) are skipped with a warning and counted
+        as `ckpt.fallbacks`; returns None when no checkpoint exists at
+        all; raises CheckpointCorruptError when checkpoints exist but
+        none verifies. step=N: that exact checkpoint; corruption raises
+        (explicit requests never silently fall back).
+        """
+        model = model if model is not None else self.model
+        optimizer = optimizer if optimizer is not None else self.optimizer
+        if model is None:
+            raise CheckpointError("restore needs a model")
+        if step is not None:
+            problems = self.verify(step)
+            if problems:
+                raise CheckpointCorruptError(self.step_dir(step), problems)
+            return self._restore_one(int(step), model, optimizer)
+        steps = self.steps()
+        if not steps:
+            return None
+        last_problems = None
+        for s in sorted(steps, reverse=True):
+            problems = self.verify(s)
+            if problems:
+                last_problems = (s, problems)
+                monitor.incr("ckpt.fallbacks")
+                self._emit("fallback", s, problems=problems[:8])
+                warnings.warn(
+                    f"[ckpt] checkpoint step {s} failed verification "
+                    f"({problems[0]}" +
+                    (f"; +{len(problems) - 1} more" if len(problems) > 1
+                     else "") + "); falling back to an older checkpoint",
+                    RuntimeWarning, stacklevel=2)
+                continue
+            return self._restore_one(s, model, optimizer)
+        raise CheckpointCorruptError(
+            self.step_dir(last_problems[0]), last_problems[1])
+
+    def _restore_one(self, step, model, optimizer):
+        from ..distributed.checkpoint import load_checkpoint
+        path = os.path.join(self.step_dir(step), ARRAYS_SUBDIR)
+        t0 = time.perf_counter()
+
+        def _load():
+            chaos.inject("restore")
+            return load_checkpoint(path, model, optimizer)
+
+        try:
+            self._io(_load, f"ckpt.restore(step={step})")
+        except Exception as e:
+            self._failed(step, "restore", e)
+            raise (e if isinstance(e, CheckpointError) else
+                   CheckpointError(f"checkpoint restore at step {step} "
+                                   f"failed: {e}")) from e
+        rs_path = os.path.join(self.step_dir(step), RUN_STATE_NAME)
+        run_state = RunState(step=step)
+        if os.path.exists(rs_path):
+            with open(rs_path) as f:
+                run_state = RunState.from_dict(json.load(f))
+        run_state.restore_rng()
+        monitor.incr("ckpt.restores")
+        monitor.set_gauge("ckpt.restore_ms",
+                          (time.perf_counter() - t0) * 1000.0)
+        self._emit("restore", step)
+        return run_state
+
+    # -- record plumbing ----------------------------------------------------
+    def _emit(self, event, step, **fields):
+        from ..telemetry.sink import make_ckpt_record
+        rec = make_ckpt_record(event=event, step=step, rank=self.rank,
+                               **fields)
+        self.records.append(rec)
+        sink = self.sink
+        if sink is None:
+            from ..telemetry.recorder import current_recorder
+            r = current_recorder()
+            sink = r.sink if r is not None else None
+        if sink is not None:
+            sink.write(rec)
+        if self.health is not None:
+            # the same kind=ckpt record the JSONL carries is judged
+            # in-flight, so live paging and offline replay agree
+            self.health.observe_record(rec)
+        return rec
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        """Drain + release the checkpointer (its background threads)."""
+        try:
+            self.drain()
+        finally:
+            if self._ckptr is not None:
+                try:
+                    self._ckptr.close()
+                except Exception:
+                    pass
+                self._ckptr = None
+            if self.sink is not None and self._owns_sink:
+                self.sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,280 @@
+"""Preemption-aware graceful shutdown + the `resilience=` train hook.
+
+A TPU pod job does not end with an exception: it ends with a SIGTERM
+from the scheduler (maintenance event, spot reclaim, elastic rescale)
+and a grace window measured in seconds. The reference framework's
+answer was the elastic manager's relaunch protocol plus HDFS
+auto-checkpoint; this module is the step-granular TPU-build version:
+
+- `PreemptionHandler` turns SIGTERM/SIGINT into an ARMED FLAG, not an
+  exception — a signal mid-XLA-dispatch must not unwind the stack
+  through a donated-buffer update;
+- train steps wired with `resilience=` (TrainStep / ShardedTrainStep /
+  `PipelineParallel.resilience`, the same pattern as `health=`/`lint=`)
+  call `ResilienceManager.step_boundary()` between steps; an armed
+  request there drains the in-flight async save, commits a final
+  checkpoint synchronously, writes a black-box dump through the
+  watchdog machinery, and exits with `RESUMABLE_EXIT_CODE` — a code
+  the launcher can distinguish from a crash (restart-and-resume) and
+  from `ELASTIC_EXIT_CODE` (restart-with-new-world);
+- `RunState` (saved inside every checkpoint) carries step, epoch,
+  data position and `core/random` RNG state, so `resume()` restarts
+  bit-identical at STEP granularity, not epoch.
+"""
+import os
+import signal
+import threading
+import time
+import warnings
+
+from .. import monitor
+from .ckpt import CheckpointManager, RunState
+
+__all__ = ["RESUMABLE_EXIT_CODE", "PreemptionHandler", "ResilienceManager",
+           "as_resilience"]
+
+# exit-code protocol: 101 (ELASTIC_EXIT_CODE) = relaunch with a new
+# world; 102 = graceful preemption exit, state committed, relaunch and
+# auto-resume from the checkpoint. Distinct so the launcher/driver can
+# tell "resume me" from "rebuild me" from a real crash.
+RESUMABLE_EXIT_CODE = 102
+
+
+class PreemptionHandler:
+    """Arm a 'checkpoint at the next step boundary' request on SIGTERM.
+
+    handler = PreemptionHandler().install()
+    ...
+    if handler.requested: ...            # polled between steps
+
+    The signal handler only sets a flag (async-signal-safe by
+    construction); all real work happens at the next step boundary on
+    the main thread. `request()` arms it programmatically (tests,
+    chaos drills, cooperative shutdown). install()/uninstall() save and
+    restore the previous handlers; install from a non-main thread is a
+    warning no-op (the boundary check then relies on `request()`).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._prev = {}
+        self._requested = None     # (signal number or None, monotonic ts)
+        self.installed = False
+
+    def _on_signal(self, signum, frame):
+        self._requested = (signum, time.monotonic())
+        monitor.incr("ckpt.preempt_signals")
+
+    def install(self):
+        if self.installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            warnings.warn(
+                "PreemptionHandler.install() outside the main thread: "
+                "signal handlers cannot be set; only request() will arm",
+                RuntimeWarning, stacklevel=2)
+            return self
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self.installed = True
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):     # non-main thread / teardown
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    def request(self, signum=None):
+        """Arm the shutdown request without a real signal."""
+        self._requested = (signum, time.monotonic())
+        return self
+
+    @property
+    def requested(self):
+        return self._requested is not None
+
+    @property
+    def signal_name(self):
+        if self._requested is None:
+            return None
+        signum = self._requested[0]
+        if signum is None:
+            return "request()"
+        try:
+            return signal.Signals(signum).name
+        except ValueError:
+            return str(signum)
+
+
+class ResilienceManager:
+    """The `resilience=` hook: periodic step checkpoints + preemption-
+    aware graceful shutdown + auto-resume.
+
+        res = ResilienceManager("/ckpts/job", save_every=100,
+                                hang-free defaults elsewhere)
+        step = TrainStep(model, loss_fn, opt, resilience=res)
+        start = res.resume() or 0        # restores model/opt/RNG if a
+                                         # committed checkpoint exists
+        for i in range(start, total_steps):
+            loss = step(*batch_at(i))    # step_boundary runs after
+                                         # each completed step
+
+    On SIGTERM the NEXT step boundary drains the in-flight save,
+    commits a synchronous final checkpoint, dumps a black box (the
+    PR-3 watchdog format), and raises SystemExit(RESUMABLE_EXIT_CODE).
+
+    save_every=0 disables periodic saves (preemption saves still
+    happen). The underlying CheckpointManager can be shared/preset via
+    `manager=`; otherwise one is built over `checkpoint_dir`.
+    """
+
+    def __init__(self, checkpoint_dir=None, manager=None, model=None,
+                 optimizer=None, save_every=100, keep_last=3,
+                 keep_every=None, async_save=True, retry=None,
+                 preempt=True, exit_on_preempt=True,
+                 exit_code=RESUMABLE_EXIT_CODE, dump_dir=None, health=None,
+                 sink=None, rank=0):
+        if (checkpoint_dir is None) == (manager is None):
+            raise ValueError("ResilienceManager: pass exactly one of "
+                             "checkpoint_dir or manager")
+        self.ckpt = manager if manager is not None else CheckpointManager(
+            checkpoint_dir, model=model, optimizer=optimizer,
+            keep_last=keep_last, keep_every=keep_every,
+            async_save=async_save, retry=retry, rank=rank, health=health,
+            sink=sink)
+        self.save_every = int(save_every)
+        self.exit_on_preempt = bool(exit_on_preempt)
+        self.exit_code = int(exit_code)
+        self.dump_dir = dump_dir if dump_dir is not None else self.ckpt.dir
+        self.state = RunState()
+        self.resumed_from = None
+        self._shutdown_done = False
+        if isinstance(preempt, PreemptionHandler):
+            self.handler = preempt.install()
+        elif preempt:
+            self.handler = PreemptionHandler().install()
+        else:
+            self.handler = None
+
+    # -- train-step wiring --------------------------------------------------
+    def attach(self, model, optimizer=None):
+        """Late-bind the model/optimizer (the train step passes its own
+        when the manager was built from a bare directory)."""
+        if self.ckpt.model is None:
+            self.ckpt.model = model
+        if self.ckpt.optimizer is None and optimizer is not None:
+            self.ckpt.optimizer = optimizer
+        return self
+
+    def note(self, epoch=None, data_position=None, **extra):
+        """Update run-position fields carried by the next checkpoint."""
+        if epoch is not None:
+            self.state.epoch = int(epoch)
+        if data_position is not None:
+            self.state.data_position = data_position
+        self.state.extra.update(extra)
+        return self
+
+    def step_boundary(self, loss=None):
+        """Called by the wired train step after each COMPLETED step.
+        Advances the step count; on an armed preemption request commits
+        a final checkpoint and exits resumable; otherwise saves on the
+        periodic schedule."""
+        self.state.step += 1
+        if self.handler is not None and self.handler.requested:
+            self.graceful_shutdown()
+            return
+        if self.save_every and self.state.step % self.save_every == 0:
+            self.ckpt.save(self.state.step,
+                           run_state=self.state.snapshot())
+
+    def graceful_shutdown(self, reason=None):
+        """Drain + final synchronous checkpoint + black-box dump + (by
+        default) SystemExit(RESUMABLE_EXIT_CODE). Idempotent — a second
+        call (signal during shutdown) exits without re-saving."""
+        if self._shutdown_done:
+            if self.exit_on_preempt:
+                raise SystemExit(self.exit_code)
+            return
+        self._shutdown_done = True
+        sig = self.handler.signal_name if self.handler is not None else None
+        reason = reason or (f"preemption ({sig or 'requested'}): graceful "
+                            f"shutdown at step {self.state.step}")
+        monitor.incr("ckpt.preemptions")
+        err = None
+        try:
+            self.ckpt.save(self.state.step,
+                           run_state=self.state.snapshot(), block=True)
+        except Exception as e:      # the dump must still happen
+            err = e
+        from ..telemetry.watchdog import dump_black_box
+        dump_black_box(
+            reason=reason, dump_dir=self.dump_dir,
+            ring=list(self.ckpt.records[-16:]),
+            extra={"ckpt_step": self.state.step,
+                   "ckpt_dir": self.ckpt.dir,
+                   "exit_code": self.exit_code if self.exit_on_preempt
+                   else None,
+                   "final_save_error": repr(err) if err else None})
+        fields = {"signal": sig} if sig else {}
+        self.ckpt._emit("preempt", self.state.step, **fields)
+        self.close(uninstall=True)
+        if err is not None:
+            raise err
+        if self.exit_on_preempt:
+            raise SystemExit(self.exit_code)
+
+    # -- resume -------------------------------------------------------------
+    def resume(self, model=None, optimizer=None):
+        """Auto-resume: restore the newest valid checkpoint (if any)
+        into the attached model/optimizer + the RNG, adopt its
+        RunState, and return the step to continue FROM (== completed
+        steps), or None when starting fresh."""
+        if model is not None or optimizer is not None:
+            self.attach(model, optimizer)
+        rs = self.ckpt.restore()
+        if rs is None:
+            return None
+        self.state = rs
+        self.resumed_from = rs.step
+        monitor.set_gauge("ckpt.resumed_step", float(rs.step))
+        return rs.step
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, uninstall=True):
+        try:
+            self.ckpt.close()
+        finally:
+            if uninstall and self.handler is not None:
+                self.handler.uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def as_resilience(arg):
+    """Normalize the `resilience=` argument of TrainStep /
+    ShardedTrainStep / PipelineParallel: None/False -> None,
+    ResilienceManager -> itself (shared across steps), CheckpointManager
+    -> wrapped, str -> manager over that directory, dict -> kwargs."""
+    if arg is None or arg is False:
+        return None
+    if isinstance(arg, ResilienceManager):
+        return arg
+    if isinstance(arg, CheckpointManager):
+        return ResilienceManager(manager=arg)
+    if isinstance(arg, str):
+        return ResilienceManager(checkpoint_dir=arg)
+    if isinstance(arg, dict):
+        return ResilienceManager(**arg)
+    raise TypeError(
+        "resilience= expects a ResilienceManager, CheckpointManager, "
+        f"checkpoint-dir string, or kwargs dict; got {type(arg).__name__}")

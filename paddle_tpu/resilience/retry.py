@@ -1,0 +1,210 @@
+"""Retry/backoff combinator for flaky distributed I/O.
+
+At pod scale, storage hiccups are weather, not bugs: a GCS 503 during a
+checkpoint save, an NFS stall during restore, a kvstore coordinator
+restarting mid-heartbeat. The reference framework dealt with this ad
+hoc (the HDFS client's `sleep_inter` loop in `fleet/utils/fs.py`); this
+module centralizes the policy so every I/O path in the resilience
+runtime — checkpoint save/restore (`resilience.ckpt`), the HDFS client
+(`distributed/fs.py`), chaos drills — retries the same way and reports
+retries to the same `ckpt.retries` counter family.
+
+Design points:
+
+- **exponential backoff with FULL jitter** (delay ~ U[0, min(cap,
+  base*mult^n)]): the AWS-architecture result that de-synchronizes a
+  pod's worth of hosts all retrying the same flaky filestore;
+- **deadlines** bound total wall time (a preemption grace window is
+  ~30s — a retry loop must not out-sleep it);
+- **retry budgets** (`RetryBudget`) cap the *aggregate* retries a
+  subsystem spends, so a persistently broken filesystem degrades to
+  fail-fast instead of multiplying every call by max_attempts;
+- **transient-vs-permanent classification**: FileNotFoundError or a
+  shape mismatch must fail NOW — retrying a permanent error just turns
+  a clear traceback into a slow one.
+
+Clock and sleep are injectable, so tests pin the whole schedule with a
+fake clock (no real sleeping, no flaky timing assertions).
+"""
+import errno
+import functools
+import random
+import threading
+import time
+
+__all__ = ["RetryPolicy", "RetryBudget", "RetryError", "with_retry",
+           "retrying", "is_transient"]
+
+# errno values worth retrying: transient kernel/FS/network conditions.
+# Deliberately NOT here: ENOSPC/EDQUOT (disk full stays full), EACCES/
+# EPERM (permissions don't heal), ENOENT (missing stays missing).
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR, errno.ETIMEDOUT,
+    errno.ECONNRESET, errno.ECONNREFUSED, errno.ECONNABORTED,
+    errno.ENETUNREACH, errno.ENETRESET, errno.EHOSTUNREACH,
+    errno.ESTALE,           # NFS handle went stale — a remount heals it
+})
+
+_PERMANENT_TYPES = (FileNotFoundError, PermissionError, IsADirectoryError,
+                    NotADirectoryError, ValueError, TypeError, KeyError)
+
+
+class RetryError(Exception):
+    """All attempts exhausted (or deadline/budget hit). `last` carries
+    the final underlying exception; `attempts` how many were made."""
+
+    def __init__(self, message, last=None, attempts=0):
+        super().__init__(message)
+        self.last = last
+        self.attempts = attempts
+
+
+def is_transient(exc):
+    """Default transient-vs-permanent classifier.
+
+    Transient: timeouts, connection errors, OSError with a transient
+    errno (EIO/EAGAIN/ESTALE/...), and anything explicitly tagged
+    `exc.transient = True` (the chaos monkey tags its injected faults).
+    Permanent: missing files, permissions, type/value errors — retrying
+    those only delays the real traceback.
+    """
+    tagged = getattr(exc, "transient", None)
+    if tagged is not None:
+        return bool(tagged)
+    if isinstance(exc, (TimeoutError, ConnectionError)):
+        return True
+    if isinstance(exc, _PERMANENT_TYPES):
+        return False
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    # subprocess.TimeoutExpired without importing subprocess eagerly
+    if type(exc).__name__ == "TimeoutExpired":
+        return True
+    return False
+
+
+class RetryBudget:
+    """A shared, thread-safe allowance of retries for a subsystem.
+
+    Every RETRY (not first attempts) spends one token; an empty budget
+    makes with_retry fail fast after the first error. This bounds the
+    worst case of a persistently broken filesystem: N calls cost
+    N + budget attempts total, not N * max_attempts.
+    """
+
+    def __init__(self, tokens=64):
+        self._mu = threading.Lock()
+        self._tokens = int(tokens)
+        self.spent = 0
+
+    def take(self):
+        with self._mu:
+            if self._tokens <= 0:
+                return False
+            self._tokens -= 1
+            self.spent += 1
+            return True
+
+    def remaining(self):
+        with self._mu:
+            return self._tokens
+
+
+class RetryPolicy:
+    """Backoff schedule + limits.
+
+    max_attempts   total tries (1 == no retry)
+    base_delay_s   first backoff cap (full jitter draws from [0, cap])
+    max_delay_s    backoff cap ceiling
+    multiplier     cap growth per retry
+    deadline_s     total wall-time bound across attempts (None: unbounded)
+    budget         optional RetryBudget shared across calls
+    classify       predicate(exc) -> transient? (default `is_transient`)
+    jitter         False: deterministic caps (tests); True: full jitter
+    """
+
+    def __init__(self, max_attempts=4, base_delay_s=0.5, max_delay_s=30.0,
+                 multiplier=2.0, deadline_s=None, budget=None,
+                 classify=None, jitter=True, seed=None):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.deadline_s = deadline_s
+        self.budget = budget
+        self.classify = classify or is_transient
+        self.jitter = bool(jitter)
+        self._rand = random.Random(seed)
+
+    def delay(self, attempt):
+        """Backoff before retry #`attempt` (1-based). Full jitter:
+        U[0, cap]; cap = base * multiplier^(attempt-1), clipped."""
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (self.multiplier ** (attempt - 1)))
+        return self._rand.uniform(0.0, cap) if self.jitter else cap
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"base={self.base_delay_s}s, cap={self.max_delay_s}s, "
+                f"deadline={self.deadline_s})")
+
+
+def with_retry(fn, policy=None, on_retry=None, clock=None, sleep=None,
+               label=None):
+    """Call `fn()` under `policy`; returns fn's value or raises.
+
+    Permanent errors (per policy.classify) raise immediately, untouched.
+    Transient errors back off and retry until attempts, deadline, or the
+    shared budget run out — then `RetryError` wraps the last one.
+
+    on_retry(attempt, exc, delay_s) fires before each backoff sleep (the
+    checkpoint manager advances `ckpt.retries` here). `clock`/`sleep`
+    default to time.monotonic/time.sleep and are injectable for tests.
+    """
+    policy = policy or RetryPolicy()
+    clock = clock or time.monotonic
+    sleep = sleep or time.sleep
+    name = label or getattr(fn, "__name__", "fn")
+    t0 = clock()
+    last = None
+    attempt = 0
+    while attempt < policy.max_attempts:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as e:
+            if not policy.classify(e):
+                raise
+            last = e
+        if attempt >= policy.max_attempts:
+            break
+        if policy.budget is not None and not policy.budget.take():
+            raise RetryError(
+                f"{name}: retry budget exhausted after attempt {attempt}: "
+                f"{type(last).__name__}: {last}", last=last,
+                attempts=attempt)
+        delay = policy.delay(attempt)
+        if policy.deadline_s is not None and \
+                (clock() - t0) + delay > policy.deadline_s:
+            raise RetryError(
+                f"{name}: deadline {policy.deadline_s}s would be exceeded "
+                f"after attempt {attempt}: {type(last).__name__}: {last}",
+                last=last, attempts=attempt)
+        if on_retry is not None:
+            on_retry(attempt, last, delay)
+        sleep(delay)
+    raise RetryError(
+        f"{name}: {policy.max_attempts} attempt(s) failed; last: "
+        f"{type(last).__name__}: {last}", last=last, attempts=attempt)
+
+
+def retrying(policy=None, **kwargs):
+    """Decorator form: @retrying(RetryPolicy(max_attempts=5))."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            return with_retry(lambda: fn(*a, **kw), policy=policy, **kwargs)
+        return wrapped
+    return deco

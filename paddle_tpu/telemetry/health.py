@@ -92,6 +92,9 @@ class HealthConfig:
     flops_drift_tol   relative drift between a compile record's
                       cost.flops and its analytic_flops (the peak-FLOPs
                       table MFU claims ride on) that fires `flops_drift`
+    ckpt_stall_s      a kind=ckpt commit record whose save_ms exceeds
+                      this many seconds fires `checkpoint_stall`
+                      (resilience.CheckpointManager records)
     hang_deadline_s   arm a HangWatchdog with this deadline (None: off)
     dump_dir          where black-box dumps go ('.' default)
     dump_on_exception fire the black-box dump when an exception escapes
@@ -103,7 +106,7 @@ class HealthConfig:
                  z_loss=8.0, z_grad=8.0, z_step_time=8.0,
                  rel_step_time=1.5, storm_compiles=5, storm_window_steps=32,
                  hbm_drift_tol=0.15, flops_drift_tol=0.25,
-                 hang_deadline_s=None, dump_dir=".",
+                 ckpt_stall_s=300.0, hang_deadline_s=None, dump_dir=".",
                  dump_on_exception=True, ring_size=64):
         if action not in _ACTIONS:
             raise ValueError(f"health action must be one of {_ACTIONS}, "
@@ -122,6 +125,7 @@ class HealthConfig:
         self.storm_window_steps = int(storm_window_steps)
         self.hbm_drift_tol = float(hbm_drift_tol)
         self.flops_drift_tol = float(flops_drift_tol)
+        self.ckpt_stall_s = float(ckpt_stall_s)
         self.hang_deadline_s = hang_deadline_s
         self.dump_dir = dump_dir
         self.dump_on_exception = bool(dump_on_exception)
@@ -224,6 +228,13 @@ class AnomalyDetector:
     - flops_drift          a compile record whose cost.flops drifts more
                            than flops_drift_tol from its analytic_flops
                            (the MFU peak-FLOPs accounting)
+    - checkpoint_failed    a ckpt record (kind='ckpt', resilience
+                           runtime) with event='failed' (retries
+                           exhausted) or event='fallback' (a corrupt
+                           checkpoint was skipped at restore)
+    - checkpoint_stall     a ckpt commit whose save_ms exceeds
+                           ckpt_stall_s — saves that slow eat the
+                           preemption grace window
 
     Clean values enter their windows AFTER judgment, so a spike does not
     vaccinate the window against itself; anomalous values are excluded
@@ -274,6 +285,10 @@ class AnomalyDetector:
             return found
         if rec.get("kind") == "compile":
             found = self._observe_compile(rec)
+            self.anomalies.extend(found)
+            return found
+        if rec.get("kind") == "ckpt":
+            found = self._observe_ckpt(rec)
             self.anomalies.extend(found)
             return found
         step = rec.get("step", self._n - 1)
@@ -415,6 +430,42 @@ class AnomalyDetector:
                     f"{float(analytic):.3e} the MFU accounting assumes "
                     f"(tolerance {c.flops_drift_tol * 100:.0f}%)",
                     expected=analytic, z=round(drift, 3)))
+        return found
+
+    def _observe_ckpt(self, rec):
+        """Rules over one checkpoint-event record (kind='ckpt',
+        paddle_tpu.resilience): failed saves/restores and corrupt-
+        checkpoint fallbacks page as `checkpoint_failed`; a commit
+        slower than ckpt_stall_s pages as `checkpoint_stall` (the
+        preemption grace window is the budget a save must fit). Same
+        records in flight (CheckpointManager health=) and offline
+        (tools/healthwatch.py), so replays agree."""
+        found = []
+        step = rec.get("step", self._n - 1)
+        event = rec.get("event")
+        if event == "failed":
+            found.append(Anomaly(
+                "checkpoint_failed", step, None,
+                f"step {step}: checkpoint {rec.get('op', 'operation')} "
+                f"failed permanently: {rec.get('error', 'unknown error')}"))
+        elif event == "fallback":
+            probs = rec.get("problems") or []
+            hint = f" ({probs[0]})" if probs else ""
+            found.append(Anomaly(
+                "checkpoint_failed", step, None,
+                f"checkpoint at step {step} failed integrity "
+                f"verification{hint}; restore fell back to an older one"))
+        elif event == "commit":
+            save_ms = rec.get("save_ms")
+            limit_ms = self.config.ckpt_stall_s * 1000.0
+            if _finite(save_ms) and save_ms > limit_ms:
+                found.append(Anomaly(
+                    "checkpoint_stall", step, float(save_ms),
+                    f"step {step}: checkpoint save took "
+                    f"{save_ms / 1000.0:.1f}s (budget "
+                    f"{self.config.ckpt_stall_s:.0f}s) — a preemption "
+                    "during a save this slow loses the step",
+                    expected=limit_ms))
         return found
 
     def kinds(self):

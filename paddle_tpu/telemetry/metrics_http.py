@@ -167,6 +167,19 @@ class MetricsServer:
             "compiles": snap.get("compile.count", 0),
             "recompiles": snap.get("compile.recompiles", 0),
             "compile_storms": snap.get("compile.storms", 0),
+            # resilience runtime (paddle_tpu.resilience): is this job
+            # actually checkpointing, and has it had to retry/fall back
+            "checkpoint": {
+                "saves": snap.get("ckpt.saves", 0),
+                "commits": snap.get("ckpt.commits", 0),
+                "restores": snap.get("ckpt.restores", 0),
+                "fallbacks": snap.get("ckpt.fallbacks", 0),
+                "failures": snap.get("ckpt.failures", 0),
+                "retries": snap.get("ckpt.retries", 0),
+                "preemptions": snap.get("ckpt.preemptions", 0),
+                "last_step": snap.get("ckpt.last_step"),
+                "last_save_ms": snap.get("ckpt.save_ms"),
+            },
         }
         h = self.health
         if h is not None:

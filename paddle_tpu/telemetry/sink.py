@@ -48,6 +48,13 @@ HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
 COMPILE_RECORD_KEYS = ("schema", "kind", "rank", "fn", "step",
                       "compile_ms", "n_compiles")
 
+# required keys of a checkpoint-event record (paddle_tpu.resilience);
+# optional: save_ms, bytes, op, error, problems, removed, signal
+CKPT_RECORD_KEYS = ("schema", "kind", "rank", "step", "event")
+# the event vocabulary tools/trace_check.py accepts
+CKPT_EVENTS = ("save", "commit", "restore", "fallback", "failed", "gc",
+               "preempt")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -140,6 +147,34 @@ def make_compile_record(fn, step, compile_ms, rank=0, n_compiles=1,
         rec["untracked"] = True
     if extra:
         rec["extra"] = extra
+    return rec
+
+
+def make_ckpt_record(event, step, rank=0, save_ms=None, bytes=None,  # noqa: A002
+                     **extra):
+    """One checkpoint-lifecycle event as a first-class record
+    (kind='ckpt', paddle_tpu.resilience.ckpt). `event` is one of
+    CKPT_EVENTS: save (async kickoff), commit (manifest + atomic
+    rename landed), restore, fallback (a corrupt checkpoint was
+    skipped), failed (retries exhausted), gc (retention sweep),
+    preempt (graceful-shutdown checkpoint)."""
+    if event not in CKPT_EVENTS:
+        raise ValueError(f"ckpt event must be one of {CKPT_EVENTS}, "
+                         f"got {event!r}")
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "ckpt",
+        "rank": int(rank),
+        "step": int(step),
+        "event": str(event),
+    }
+    if save_ms is not None:
+        rec["save_ms"] = round(float(save_ms), 4)
+    if bytes is not None:
+        rec["bytes"] = int(bytes)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
     return rec
 
 
@@ -248,6 +283,21 @@ def validate_step_record(rec):
         if cause is not None and (not isinstance(cause, list) or
                                   not all(isinstance(c, str) for c in cause)):
             problems.append(f"'cause' not a list of strings: {cause!r}")
+        return problems
+    if kind == "ckpt":
+        for key in CKPT_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"ckpt record missing '{key}'")
+        ev = rec.get("event")
+        if ev is not None and ev not in CKPT_EVENTS:
+            problems.append(f"unknown ckpt event {ev!r} "
+                            f"(expected one of {list(CKPT_EVENTS)})")
+        for key in ("save_ms", "bytes"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float)) or v < 0):
+                problems.append(f"'{key}' not a non-negative number: {v!r}")
+        if ev == "commit" and "save_ms" not in rec:
+            problems.append("ckpt commit record carries no save_ms")
         return problems
     for key in STEP_RECORD_KEYS:
         if key not in rec:

@@ -1,0 +1,825 @@
+"""Resilience runtime tests (paddle_tpu.resilience): retry/backoff
+schedules under a fake clock, the atomic checkpoint commit protocol +
+manifest integrity verification + fallback-to-previous-valid, SIGTERM
+graceful shutdown at a step boundary, auto-resume (bit-identical incl.
+RNG), chaos fault injection, and the elastic-manager clock-skew fixes.
+The subprocess kill-and-resume drill (tools/chaos_drill.py) runs slow."""
+import errno
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.resilience import (
+    ChaosConfig, ChaosMonkey, CheckpointCorruptError, CheckpointError,
+    CheckpointManager, PreemptionHandler, RESUMABLE_EXIT_CODE,
+    ResilienceManager, RetryBudget, RetryError, RetryPolicy, RunState,
+    as_resilience, corrupt_one_file, is_transient, verify_checkpoint,
+    with_retry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(seed=5):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 6))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    return net, opt
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_delay_s", 0.0005)
+    kw.setdefault("max_delay_s", 0.001)
+    return RetryPolicy(**kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+# =========================================================================
+# retry.py
+# =========================================================================
+
+def test_retry_backoff_schedule_deterministic():
+    """jitter=False: the sleeps are exactly base * mult^n, capped."""
+    clk = FakeClock()
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise OSError(errno.EIO, "flaky")
+
+    pol = RetryPolicy(max_attempts=4, base_delay_s=0.5, multiplier=2.0,
+                      max_delay_s=30.0, jitter=False)
+    with pytest.raises(RetryError) as e:
+        with_retry(boom, policy=pol, clock=clk, sleep=clk.sleep)
+    assert len(calls) == 4
+    assert e.value.attempts == 4
+    assert isinstance(e.value.last, OSError)
+    assert clk.sleeps == [0.5, 1.0, 2.0]
+
+
+def test_retry_full_jitter_within_caps():
+    clk = FakeClock()
+    pol = RetryPolicy(max_attempts=5, base_delay_s=1.0, multiplier=2.0,
+                      max_delay_s=3.0, jitter=True, seed=7)
+    with pytest.raises(RetryError):
+        with_retry(lambda: (_ for _ in ()).throw(TimeoutError("t")),
+                   policy=pol, clock=clk, sleep=clk.sleep)
+    caps = [1.0, 2.0, 3.0, 3.0]
+    assert len(clk.sleeps) == 4
+    for s, cap in zip(clk.sleeps, caps):
+        assert 0.0 <= s <= cap
+
+
+def test_retry_succeeds_after_transients():
+    clk = FakeClock()
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionResetError("blip")
+        return "ok"
+
+    out = with_retry(flaky, policy=RetryPolicy(max_attempts=5, jitter=False,
+                                               base_delay_s=0.1),
+                     clock=clk, sleep=clk.sleep)
+    assert out == "ok" and state["n"] == 3
+
+
+def test_retry_permanent_error_raises_immediately():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        with_retry(missing, policy=_fast_policy())
+    assert len(calls) == 1     # no retries for a permanent error
+
+
+def test_retry_deadline_stops_early():
+    clk = FakeClock()
+    pol = RetryPolicy(max_attempts=100, base_delay_s=10.0, jitter=False,
+                      deadline_s=25.0)
+    with pytest.raises(RetryError, match="deadline"):
+        with_retry(lambda: (_ for _ in ()).throw(TimeoutError()),
+                   policy=pol, clock=clk, sleep=clk.sleep)
+    # 10 + 20 > 25: the second backoff would blow the deadline
+    assert clk.sleeps == [10.0]
+
+
+def test_retry_budget_shared_across_calls():
+    clk = FakeClock()
+    budget = RetryBudget(tokens=1)
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.1, jitter=False,
+                      budget=budget)
+
+    def boom():
+        raise TimeoutError("x")
+
+    with pytest.raises(RetryError, match="budget"):
+        with_retry(boom, policy=pol, clock=clk, sleep=clk.sleep)
+    assert budget.remaining() == 0
+    # second caller fails fast: no tokens left, exactly one attempt
+    calls = []
+    with pytest.raises(RetryError, match="budget"):
+        with_retry(lambda: calls.append(1) or boom(), policy=pol,
+                   clock=clk, sleep=clk.sleep)
+    assert len(calls) == 1
+
+
+def test_transient_classification():
+    assert is_transient(OSError(errno.EIO, "io"))
+    assert is_transient(OSError(errno.ESTALE, "nfs"))
+    assert is_transient(TimeoutError())
+    assert is_transient(ConnectionRefusedError())
+    assert not is_transient(OSError(errno.ENOSPC, "full"))
+    assert not is_transient(FileNotFoundError())
+    assert not is_transient(ValueError("bad"))
+    tagged = RuntimeError("storage blip")
+    tagged.transient = True
+    assert is_transient(tagged)
+
+
+# =========================================================================
+# ckpt.py: manifest + atomic commit + retention + fallback
+# =========================================================================
+
+def test_atomic_commit_latest_marker_and_manifest(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(1, block=True)
+    assert mgr.steps() == [1]
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert (tmp_path / "latest").read_text() == "1"
+    assert mgr.verify(1) == []
+    from paddle_tpu.resilience.ckpt import load_manifest
+    m = load_manifest(mgr.step_dir(1))
+    # every model/optimizer leaf is named with shape+dtype+bytes
+    leaves = m["leaves"]
+    assert any(k.startswith("model.") for k in leaves)
+    w = next(v for k, v in leaves.items() if k.endswith("weight")
+             and k.startswith("model."))
+    assert w["dtype"] == "float32" and w["nbytes"] > 0
+    # every file is digested
+    assert all("sha256" in e for e in m["files"].values())
+    assert "run_state.json" in m["files"]
+    mgr.close()
+
+
+def test_verify_detects_corrupt_truncated_missing(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(2, block=True)
+    d = mgr.step_dir(2)
+    # corrupt: flip bytes in a leaf shard, size unchanged -> digest catch
+    bad = corrupt_one_file(d, seed=0, prefer="arrays/model")
+    probs = verify_checkpoint(d)
+    assert probs and "digest mismatch" in probs[0] and "leaf model." in \
+        probs[0]
+    # truncate another leaf file
+    shard = None
+    for root, _, files in os.walk(os.path.join(d, "arrays")):
+        for f in files:
+            p = os.path.join(root, f)
+            if p != bad and os.path.getsize(p) > 4:
+                shard = p
+                break
+        if shard:
+            break
+    with open(shard, "rb+") as f:
+        f.truncate(os.path.getsize(shard) - 2)
+    probs = verify_checkpoint(d)
+    assert any("truncated" in p for p in probs)
+    # missing file
+    os.remove(shard)
+    probs = verify_checkpoint(d)
+    assert any("missing" in p for p in probs)
+    # manifest gone == never committed
+    os.remove(os.path.join(d, "manifest.json"))
+    probs = verify_checkpoint(d)
+    assert probs and "never committed" in probs[0]
+    mgr.close()
+
+
+def test_crash_husk_is_ignored_and_reaped(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(1, block=True)
+    mgr.close()
+    # simulate a crash mid-save: an uncommitted husk from a dead process
+    husk = tmp_path / "step_2.tmp"
+    (husk / "arrays").mkdir(parents=True)
+    (husk / "arrays" / "junk").write_text("partial")
+    mgr2 = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    assert mgr2.steps() == [1]          # husk is not a checkpoint
+    assert not husk.exists()            # init GC reaped it
+    rs = mgr2.restore()
+    assert rs.step == 1                 # restore never touches a husk
+    mgr2.close()
+
+
+def test_retention_keep_last_and_keep_every(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, keep_last=2,
+                            keep_every=4, retry=_fast_policy())
+    for s in range(1, 10):
+        mgr.save(s, block=True)
+    # keep-last-2 {8, 9} plus every-4th {4, 8}
+    assert mgr.steps() == [4, 8, 9]
+    mgr.close()
+
+
+def test_single_async_checkpointer_reused(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(1)
+    first = mgr._ckptr
+    mgr.save(2)
+    mgr.drain()
+    assert mgr._ckptr is first          # no per-save checkpointer leak
+    assert mgr._pending is None
+    mgr.close()
+
+
+def test_restore_falls_back_past_corruption(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, keep_last=3,
+                            retry=_fast_policy())
+    marks = {}
+    for s in (1, 2, 3):
+        net[0].weight.set_value(net[0].weight.numpy() + 1.0)
+        marks[s] = net[0].weight.numpy().copy()
+        mgr.save(s, block=True)
+    corrupt_one_file(mgr.step_dir(3), seed=1, prefer="arrays/model")
+    before = monitor.get("ckpt.fallbacks")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        rs = mgr.restore()
+    assert rs.step == 2
+    assert np.allclose(net[0].weight.numpy(), marks[2])
+    assert monitor.get("ckpt.fallbacks") == before + 1
+    assert any(r["event"] == "fallback" for r in mgr.records)
+    # explicit request for the corrupt step must RAISE, never fall back
+    with pytest.raises(CheckpointCorruptError) as e:
+        mgr.restore(step=3)
+    assert e.value.problems
+    # all checkpoints corrupt -> CheckpointCorruptError, not garbage
+    corrupt_one_file(mgr.step_dir(2), seed=2, prefer="arrays/model")
+    corrupt_one_file(mgr.step_dir(1), seed=3, prefer="arrays/model")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore()
+    mgr.close()
+
+
+def test_resave_failure_never_destroys_committed_step(tmp_path):
+    """Replaying a step after resume re-saves the same step number; if
+    that save FAILS, the previously committed step_N must survive —
+    the old copy is only moved aside at the commit rename, not deleted
+    at save kickoff."""
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt,
+                            retry=_fast_policy(max_attempts=2))
+    mgr.save(1, block=True)
+    w1 = net[0].weight.numpy().copy()
+    net[0].weight.set_value(w1 + 5.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with ChaosMonkey(ChaosConfig(seed=0, io_error_rate=1.0)).active():
+            with pytest.raises(CheckpointError):
+                mgr.save(1, block=True)     # the re-save dies
+    assert mgr.steps() == [1]
+    assert mgr.verify(1) == []              # old commit intact
+    rs = mgr.restore()
+    assert rs.step == 1
+    assert np.allclose(net[0].weight.numpy(), w1)
+    # and a SUCCESSFUL re-save supersedes it cleanly
+    net[0].weight.set_value(w1 + 7.0)
+    mgr.save(1, block=True)
+    assert mgr.verify(1) == []
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    mgr.restore()
+    assert np.allclose(net[0].weight.numpy(), w1 + 7.0)
+    mgr.close()
+
+
+def test_stale_latest_marker_does_not_hide_newer_commit(tmp_path):
+    """The rename is the commit point: a crash between the rename and
+    the marker write leaves the marker pointing one step back, and
+    restore must still pick the newer committed step from the scan."""
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(1, block=True)
+    net[0].weight.set_value(net[0].weight.numpy() + 3.0)
+    w2 = net[0].weight.numpy().copy()
+    mgr.save(2, block=True)
+    (tmp_path / "latest").write_text("1")   # the simulated crash
+    assert mgr.latest_step() == 2
+    rs = mgr.restore()
+    assert rs.step == 2
+    assert np.allclose(net[0].weight.numpy(), w2)
+    mgr.close()
+
+
+def test_restore_empty_dir_returns_none(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    assert mgr.restore() is None
+    mgr.close()
+
+
+def test_run_state_rng_roundtrip(tmp_path):
+    """Resume must continue the PRNG stream bit-identically."""
+    from paddle_tpu.core.random import default_generator
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    paddle.seed(77)
+    default_generator().split()         # advance a bit
+    rs = RunState(step=1, epoch=2, data_position={"batch": 17},
+                  extra={"lr": 0.05}).capture_rng()
+    mgr.save(1, run_state=rs, block=True)
+    expected = [np.asarray(default_generator().split()) for _ in range(3)]
+
+    paddle.seed(999)                    # trash the generator
+    out = mgr.restore()
+    assert out.step == 1 and out.epoch == 2
+    assert out.data_position == {"batch": 17}
+    assert out.extra == {"lr": 0.05}
+    got = [np.asarray(default_generator().split()) for _ in range(3)]
+    for e, g in zip(expected, got):
+        assert np.array_equal(e, g)
+    mgr.close()
+
+
+def test_ckpt_records_and_trace_check(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_check import check_pair
+    net, opt = _mlp()
+    ledger = str(tmp_path / "ckpt.jsonl")
+    mgr = CheckpointManager(str(tmp_path / "ck"), net, opt, sink=ledger,
+                            retry=_fast_policy())
+    mgr.save(1, block=True)
+    mgr.save(2, block=True)
+    mgr.restore()
+    mgr.close()
+    problems, stats = check_pair(ledger)
+    assert problems == []
+    assert stats["n_ckpt"] >= 5         # 2x(save+commit) + restore
+    # a doctored ledger (commit without save, non-monotonic) must fail
+    recs = [json.loads(line) for line in open(ledger)]
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write(json.dumps({"schema": 1, "kind": "ckpt", "rank": 0,
+                            "step": 1, "event": "commit",
+                            "save_ms": 1.0}) + "\n")
+    problems, _ = check_pair(bad)
+    assert any("non-monotonic" in p for p in problems)
+    # unknown event vocabulary is rejected at the schema layer
+    from paddle_tpu.telemetry.sink import validate_step_record
+    assert validate_step_record({"schema": 1, "kind": "ckpt", "rank": 0,
+                                 "step": 1, "event": "vibe"})
+
+
+def test_chaos_injection_exercises_retry_and_failure(tmp_path):
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path / "a"), net, opt,
+                            retry=_fast_policy(max_attempts=8))
+    before = monitor.get("ckpt.retries")
+    monkey = ChaosMonkey(ChaosConfig(seed=3, io_error_rate=0.6,
+                                     max_faults=6))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with monkey.active():
+            mgr.save(1, block=True)
+    assert monkey.faults > 0
+    assert monitor.get("ckpt.retries") > before
+    assert mgr.steps() == [1]           # survived the weather
+    mgr.close()
+    # 100% fault rate exhausts the retries -> CheckpointError + a
+    # kind=ckpt failed record (the pageable artifact)
+    mgr2 = CheckpointManager(str(tmp_path / "b"), net, opt,
+                             retry=_fast_policy(max_attempts=2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with ChaosMonkey(ChaosConfig(seed=0, io_error_rate=1.0)).active():
+            with pytest.raises(CheckpointError):
+                mgr2.save(1, block=True)
+    assert any(r["event"] == "failed" for r in mgr2.records)
+    mgr2.close()
+
+
+# =========================================================================
+# preempt.py: SIGTERM -> graceful exit -> auto-resume
+# =========================================================================
+
+def test_preemption_handler_sigterm_arms_flag():
+    h = PreemptionHandler().install()
+    try:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+        assert h.signal_name == "SIGTERM"
+    finally:
+        h.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is not h._on_signal
+
+
+def test_train_step_periodic_saves_and_graceful_exit(tmp_path):
+    net, opt = _mlp()
+    res = ResilienceManager(str(tmp_path), save_every=2, preempt=True)
+    step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt,
+                     resilience=res)
+    x = paddle.randn([4, 6])
+    y = paddle.randn([4, 6])
+    try:
+        for _ in range(4):
+            step(x, y)
+        res.ckpt.drain()
+        assert res.ckpt.steps() == [2, 4]       # periodic schedule
+        res.handler.request()                    # "SIGTERM" arrived
+        with pytest.raises(SystemExit) as e:
+            step(x, y)                           # next boundary exits
+        assert e.value.code == RESUMABLE_EXIT_CODE
+        # the final synchronous checkpoint committed step 5
+        assert 5 in CheckpointManager(str(tmp_path), net).steps()
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("health_blackbox")]
+        assert dumps, "graceful shutdown must leave a black-box dump"
+        box = json.load(open(tmp_path / dumps[0]))
+        assert box["extra"]["ckpt_step"] == 5
+        assert "preemption" in box["reason"]
+        assert monitor.get("ckpt.preemptions") >= 1
+    finally:
+        if res.handler is not None:
+            res.handler.uninstall()
+
+
+def test_auto_resume_continues_bit_identical(tmp_path):
+    """3 steps + resume + 3 steps == 6 uninterrupted steps, exactly."""
+    def data(i):
+        rs = np.random.RandomState(100 + i)
+        return (rs.randn(8, 6).astype("float32"),
+                rs.randn(8, 6).astype("float32"))
+
+    def run(ckpt_dir, stop_at=None, fresh_seed=5):
+        net, opt = _mlp(fresh_seed)
+        res = ResilienceManager(str(ckpt_dir), save_every=1, preempt=False)
+        step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt,
+                         resilience=res)
+        start = res.resume(net, opt) or 0
+        losses = {}
+        for i in range(start, stop_at if stop_at is not None else 6):
+            x, y = data(i)
+            losses[i] = float(step(x, y).numpy())
+        res.ckpt.drain()
+        res.close()
+        return losses, net
+
+    base, net_a = run(tmp_path / "base")
+    first, _ = run(tmp_path / "drill", stop_at=3)
+    second, net_b = run(tmp_path / "drill", fresh_seed=123)  # resumes
+    combined = dict(first)
+    combined.update(second)
+    assert combined == base             # exact float equality, all steps
+    for (na, pa), (nb, pb) in zip(sorted(net_a.named_parameters()),
+                                  sorted(net_b.named_parameters())):
+        assert na == nb
+        assert np.array_equal(pa.numpy(), pb.numpy())
+
+
+def test_as_resilience_normalization(tmp_path):
+    assert as_resilience(None) is None
+    assert as_resilience(False) is None
+    res = ResilienceManager(str(tmp_path / "a"), preempt=False)
+    assert as_resilience(res) is res
+    mgr = CheckpointManager(str(tmp_path / "b"))
+    wrapped = as_resilience(mgr)
+    assert isinstance(wrapped, ResilienceManager) and wrapped.ckpt is mgr
+    from_dir = as_resilience(str(tmp_path / "c"))
+    assert isinstance(from_dir, ResilienceManager)
+    from_kw = as_resilience({"checkpoint_dir": str(tmp_path / "d"),
+                             "save_every": 7, "preempt": False})
+    assert from_kw.save_every == 7
+    with pytest.raises(TypeError, match="resilience="):
+        as_resilience(42)
+    for r in (res, wrapped, from_dir, from_kw):
+        r.close()
+
+
+def test_sharded_train_step_resilience(tmp_path):
+    import paddle_tpu.distributed as dist
+    dist.build_mesh(dp=8)
+    net, opt = _mlp()
+    res = ResilienceManager(str(tmp_path), save_every=1, preempt=False)
+    step = dist.ShardedTrainStep(
+        net, lambda a, b: F.mse_loss(net(a), b), opt, zero_stage=1,
+        resilience=res)
+    step(paddle.randn([8, 6]), paddle.randn([8, 6]))
+    step(paddle.randn([8, 6]), paddle.randn([8, 6]))
+    res.ckpt.drain()
+    assert res.ckpt.steps() == [1, 2]
+    # restore over the sharded placements round-trips
+    w = net[0].weight.numpy().copy()
+    net[0].weight.set_value(np.zeros_like(w))
+    rs = res.ckpt.restore()
+    assert rs.step == 2
+    assert np.allclose(net[0].weight.numpy(), w)
+    res.close()
+
+
+def test_pipeline_resilience_attribute(tmp_path):
+    import paddle_tpu.distributed as dist
+    layer = dist.PipelineLayer(
+        [nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2)], num_stages=1,
+        loss_fn=lambda out, y: F.cross_entropy(out, y))
+    pp = dist.PipelineParallel(layer)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    pp.resilience = str(tmp_path)       # attribute hook, like pp.lint
+    x = paddle.randn([4, 4])
+    y = paddle.randint(0, 2, [4])
+    pp.train_batch((x, y), opt)
+    res = pp._resilience_manager()
+    res.save_every = 1
+    pp.train_batch((x, y), opt)
+    res.ckpt.drain()
+    assert 2 in res.ckpt.steps()
+    assert res.ckpt.model is layer      # attached lazily from the hook
+    res.close()
+
+
+# =========================================================================
+# telemetry integration: health rules + /metrics + /healthz
+# =========================================================================
+
+def test_health_rules_checkpoint_failed_and_stall():
+    from paddle_tpu.telemetry.health import AnomalyDetector, HealthConfig
+    det = AnomalyDetector(HealthConfig(action="record", ckpt_stall_s=1.0))
+    assert det.observe({"kind": "ckpt", "event": "save", "step": 1}) == []
+    a = det.observe({"kind": "ckpt", "event": "failed", "step": 2,
+                     "op": "save", "error": "RetryError: disk on fire"})
+    assert [x.kind for x in a] == ["checkpoint_failed"]
+    assert "disk on fire" in a[0].message
+    a = det.observe({"kind": "ckpt", "event": "fallback", "step": 3,
+                     "problems": ["arrays/w/0.0: digest mismatch"]})
+    assert [x.kind for x in a] == ["checkpoint_failed"]
+    assert det.observe({"kind": "ckpt", "event": "commit", "step": 4,
+                        "save_ms": 400.0}) == []       # under budget
+    a = det.observe({"kind": "ckpt", "event": "commit", "step": 5,
+                     "save_ms": 5000.0})
+    assert [x.kind for x in a] == ["checkpoint_stall"]
+    assert det.kinds() == ["checkpoint_failed", "checkpoint_stall"]
+
+
+def test_ckpt_metrics_on_http_endpoint(tmp_path):
+    import urllib.request
+    from paddle_tpu.telemetry import MetricsServer
+    net, opt = _mlp()
+    mgr = CheckpointManager(str(tmp_path), net, opt, retry=_fast_policy())
+    mgr.save(1, block=True)
+    with MetricsServer() as srv:
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=5).read().decode()
+        hz = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=5).read().decode())
+    for name in ("paddle_tpu_ckpt_saves", "paddle_tpu_ckpt_commits",
+                 "paddle_tpu_ckpt_save_ms", "paddle_tpu_ckpt_bytes"):
+        assert name in text
+    ck = hz["checkpoint"]
+    assert ck["saves"] >= 1 and ck["commits"] >= 1
+    assert ck["last_step"] is not None
+    mgr.close()
+
+
+def test_healthwatch_replays_ckpt_records(tmp_path):
+    bad = tmp_path / "ckpt_bad.jsonl"
+    bad.write_text(json.dumps(
+        {"schema": 1, "kind": "ckpt", "rank": 0, "step": 4,
+         "event": "failed", "op": "restore", "error": "boom"}) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "healthwatch.py"),
+         str(bad), "--expect", "checkpoint_failed"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# =========================================================================
+# satellites: checkpoint.py, fs.py, elastic.py
+# =========================================================================
+
+def test_train_epoch_range_walks_back_past_lost_checkpoint(tmp_path):
+    from paddle_tpu.distributed.checkpoint import TrainEpochRange
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    r = TrainEpochRange(3, name="job_wb", checkpoint_dir=str(tmp_path),
+                        model=net, optimizer=opt)
+    w_by_epoch = {}
+    for epoch in r:
+        net.weight.set_value(net.weight.numpy() + 1.0)
+        w_by_epoch[epoch] = net.weight.numpy().copy()
+    # storage loses the newest epoch checkpoint after the run
+    shutil.rmtree(os.path.join(str(tmp_path), "job_wb", "epoch_2"))
+    paddle.seed(1)
+    net2 = nn.Linear(4, 4)
+    r2 = TrainEpochRange(4, name="job_wb", checkpoint_dir=str(tmp_path),
+                         model=net2, optimizer=opt)
+    with pytest.warns(RuntimeWarning, match="walking back"):
+        seen = list(r2)
+    # epoch_2 gone -> restored epoch 1's weights, re-ran epochs 2..3
+    assert seen == [2, 3]
+    assert r2.restored_from.endswith("epoch_1")
+
+
+def test_load_checkpoint_corruption_propagates(tmp_path):
+    """The old blanket `except Exception` silently fell back to an
+    unsharded restore on ANY failure; corruption must now raise."""
+    from paddle_tpu.distributed.checkpoint import (load_checkpoint,
+                                                   save_checkpoint)
+    net, opt = _mlp()
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, net, opt, async_save=False)
+    # wreck the orbax tree metadata: both restore paths now fail, and
+    # the failure must PROPAGATE instead of warning-and-garbage
+    with open(os.path.join(ck, "_METADATA"), "w") as f:
+        f.write("{corrupt json")
+    with pytest.raises(Exception) as e:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")      # a warning == fallback
+            load_checkpoint(ck, net, opt)
+    assert not isinstance(e.value, warnings.WarningMessage)
+
+
+def test_load_checkpoint_sharding_error_still_falls_back(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    net, opt = _mlp()
+    ck = str(tmp_path / "ck")
+    ckpt_mod.save_checkpoint(ck, net, opt, async_save=False)
+    import orbax.checkpoint as ocp
+    orig = ocp.checkpoint_utils.construct_restore_args
+
+    def boom(*a, **kw):
+        raise ValueError("sharding mismatch: mesh changed")
+
+    ocp.checkpoint_utils.construct_restore_args = boom
+    try:
+        with pytest.warns(UserWarning, match="unsharded restore"):
+            ckpt_mod.load_checkpoint(ck, net, opt)
+    finally:
+        ocp.checkpoint_utils.construct_restore_args = orig
+
+
+def test_hdfs_stderr_classifier():
+    from paddle_tpu.distributed.fs import _hdfs_transient
+    assert _hdfs_transient("Connection refused by namenode")
+    assert _hdfs_transient("java.net.SocketTimeoutException: timeout")
+    assert not _hdfs_transient("ls: `/x': No such file or directory")
+    assert not _hdfs_transient("put: Permission denied")
+    assert not _hdfs_transient("mkdir: `/y': File exists")
+
+
+def test_hdfs_client_retries_transient_failures(tmp_path):
+    """A fake hadoop CLI fails twice with a transient error then
+    succeeds: the retried command lands; probe commands never retry."""
+    from paddle_tpu.distributed.fs import HDFSClient
+    home = tmp_path / "hadoop"
+    bindir = home / "bin"
+    bindir.mkdir(parents=True)
+    state = tmp_path / "attempts"
+    calls = tmp_path / "calls.log"
+    hadoop = bindir / "hadoop"
+    hadoop.write_text(f"""#!/bin/sh
+echo "$@" >> {calls}
+case "$*" in
+  *-test*) exit 1 ;;
+esac
+n=$(cat {state} 2>/dev/null || echo 0)
+echo $((n + 1)) > {state}
+if [ "$n" -lt 2 ]; then
+  echo "Connection refused" >&2
+  exit 1
+fi
+echo "ok"
+""")
+    hadoop.chmod(0o755)
+    fs = HDFSClient(str(home),
+                    retry_policy=_fast_policy(max_attempts=5))
+    assert fs.mkdirs("/x") is None          # succeeded on 3rd attempt
+    assert (state.read_text().strip()) == "3"
+    n_before = len(calls.read_text().splitlines())
+    assert fs.is_exist("/nope") is False    # probe: exactly ONE call
+    assert len(calls.read_text().splitlines()) == n_before + 1
+
+
+def test_hdfs_permanent_error_fails_fast(tmp_path):
+    from paddle_tpu.distributed.fs import ExecuteError, HDFSClient
+    home = tmp_path / "hadoop"
+    (home / "bin").mkdir(parents=True)
+    calls = tmp_path / "calls.log"
+    hadoop = home / "bin" / "hadoop"
+    hadoop.write_text(f"""#!/bin/sh
+echo "$@" >> {calls}
+echo "ls: '/x': No such file or directory" >&2
+exit 1
+""")
+    hadoop.chmod(0o755)
+    fs = HDFSClient(str(home), retry_policy=_fast_policy(max_attempts=5))
+    with pytest.raises(ExecuteError, match="No such file"):
+        fs.ls_dir("/x")
+    assert len(calls.read_text().splitlines()) == 1
+
+
+def test_elastic_staleness_is_clock_skew_proof(tmp_path):
+    """A peer with a wildly wrong wall clock is judged by whether its
+    heartbeat PAYLOAD changes, on OUR monotonic clock."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    clk = FakeClock()
+    m = ElasticManager(str(tmp_path), np=2, host_id="0", timeout=5.0,
+                       fault_tolerance_level=1, clock=clk,
+                       sleep=clk.sleep)
+
+    def write_peer(ts):
+        with open(os.path.join(str(tmp_path), "host-1.json"), "w") as f:
+            f.write(json.dumps({"host": "1", "ts": ts, "np": 2}))
+
+    m.heartbeat()
+    write_peer(ts=9_999_999_999.0)      # clock an eon ahead
+    assert m.alive_hosts() == ["0", "1"]
+    clk.t += 4.0
+    assert m.alive_hosts() == ["0", "1"]   # unchanged, inside timeout
+    clk.t += 2.0                        # 6s since last change > 5s:
+    assert m.alive_hosts() == []        # BOTH stale (host 0 too — its
+    # own heartbeat ages on the same monotonic clock)
+    write_peer(ts=12.5)                 # peer clock jumped BACKWARD —
+    assert m.alive_hosts() == ["1"]     # a CHANGED payload == alive;
+    # the old `now - ts` check would have declared this host dead
+    # forever (ts eons behind) or immortal (ts eons ahead)
+
+
+def test_elastic_watch_sleeps_with_backoff(tmp_path):
+    from paddle_tpu.distributed.elastic import (ElasticManager,
+                                                ElasticStatus)
+    clk = FakeClock()
+    m = ElasticManager(str(tmp_path), np=1, host_id="0", timeout=8.0,
+                       heartbeat_interval=0.5, fault_tolerance_level=1,
+                       clock=clk, sleep=clk.sleep, backoff=2.0)
+    assert m.watch(max_checks=5) == ElasticStatus.HOLD
+    # 0.5 -> 1.0 -> 2.0 -> 4.0 (cap = timeout/2), never past the cap
+    assert clk.sleeps == [0.5, 1.0, 2.0, 4.0]
+    assert max(clk.sleeps) <= m.timeout / 2.0
+
+
+def test_specimen_is_rejected_with_leaf_named():
+    """The checked-in CI specimen must stay rejectable (the chaos-drill
+    selfcheck gates on it; this is the cheap in-suite guard)."""
+    specimen = os.path.join(REPO, "tools", "specimens", "ckpt_corrupt",
+                            "step_3")
+    probs = verify_checkpoint(specimen)
+    assert probs and any("leaf model.w" in p for p in probs)
+
+
+# =========================================================================
+# the full kill-and-resume drill (subprocess; slow)
+# =========================================================================
+
+@pytest.mark.slow
+def test_chaos_drill_kill_and_resume(tmp_path):
+    """SIGKILL mid-save -> auto-resume -> loss trajectory matches the
+    uninterrupted baseline step-for-step (the acceptance drill)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_drill.py"),
+         "--dir", str(tmp_path), "--steps", "6", "--kill-at", "3"],
+        capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "loss trajectory matches baseline exactly" in r.stdout
+    assert "fell back" in r.stdout

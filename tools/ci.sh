@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] native build =="
+echo "== [1/7] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +37,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/6] api-surface audit =="
+echo "== [2/7] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/6] graph doctor + framework lint =="
+echo "== [3/7] graph doctor + framework lint =="
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -56,7 +56,7 @@ JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
     --report /tmp/graphdoctor_ci.json
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 
-echo "== [4/6] training health + compile observatory gate =="
+echo "== [4/7] training health + compile observatory gate =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
@@ -83,12 +83,27 @@ JAX_PLATFORMS=cpu python tools/compile_report.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
     tools/specimens/compile_thrash.jsonl --expect-arg batch
 
-echo "== [5/6] test suite =="
+echo "== [5/7] resilience chaos drill =="
+# fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
+#   a) the checked-in corrupt-checkpoint specimen
+#      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
+#      verification with the offending leaf named — proof the verifier
+#      can still see the corruption it gates on — while a re-sealed
+#      clean copy must pass;
+#   b) a real mini train loop is SIGKILL'd right after step 3's async
+#      save kicks off (leaving an uncommitted .tmp husk), auto-resumed
+#      from the last committed step, and must finish with a loss
+#      trajectory bit-identical to an uninterrupted baseline, with
+#      ckpt.* metrics live on /metrics during the run and the kind=ckpt
+#      telemetry ledger validating under tools/trace_check.py.
+JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
+
+echo "== [6/7] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [6/6] op benchmark gate =="
+echo "== [7/7] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

@@ -18,8 +18,10 @@ exactly what CI gates on. Two modes:
 Step records (kind=step) run the rolling-window rules (NaN/Inf, loss
 spike, grad explosion, step-time regression — compile steps exempt);
 phase records (kind=phase, bench.py output) are checked for recorded
-errors and non-finite metrics. Detector knobs (--window, --z-loss,
---z-grad, --z-step-time, --min-points) mirror HealthConfig.
+errors and non-finite metrics; checkpoint records (kind=ckpt,
+paddle_tpu.resilience) run the checkpoint_failed / checkpoint_stall
+rules. Detector knobs (--window, --z-loss, --z-grad, --z-step-time,
+--min-points, --ckpt-stall-s) mirror HealthConfig.
 
 Exit codes: 0 clean / all expected families fired; 5 findings in gate
 mode; 9 an expected family did NOT fire (the watcher itself is broken).
@@ -59,6 +61,12 @@ def analyze_file(path, config):
             n_phase += 1
         elif kind == "step":
             n_step += 1
+        elif kind == "ckpt":
+            # checkpoint-lifecycle records (paddle_tpu.resilience):
+            # failed saves / corrupt-checkpoint fallbacks / slow commits
+            # replay through the same checkpoint_failed/checkpoint_stall
+            # rules the in-flight manager runs
+            pass
         else:
             continue
         det.observe(rec)
@@ -81,12 +89,13 @@ def main(argv=None):
     ap.add_argument("--z-loss", type=float, default=8.0)
     ap.add_argument("--z-grad", type=float, default=8.0)
     ap.add_argument("--z-step-time", type=float, default=8.0)
+    ap.add_argument("--ckpt-stall-s", type=float, default=300.0)
     args = ap.parse_args(argv)
 
     config = HealthConfig(
         action="record", window=args.window, min_points=args.min_points,
         z_loss=args.z_loss, z_grad=args.z_grad,
-        z_step_time=args.z_step_time)
+        z_step_time=args.z_step_time, ckpt_stall_s=args.ckpt_stall_s)
 
     all_anoms, all_problems = [], []
     per_file = {}

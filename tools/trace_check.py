@@ -21,7 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def check_metrics_jsonl(path):
-    """Returns (n_records, n_step_records, n_compile_records, problems).
+    """Returns (n_records, n_step_records, n_compile_records,
+    n_ckpt_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -32,8 +33,8 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, [f"{path}: empty metrics file (0 bytes): no "
-                             "step was ever recorded"]
+            return 0, 0, 0, 0, [f"{path}: empty metrics file (0 bytes): no "
+                                "step was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -44,18 +45,21 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
         for p in validate_step_record(rec):
             problems.append(f"{path}:{i + 1}: {p}")
     problems += check_compile_records(records, path)
+    problems += check_ckpt_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
                      if isinstance(r, dict) and r.get("kind") == "compile")
-    return len(records), n_steps, n_compiles, problems
+    n_ckpt = sum(1 for r in records
+                 if isinstance(r, dict) and r.get("kind") == "ckpt")
+    return len(records), n_steps, n_compiles, n_ckpt, problems
 
 
 def check_compile_records(records, path):
@@ -108,6 +112,57 @@ def check_compile_records(records, path):
     return problems
 
 
+def check_ckpt_records(records, path):
+    """Cross-record rules for checkpoint events (paddle_tpu.resilience;
+    per-record schema/vocabulary lives in sink.validate_step_record):
+
+    - per rank, COMMIT steps must be monotonic non-decreasing — the
+      atomic-commit protocol cannot legally land step 5 after step 9
+      within one ledger;
+    - every commit must be preceded by a save event for the same step
+      and rank — a commit the ledger never saw started is a producer
+      bug (or a doctored file);
+    - a restore/fallback must reference a step some commit in the file
+      landed, when any commits are present at all (a restore-only
+      ledger — a resumed process reading an older run's checkpoints —
+      is legitimate).
+    """
+    problems = []
+    last_commit = {}
+    saved = set()
+    committed = set()
+    any_commits = False
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "ckpt":
+            continue
+        rank = rec.get("rank", 0)
+        step = rec.get("step")
+        event = rec.get("event")
+        if not isinstance(step, (int, float)):
+            continue          # schema validation already flagged it
+        if event == "save":
+            saved.add((rank, step))
+        elif event == "commit":
+            any_commits = True
+            committed.add((rank, step))
+            if (rank, step) not in saved:
+                problems.append(
+                    f"{path}:{i + 1}: ckpt commit at step {step} "
+                    f"(rank {rank}) with no preceding save event")
+            prev = last_commit.get(rank)
+            if prev is not None and step < prev:
+                problems.append(
+                    f"{path}:{i + 1}: ckpt commit at step {step} after "
+                    f"one at step {prev} (rank {rank}, non-monotonic)")
+            last_commit[rank] = step
+        elif event in ("restore", "fallback") and any_commits and \
+                (rank, step) not in committed:
+            problems.append(
+                f"{path}:{i + 1}: ckpt {event} references step {step} "
+                f"(rank {rank}) that no commit in this ledger landed")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -145,9 +200,11 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, n_steps, n_compiles, problems = check_metrics_jsonl(jsonl_path)
+    n_rec, n_steps, n_compiles, n_ckpt, problems = \
+        check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
-             "n_compiles": n_compiles, "n_events": 0, "ranks": set()}
+             "n_compiles": n_compiles, "n_ckpt": n_ckpt,
+             "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -187,6 +244,8 @@ def main(argv):
     msg = f"OK: {stats['n_records']} records in {jsonl_path}"
     if stats.get("n_compiles"):
         msg += f" ({stats['n_compiles']} compile events)"
+    if stats.get("n_ckpt"):
+        msg += f" ({stats['n_ckpt']} ckpt events)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
