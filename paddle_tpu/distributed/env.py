@@ -147,3 +147,21 @@ def batch_sharding(mesh=None, seq_axis=False):
     if seq_axis and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         return NamedSharding(mesh, PartitionSpec("dp", "sp"))
     return NamedSharding(mesh, PartitionSpec("dp"))
+
+
+def trim_batch_sharding(arr, sh, mesh):
+    """Trim a batch Sharding to ONE array leaf: drop spec axes that
+    don't exist on / don't divide `arr`, so one batch Sharding serves
+    mixed-rank leaves. This is THE placement rule shared by
+    `sharded_train.shard_batch` and `io.prefetch`'s device stage — the
+    no-redundant-h2d fast path only fires when both sides compute the
+    identical target spec, so it must have exactly one owner."""
+    spec = getattr(sh, "spec", None)
+    if spec is None or mesh is None:
+        return sh
+    trimmed = list(spec)[:arr.ndim]
+    for i, a in enumerate(trimmed):
+        if a is not None and arr.shape[i] % mesh.shape[a] != 0:
+            trimmed[i] = None
+    trimmed += [None] * (arr.ndim - len(trimmed))
+    return NamedSharding(mesh, PartitionSpec(*trimmed))

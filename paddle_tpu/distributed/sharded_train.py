@@ -50,13 +50,22 @@ def shard_batch(batch, mesh=None, seq_axis=False):
     out = []
     for b in batch:
         v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
-        spec = list(sh.spec)
-        # only shard dims that exist & divide
-        spec = spec[:v.ndim]
-        for i, a in enumerate(spec):
-            if a is not None and v.shape[i] % mesh.shape[a] != 0:
-                spec[i] = None
-        out.append(jax.device_put(v, NamedSharding(mesh, PartitionSpec(*spec))))
+        # env.trim_batch_sharding is SHARED with io.prefetch's device
+        # stage: the no-redundant-h2d fast path below only fires when
+        # both sides compute the identical target spec
+        target = env.trim_batch_sharding(v, sh, mesh)
+        # already-resident fast path: a batch the input pipeline placed
+        # with the right sharding (io.prefetch_to_device with this mesh)
+        # must NOT pay a second h2d/reshard hop on the step hot path
+        cur = getattr(v, "sharding", None)
+        if isinstance(v, jax.Array) and cur is not None:
+            try:
+                if cur.is_equivalent_to(target, v.ndim):
+                    out.append(v)
+                    continue
+            except Exception:
+                pass
+        out.append(jax.device_put(v, target))
     return out
 
 

@@ -193,19 +193,28 @@ class Model:
         return [o.numpy() for o in _as_tuple(outs)]
 
     # ------------------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle):
+    def _loader(self, data, batch_size, shuffle, num_workers=0):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
-            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+            # num_workers rides through to the async prefetch pipeline
+            # (io.prefetch) — fit(num_workers=N) was previously accepted
+            # and silently ignored. Default worker_mode="auto" means
+            # THREADS sharing this one dataset object: a dataset with
+            # per-instance mutable state (own RandomState, file handle,
+            # parse buffer) must be wrapped in an explicit
+            # DataLoader(worker_mode="process") and passed in directly
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
         return data  # any iterable of batches
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        train_loader = self._loader(train_data, batch_size, shuffle)
-        eval_loader = self._loader(eval_data, batch_size, False)
+        train_loader = self._loader(train_data, batch_size, shuffle,
+                                    num_workers)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         cbks = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
             cbks.insert(0, ProgBarLogger(log_freq, verbose=verbose))
@@ -279,17 +288,22 @@ class Model:
         for m in self._metrics:
             logs.update(_metric_items(m))
         cblist.on_eval_end(logs)
+        # drop the eval loader's one-shot input-wait stats: the next
+        # recorded TRAIN step must not report this pass's fetch wait as
+        # its own (io.prefetch keeps a single process-global slot)
+        from ..io.prefetch import consume_step_input_stats
+        consume_step_input_stats()
         return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
-        loader = self._loader(eval_data, batch_size, False)
+        loader = self._loader(eval_data, batch_size, False, num_workers)
         cblist = CallbackList(callbacks or [], model=self, params={})
         return self._run_eval(loader, cblist)
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):
-        loader = self._loader(test_data, batch_size, False)
+        loader = self._loader(test_data, batch_size, False, num_workers)
         outputs = None
         for batch in loader:
             batch = batch if isinstance(batch, (list, tuple)) else [batch]

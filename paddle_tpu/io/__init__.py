@@ -5,6 +5,10 @@ from .dataloader import (  # noqa: F401
     RandomSampler, WeightedRandomSampler, BatchSampler,
     DistributedBatchSampler, DataLoader, default_collate_fn, get_worker_info,
 )
+from .prefetch import (  # noqa: F401
+    DeviceLoader, WorkerInfo, default_collate_numpy, device_put_batch,
+    prefetch_to_device,
+)
 from .serialization import save, load  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetBase, InMemoryDataset, QueueDataset, SlotDesc, dataset_factory,

@@ -258,33 +258,41 @@ def default_collate_fn(batch):
     return batch
 
 
-def _mp_worker_loop(dataset, index_q, result_q, worker_init_fn, wid):
-    """Worker PROCESS: fetch raw samples for each index batch; the parent
-    collates (keeps the pickle payload to raw numpy/py objects). Reference
-    analog: `fluid/dataloader/worker.py` _worker_loop."""
-    if worker_init_fn is not None:
-        worker_init_fn(wid)
-    while True:
-        job = index_q.get()
-        if job is None:
-            break
-        seq, indices = job
-        try:
-            samples = [dataset[i] for i in indices]
-            result_q.put((seq, samples, None))
-        except Exception as e:  # surface the worker error in the parent
-            result_q.put((seq, None, f"{type(e).__name__}: {e}"))
-
-
 class DataLoader:
     """Iterates a Dataset into device Tensors.
 
-    Map-style datasets with num_workers>0 fetch samples in real WORKER
-    PROCESSES (reference `fluid/dataloader/worker.py` semantics — python
-    transforms escape the GIL); batches are delivered in sampler order
-    regardless of worker completion order. Iterable datasets use a
-    background-thread prefetch pipeline (the reference's BufferedReader
-    double-buffering, `operators/reader/buffered_reader.h:36`).
+    Map-style datasets with num_workers>0 run an asynchronous prefetch
+    pipeline (io.prefetch): worker THREADS by default (the numpy decode
+    path releases the GIL), or real worker PROCESSES over a fork-safe
+    start method with shared-memory batch transport
+    (``worker_mode="process"``, picklable dataset required). Batches are
+    delivered in sampler order regardless of worker completion order,
+    so the stream is deterministic in num_workers for a fixed seed.
+    Iterable datasets use a background-thread prefetch pipeline (the
+    reference's BufferedReader double-buffering,
+    `operators/reader/buffered_reader.h:36`).
+
+    DEPRECATED (PR 6): the old fork-context worker pool is gone —
+    ``os.fork()`` under multithreaded JAX is a deadlock hazard
+    (BENCH_r04/r05 RuntimeWarning) — and ``worker_mode="fork"`` raises.
+    The constructor surface is otherwise unchanged;
+    ``use_shared_memory`` now gates the preallocated shared-memory slot
+    transport of process workers (ignored for threads).
+
+    BEHAVIOR CHANGE vs the fork pool: the default ``worker_mode="auto"``
+    runs worker THREADS that share ONE dataset object (the fork workers
+    each had a copy-on-write copy). A dataset with per-instance mutable
+    state (its own RandomState, parser buffers, file handles) must pass
+    ``worker_mode="process"`` to get per-worker copies back — thread
+    workers calling ``__getitem__`` concurrently on such a dataset race.
+
+    A ``persistent_workers`` loader supports ONE active iterator at a
+    time (they share the worker pool): starting a new epoch drains and
+    invalidates the previous iterator, whose ``next()`` then raises.
+
+    For training loops, wrap the loader in
+    ``io.prefetch_to_device(loader, sharding=...)`` to overlap the H2D
+    transfer with compute and land each dp shard directly on its device.
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -292,13 +300,19 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_mode="auto"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch = max(2, prefetch_factor)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self.worker_mode = worker_mode
+        self.device_sharding = None   # set by prefetch.DeviceLoader/callers
+        self._pool = None
+        self._active_iter = None      # weakref: persistent-workers guard
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -336,107 +350,115 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers == 0:
-            yield from self._batches()
-            return
-        if not self._iterable_mode:
-            import multiprocessing as mp
-            if "fork" in mp.get_all_start_methods():
-                # fork-context workers inherit the dataset — no pickling
-                # of the dataset object itself, so arbitrary python
-                # datasets work
-                yield from self._process_iter()
-                return
-            # no fork (Windows): thread prefetch below still works
-        # background-thread prefetch pipeline
+            return self._batches()
+        if self._iterable_mode:
+            return self._iterable_prefetch()
+        from .prefetch import MultiWorkerIterator, make_pool
+        if self.persistent_workers:
+            # one ACTIVE iterator at a time: two iterators sharing the
+            # persistent pool would steal each other's results off the
+            # single result queue and deadlock — drain and invalidate
+            # the previous one before feeding new jobs
+            prev = self._active_iter() if self._active_iter else None
+            if prev is not None:
+                prev._invalidate()
+        if self._pool is None or not self.persistent_workers:
+            self._pool = make_pool(self)
+        it = MultiWorkerIterator(self, self._pool)
+        if self.persistent_workers:
+            import weakref
+            self._active_iter = weakref.ref(it)
+        return it
+
+    def _iterable_prefetch(self):
+        """Iterable datasets: one background producer thread feeding a
+        bounded queue (backpressure = prefetch depth), waits recorded
+        for the flight recorder."""
+        import time as _time
+        from .prefetch import _WaitTracker
         q = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
+        err = []
 
         def producer():
             try:
                 for b in self._batches():
                     q.put(b)
+            except BaseException as e:
+                err.append(e)
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="paddle-io-iterable-prefetch")
         t.start()
+        wait = _WaitTracker()
         while True:
+            t0 = _time.perf_counter()
             item = q.get()
             if item is sentinel:
+                if err:
+                    raise err[0]
                 break
+            wait.fetched(_time.perf_counter() - t0, q.qsize())
             yield item
 
-    def _process_iter(self):
-        """Real worker processes; results reordered to sampler order.
-        Index feeding has backpressure (<= num_workers * prefetch jobs in
-        flight) and result waits poll worker liveness so a killed worker
-        raises instead of hanging."""
-        import multiprocessing as mp
-        import queue as _q
-        from .. import monitor
-        ctx = mp.get_context("fork")
-        index_q = ctx.Queue()
-        result_q = ctx.Queue()
-        workers = [ctx.Process(
-            target=_mp_worker_loop,
-            args=(self.dataset, index_q, result_q, self.worker_init_fn,
-                  wid),
-            daemon=True) for wid in range(self.num_workers)]
-        for w in workers:
-            w.start()
-        deadline = self.timeout or None
+    # -- hooks used by io.prefetch ---------------------------------------
+    def _leaf_transfer(self, sharding=None):
+        """Process-pool finalize hook: move one batch's ndarray leaves
+        (views into a shared-memory slot) onto the device and block
+        until the copy lands — the slot is recycled right after."""
+        from .prefetch import _leaf_put
+        import jax
+        put = _leaf_put(sharding)
+        # the CPU client zero-copy-aliases aligned host buffers instead
+        # of copying them; a device array aliasing a recycled slot is a
+        # use-after-unmap, so on host-resident backends the leaf must be
+        # copied out first. Real accelerators DMA the bytes to HBM —
+        # there the view-to-device_put path is the zero-copy win.
+        aliases_host = jax.default_backend() == "cpu"
+
+        def xfer(leaves):
+            if aliases_host:
+                leaves = [np.array(a) for a in leaves]
+            out = [put(a) for a in leaves]
+            if out:
+                jax.block_until_ready(out)
+            return out
+        return xfer
+
+    def _wrap_leaves(self, tree):
+        """Wrap array leaves of a worker-collated batch into Tensors so
+        process-worker output matches default_collate_fn's exactly."""
+        import jax
+
+        def wrap(node):
+            if isinstance(node, (np.ndarray, jax.Array)):
+                return Tensor(node)
+            if isinstance(node, tuple):
+                return tuple(wrap(x) for x in node)
+            if isinstance(node, list):
+                return [wrap(x) for x in node]
+            if isinstance(node, dict):
+                return {k: wrap(v) for k, v in node.items()}
+            return node
+        return wrap(tree)
+
+    def shutdown(self):
+        """Tear down persistent workers (idempotent)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown()
+
+    def __del__(self):
         try:
-            jobs = enumerate(self.batch_sampler)
-            n_sent = 0
-            n_jobs = len(self.batch_sampler)
-            exhausted = False
-
-            def feed(limit):
-                nonlocal n_sent, exhausted
-                while not exhausted and n_sent - next_seq < limit:
-                    try:
-                        seq, indices = next(jobs)
-                    except StopIteration:
-                        exhausted = True
-                        for _ in workers:
-                            index_q.put(None)
-                        return
-                    index_q.put((seq, list(indices)))
-                    n_sent += 1
-
-            pending = {}
-            next_seq = 0
-            limit = max(2, self.num_workers * self.prefetch)
-            feed(limit)
-            while next_seq < n_jobs:
-                if next_seq in pending:
-                    samples = pending.pop(next_seq)
-                    next_seq += 1
-                    feed(limit)
-                    monitor.incr("io.batches")
-                    yield self.collate_fn(samples)
-                    continue
-                try:
-                    seq, samples, err = result_q.get(
-                        timeout=deadline or 5.0)
-                except _q.Empty:
-                    dead = [w for w in workers if not w.is_alive()]
-                    if dead or deadline:
-                        raise RuntimeError(
-                            f"DataLoader worker(s) "
-                            f"{[w.pid for w in dead]} died or timed out "
-                            f"waiting {deadline or 5.0}s for batch "
-                            f"{next_seq}") from None
-                    continue
-                if err is not None:
-                    raise RuntimeError(f"DataLoader worker failed: {err}")
-                pending[seq] = samples
-        finally:
-            for w in workers:
-                w.terminate()
-            for w in workers:
-                w.join()
+            self.shutdown()
+        except Exception:
+            pass
 
 
 def get_worker_info():
-    return None
+    """Inside a worker (thread or process): that worker's WorkerInfo
+    (id, num_workers, seed, dataset); None in the main process."""
+    from .prefetch import get_worker_info as _gwi
+    return _gwi()

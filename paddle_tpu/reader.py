@@ -132,5 +132,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     """Degenerates to chain: single-controller JAX drives the chips from
     one process; real multiprocess loading lives in io.DataLoader's
-    fork workers."""
+    fork-safe spawn/forkserver workers (io.prefetch — os.fork() under
+    multithreaded JAX deadlocks, so it is never used here either)."""
     return chain(*readers)

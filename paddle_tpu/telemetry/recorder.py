@@ -419,6 +419,17 @@ class TelemetryRecorder:
         extra = dict(win.extra)
         cache_hits = extra.pop("cache_hits", self.cache_hits)
         cache_misses = extra.pop("cache_misses", self.cache_misses)
+        # input-pipeline taps (io.prefetch): the loader stashed the
+        # fetch-wait stats of the batch this step consumed; pop them
+        # one-shot so they land in THIS step's record only
+        try:
+            from ..io.prefetch import consume_step_input_stats
+            istats = consume_step_input_stats()
+        except Exception:
+            istats = None
+        if istats:
+            for k, v in istats.items():
+                extra.setdefault(k, v)
         rec = make_step_record(
             step=self._step_idx, step_ms=step_s * 1000.0,
             compile_ms=compile_ms, rank=self.rank, loss=loss_val,

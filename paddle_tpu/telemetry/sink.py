@@ -36,7 +36,12 @@ STEP_RECORD_KEYS = ("schema", "kind", "rank", "step", "step_ms",
 STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
                       "cache_hits", "cache_misses", "collectives",
                       "grad_norm", "update_ratio", "nan_count",
-                      "inf_count", "extra")
+                      "inf_count", "input_wait_ms", "input_queue_depth",
+                      "input_bound_frac", "extra")
+# input-pipeline fields (io.prefetch loader health taps: how long the
+# step blocked waiting for its batch, ready-queue depth at fetch, and
+# the EMA input-bound fraction — host-bound vs chip-bound as a number)
+INPUT_KEYS = ("input_wait_ms", "input_queue_depth", "input_bound_frac")
 # health-tap fields (telemetry.health numerics taps; None until a fetch
 # step lands them — they appear every k-th record when taps are on)
 HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
@@ -60,7 +65,9 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
                      cache_hits=None, cache_misses=None, collectives=None,
                      grad_norm=None, update_ratio=None, nan_count=None,
-                     inf_count=None, **extra):
+                     inf_count=None, input_wait_ms=None,
+                     input_queue_depth=None, input_bound_frac=None,
+                     **extra):
     """Normalize one step's measurements into the schema dict."""
     rec = {
         "schema": SCHEMA_VERSION,
@@ -94,6 +101,14 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
         rec["nan_count"] = int(nan_count)
     if inf_count is not None:
         rec["inf_count"] = int(inf_count)
+    # input-pipeline taps (io.prefetch): numeric, wait/depth >= 0, the
+    # bound fraction in [0, 1] — validated by tools/trace_check.py
+    if input_wait_ms is not None:
+        rec["input_wait_ms"] = round(float(input_wait_ms), 4)
+    if input_queue_depth is not None:
+        rec["input_queue_depth"] = int(input_queue_depth)
+    if input_bound_frac is not None:
+        rec["input_bound_frac"] = round(float(input_bound_frac), 4)
     if collectives:
         rec["collectives"] = {
             str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
@@ -172,6 +187,43 @@ def make_ckpt_record(event, step, rank=0, save_ms=None, bytes=None,  # noqa: A00
         rec["save_ms"] = round(float(save_ms), 4)
     if bytes is not None:
         rec["bytes"] = int(bytes)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
+BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
+
+
+def make_bench_record(metric, value, unit=None, rank=0, device=None,
+                      bench_round=None, baseline=None, **extra):
+    """One benchmark RESULT as a first-class typed record (kind='bench')
+    — the perf-regression gate's unit of account (tools/bench_gate.py).
+    Distinct from kind='phase' (a phase's raw metric dict): a bench
+    record is one tracked scalar with its identity (metric name, device,
+    round) so baselines diff record-against-record. Non-finite values
+    are kept as None + an error note (the gate fails them loudly)."""
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "bench",
+        "rank": int(rank),
+        "metric": str(metric),
+    }
+    bad = isinstance(value, float) and (value != value or
+                                        value in (float("inf"),
+                                                  float("-inf")))
+    rec["value"] = None if bad or value is None else float(value)
+    if bad:
+        rec["error"] = f"non-finite value {value!r}"
+    if unit is not None:
+        rec["unit"] = str(unit)
+    if device is not None:
+        rec["device"] = str(device)
+    if bench_round is not None:
+        rec["round"] = int(bench_round)
+    if baseline is not None:
+        rec["baseline"] = float(baseline)
     for k, v in extra.items():
         if v is not None:
             rec[k] = v
@@ -284,6 +336,20 @@ def validate_step_record(rec):
                                   not all(isinstance(c, str) for c in cause)):
             problems.append(f"'cause' not a list of strings: {cause!r}")
         return problems
+    if kind == "bench":
+        for key in BENCH_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"bench record missing '{key}'")
+        v = rec.get("value")
+        if v is not None and not isinstance(v, (int, float)):
+            problems.append(f"'value' not numeric: {v!r}")
+        if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                     float("-inf"))):
+            problems.append(f"'value' non-finite: {v!r}")
+        if v is None and "error" not in rec:
+            problems.append("bench record with null value carries no "
+                            "'error' note")
+        return problems
     if kind == "ckpt":
         for key in CKPT_RECORD_KEYS:
             if key not in rec:
@@ -320,6 +386,15 @@ def validate_step_record(rec):
         v = rec.get(key)
         if v is not None and not isinstance(v, (int, float)):
             problems.append(f"'{key}' not numeric: {v!r}")
+    for key in INPUT_KEYS:
+        v = rec.get(key)
+        if v is None:
+            continue
+        if not isinstance(v, (int, float)) or v != v or v < 0:
+            problems.append(
+                f"'{key}' not a non-negative number: {v!r}")
+        elif key == "input_bound_frac" and v > 1.0:
+            problems.append(f"'input_bound_frac' above 1.0: {v!r}")
     return problems
 
 

@@ -7,12 +7,14 @@ ResNet-50 (config 2, the conv/BN path at its REAL depth) and BERT
 fine-tune (config 3, attention + LayerNorm + pooler head).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
 import paddle_tpu.nn.functional as F
 
 
+@pytest.mark.slow  # ~26s: real-depth ResNet-50 compile dominates tier-1 wall clock
 def test_resnet50_train_step_real_depth():
     """Config 2: the actual 50-layer bottleneck network (not a proxy)
     takes a fwd+bwd+Momentum step with finite loss and updated params

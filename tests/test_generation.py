@@ -30,6 +30,7 @@ def _naive_greedy(m, ids, n):
     return cur
 
 
+@pytest.mark.slow  # ~15s: compiles both the cached and full-forward decoders
 def test_greedy_cache_matches_full_forward(tiny_gpt):
     """The KV-cache prefill+decode path must reproduce the full-forward
     argmax sequence exactly."""

@@ -206,6 +206,7 @@ def test_loss_kl_hinge():
                [a])
 
 
+@pytest.mark.slow  # ~12s: CTC grad-check sweeps many alignments
 def test_loss_ctc():
     """CTC loss grad vs numeric — the hardest loss in the family
     (dynamic-programming forward, reference `warpctc_op.cc`)."""

@@ -72,6 +72,7 @@ def test_md5_check_rejects_corruption(tmp_path):
     assert os.path.exists(str(bad) + ".md5")
 
 
+@pytest.mark.slow  # ~22s ResNet roundtrip
 def test_resnet_pretrained_roundtrip_accuracy(tmp_path):
     """ResNet classification with real weights through the pretrained
     path: train -> save as <arch>.pdparams -> load via

@@ -39,6 +39,7 @@ def test_ctc_loss_matches_torch():
     assert np.allclose(x.grad.numpy(), tl.grad.numpy(), atol=1e-4)
 
 
+@pytest.mark.slow  # ~15s CRNN overfit loop
 def test_crnn_shapes_and_overfit():
     paddle.seed(0)
     model = CRNN(in_channels=1, num_classes=11, hidden=16, rnn_hidden=24)

@@ -83,6 +83,7 @@ def test_offload_second_update_uses_updated_state():
                                    atol=3e-5, err_msg=n1)
 
 
+@pytest.mark.slow  # ~20s of host-callback offload round-trips
 def test_offload_bf16_params_with_master():
     """param_dtype=bfloat16 + multi_precision AdamW: the f32 master rides
     the host state, updates accumulate at full precision (loss stays
@@ -126,6 +127,7 @@ def test_remat_flag_matches_no_remat():
     np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # ~20s: full save/restore through the offload path
 def test_offload_checkpoint_roundtrip(tmp_path):
     """Checkpoint/resume across host-resident optimizer state: train,
     save (params + optimizer state_dict), rebuild, load, continue — the

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a bench run's typed kind='bench' records
+against the checked-in rolling baseline and fail on any tracked-metric
+loss beyond the threshold.
+
+BENCH history used to accumulate as untyped JSON blobs nobody gated —
+throughput silently plateaued for two rounds (ROADMAP). bench.py now
+writes every tracked scalar through the telemetry sink as a typed
+record (telemetry.sink.make_bench_record); this tool is the other half:
+
+    # gate mode: compare a run against the rolling baseline
+    python tools/bench_gate.py bench_telemetry.jsonl \
+        --baseline tools/bench_baseline.json
+
+    # selfcheck mode: the checked-in regressed specimen must FAIL the
+    # gate (every injected defect family detected), and a clean run
+    # synthesized from the baseline itself must PASS — proof the gate
+    # can still see what it gates on (the graphdoctor pattern)
+    python tools/bench_gate.py --selfcheck
+
+    # after an ACCEPTED perf change: roll the baseline forward
+    python tools/bench_gate.py run.jsonl --update-baseline \
+        tools/bench_baseline.json
+
+Rules per baseline metric (latest record wins when a metric repeats):
+  - direction 'higher' (throughput/MFU/speedup/TFLOPs): fail when
+    value < baseline * (1 - threshold);
+  - direction 'lower' (latency ms): fail when
+    value > baseline * (1 + threshold);
+  - direction 'info': recorded, never gated (e.g. param counts);
+  - a tracked metric MISSING from the run fails (a metric silently
+    dropped from bench.py is itself a regression of the gate);
+  - a null-valued record (bench.py writes value=null + an error note
+    for non-finite measurements) fails loudly.
+Records whose 'device' differs from the baseline's are skipped with a
+note: the CPU smoke bench must not be judged against TPU numbers.
+
+Step records (kind=step) in the same file replay through the PR-3
+AnomalyDetector's step_time_regression rule (compile steps exempt), so
+an in-run slowdown the aggregate average hides is also a finding.
+
+Exit codes: 0 pass; 4 regression findings; 9 selfcheck miss (the gate
+itself is broken). Distinct from trace_check 7 / healthwatch 5 /
+compile_report 6 / chaos_drill 8 so CI logs disambiguate.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "bench_baseline.json")
+SPECIMEN = os.path.join(REPO, "tools", "specimens", "bench_regressed.jsonl")
+
+
+def load_baseline(path):
+    with open(path) as f:
+        base = json.load(f)
+    for key in ("device", "metrics"):
+        if key not in base:
+            raise ValueError(f"baseline {path} missing '{key}'")
+    return base
+
+
+def load_bench_records(path):
+    """-> ({metric: record}, step_records, problems). Latest record per
+    metric wins (the file is an append-only rolling log)."""
+    from paddle_tpu.telemetry.sink import read_jsonl, validate_step_record
+
+    problems = []
+    try:
+        records = read_jsonl(path)
+    except (OSError, json.JSONDecodeError) as e:
+        return {}, [], [f"{path}: unreadable: {e}"]
+    if not records:
+        return {}, [], [f"{path}: no records — bench telemetry never wrote"]
+    bench, steps = {}, []
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind == "bench":
+            for p in validate_step_record(rec):
+                problems.append(f"{path}:{i + 1}: {p}")
+            bench[str(rec.get("metric"))] = rec
+        elif kind == "step":
+            steps.append(rec)
+    if not bench:
+        problems.append(f"{path}: no kind='bench' records — bench.py did "
+                        "not route results through the telemetry sink")
+    return bench, steps, problems
+
+
+def compare(bench, baseline, threshold):
+    """-> (findings, notes). A finding is a dict with kind in
+    {'regression', 'missing_metric', 'null_value'}."""
+    findings, notes = [], []
+    dev = baseline["device"]
+    n_compared = 0
+    for name, spec in baseline["metrics"].items():
+        direction = spec.get("direction", "higher")
+        rec = bench.get(name)
+        if rec is None:
+            findings.append({
+                "kind": "missing_metric", "metric": name,
+                "detail": f"tracked metric '{name}' absent from the run"})
+            continue
+        rdev = rec.get("device")
+        if rdev is not None and rdev != dev:
+            notes.append(f"{name}: device {rdev!r} != baseline {dev!r}: "
+                         "comparison skipped")
+            continue
+        value = rec.get("value")
+        if value is None:
+            findings.append({
+                "kind": "null_value", "metric": name,
+                "detail": f"'{name}' recorded null "
+                          f"({rec.get('error', 'no error note')})"})
+            continue
+        if direction == "info":
+            notes.append(f"{name}: {value} (info, not gated)")
+            continue
+        base_v = float(spec["value"])
+        thr = float(spec.get("threshold", threshold))
+        n_compared += 1
+        if direction == "lower":
+            bad = value > base_v * (1.0 + thr)
+            delta = (value - base_v) / base_v if base_v else 0.0
+        else:
+            bad = value < base_v * (1.0 - thr)
+            delta = (base_v - value) / base_v if base_v else 0.0
+        if bad:
+            findings.append({
+                "kind": "regression", "metric": name, "value": value,
+                "baseline": base_v, "direction": direction,
+                "detail": f"'{name}' {value} vs baseline {base_v} "
+                          f"({delta:+.1%} worse, threshold {thr:.0%})"})
+    if n_compared == 0 and not findings:
+        notes.append(f"0 comparable metrics for device {dev!r}: value "
+                     "gate vacuous (schema checks still applied)")
+    return findings, notes
+
+
+def replay_step_regression(steps, window=64, min_points=8, z=8.0):
+    """PR-3 step_time_regression rule replayed offline over the run's
+    own step records (compile steps exempt inside the detector)."""
+    if not steps:
+        return []
+    from paddle_tpu.telemetry.health import AnomalyDetector, HealthConfig
+    det = AnomalyDetector(HealthConfig(
+        action="record", window=window, min_points=min_points,
+        z_step_time=z))
+    for rec in steps:
+        det.observe(rec)
+    return [{"kind": "step_time_regression", "metric": "step_ms",
+             "detail": a.message}
+            for a in det.anomalies if a.kind == "step_time_regression"]
+
+
+def run_gate(path, baseline_path, threshold, quiet=False):
+    """-> (findings, problems). Prints a report unless quiet."""
+    baseline = load_baseline(baseline_path)
+    bench, steps, problems = load_bench_records(path)
+    findings, notes = compare(bench, baseline, threshold)
+    findings += replay_step_regression(steps)
+    if not quiet:
+        for n in notes:
+            print(f"# {n}")
+        for p in problems:
+            print(f"PROBLEM: {p}")
+        for f in findings:
+            print(f"FAIL[{f['kind']}]: {f['detail']}")
+        ok = not findings and not problems
+        print(f"bench_gate: {len(bench)} bench record(s), "
+              f"{len(steps)} step record(s), {len(findings)} finding(s), "
+              f"{len(problems)} schema problem(s) -> "
+              f"{'OK' if ok else 'FAIL'}")
+    return findings, problems
+
+
+def update_baseline(path, out, device=None, threshold=None):
+    """Roll the baseline forward from a run's bench records. Directions
+    are inferred: *_ms -> lower, *params* -> info, else higher."""
+    bench, _, problems = load_bench_records(path)
+    if problems:
+        for p in problems:
+            print(f"PROBLEM: {p}")
+        return 4
+    # a null (non-finite) value must never roll into the baseline: the
+    # metric would silently vanish from gate coverage — the exact
+    # silent-plateau failure mode this gate exists to prevent
+    nulls = sorted(n for n, r in bench.items() if r.get("value") is None)
+    if nulls:
+        print(f"REFUSED: null value(s) in {nulls}; fix the run before "
+              "rolling the baseline forward")
+        return 4
+    metrics = {}
+    dev = device
+    for name, rec in sorted(bench.items()):
+        if rec.get("value") is None:
+            continue
+        dev = dev or rec.get("device")
+        if name.endswith("_ms"):
+            direction = "lower"
+        elif "params" in name:
+            direction = "info"
+        else:
+            direction = "higher"
+        spec = {"value": rec["value"], "direction": direction}
+        if rec.get("unit"):
+            spec["unit"] = rec["unit"]
+        metrics[name] = spec
+    base = {"device": dev or "unknown", "metrics": metrics}
+    if threshold is not None:
+        base["threshold"] = threshold
+    with open(out, "w") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"baseline written: {out} ({len(metrics)} metrics, "
+          f"device {base['device']!r})")
+    return 0
+
+
+def selfcheck(baseline_path, threshold):
+    """The regressed specimen must FAIL with every injected defect
+    family; a clean run synthesized from the baseline must PASS."""
+    baseline = load_baseline(baseline_path)
+    rc = 0
+
+    # leg 1: the checked-in regressed specimen fires every family
+    findings, problems = run_gate(SPECIMEN, baseline_path, threshold,
+                                  quiet=True)
+    fired = {f["kind"] for f in findings}
+    expected = {"regression", "missing_metric", "null_value"}
+    missed = expected - fired
+    if missed:
+        print(f"SELFCHECK MISS: specimen did not trip {sorted(missed)} "
+              f"(fired: {sorted(fired)})")
+        rc = 9
+    else:
+        print(f"selfcheck leg 1 OK: specimen tripped {sorted(expected)} "
+              f"({len(findings)} findings)")
+
+    # leg 2: a run that exactly matches the baseline must pass
+    from paddle_tpu.telemetry.sink import make_bench_record
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl", delete=False) \
+            as f:
+        clean = f.name
+        for name, spec in baseline["metrics"].items():
+            rec = make_bench_record(name, spec["value"],
+                                    unit=spec.get("unit"),
+                                    device=baseline["device"])
+            f.write(json.dumps(rec) + "\n")
+    try:
+        findings, problems = run_gate(clean, baseline_path, threshold,
+                                      quiet=True)
+        if findings or problems:
+            print("SELFCHECK MISS: baseline-identical run failed the "
+                  f"gate: {findings or problems}")
+            rc = 9
+        else:
+            print("selfcheck leg 2 OK: baseline-identical run passes")
+    finally:
+        os.unlink(clean)
+    if rc == 0:
+        print("bench_gate selfcheck OK")
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", help="bench telemetry JSONL")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="max tolerated fractional loss (default: the "
+                         "baseline file's, else 0.08)")
+    ap.add_argument("--selfcheck", action="store_true")
+    ap.add_argument("--update-baseline", metavar="OUT", default=None,
+                    help="write a fresh baseline from PATH's records")
+    args = ap.parse_args(argv)
+
+    baseline_thr = 0.08
+    if os.path.exists(args.baseline):
+        try:
+            baseline_thr = load_baseline(args.baseline).get("threshold",
+                                                            0.08)
+        except (OSError, ValueError):
+            pass
+    threshold = args.threshold if args.threshold is not None \
+        else baseline_thr
+
+    if args.selfcheck:
+        return selfcheck(args.baseline, threshold)
+    if not args.path:
+        ap.error("PATH required unless --selfcheck")
+    if args.update_baseline:
+        return update_baseline(args.path, args.update_baseline,
+                               threshold=threshold)
+    findings, problems = run_gate(args.path, args.baseline, threshold)
+    return 4 if (findings or problems) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,8 +67,21 @@ echo "== [4/7] training health + compile observatory gate =="
 #      proof the watcher can still see what it gates on (the
 #      graphdoctor selfcheck pattern).
 rm -f /tmp/bench_health_ci.jsonl   # the sink appends; stale phases lie
+# stderr to a plain file (no tee process substitution: bash would not
+# wait for it, and the fork grep below could race an unflushed log)
 JAX_PLATFORMS=cpu python bench.py --cpu \
-    --telemetry /tmp/bench_health_ci.jsonl > /tmp/bench_health_ci.json
+    --telemetry /tmp/bench_health_ci.jsonl > /tmp/bench_health_ci.json \
+    2> /tmp/bench_health_ci.err \
+    || { cat /tmp/bench_health_ci.err >&2
+         echo "FATAL: smoke bench failed"; exit 1; }
+cat /tmp/bench_health_ci.err >&2
+# fork-safety gate (PR 6): os.fork() under the multithreaded JAX parent
+# is a real deadlock hazard (the BENCH_r04/r05 RuntimeWarning) — the
+# io.prefetch rebuild removed every fork, and this grep keeps it removed
+if grep -E "os\.fork" /tmp/bench_health_ci.err; then
+  echo "FATAL: os.fork() under multithreaded JAX reappeared in the bench log"
+  exit 1
+fi
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \
@@ -82,6 +95,16 @@ JAX_PLATFORMS=cpu python tools/healthwatch.py \
 JAX_PLATFORMS=cpu python tools/compile_report.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
     tools/specimens/compile_thrash.jsonl --expect-arg batch
+# perf-regression gate (tools/bench_gate.py), same two-sided pattern:
+#   a) the checked-in REGRESSED specimen must fail the gate with every
+#      injected defect family (value regression, missing tracked
+#      metric, null value) and a baseline-identical run must pass;
+#   b) the smoke bench's typed kind=bench records must gate clean
+#      against the rolling baseline (CPU records are device-skipped —
+#      the value gate binds on the bench host — but schema problems or
+#      a missing record stream still fail).
+JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
+JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
 echo "== [5/7] resilience chaos drill =="
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):

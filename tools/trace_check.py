@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
-    n_ckpt_records, problems).
+    n_ckpt_records, n_bench_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -33,8 +33,8 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, [f"{path}: empty metrics file (0 bytes): no "
-                                "step was ever recorded"]
+            return 0, 0, 0, 0, 0, [f"{path}: empty metrics file (0 bytes): "
+                                   "no step was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -45,7 +45,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -53,13 +53,16 @@ def check_metrics_jsonl(path):
             problems.append(f"{path}:{i + 1}: {p}")
     problems += check_compile_records(records, path)
     problems += check_ckpt_records(records, path)
+    problems += check_bench_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
                      if isinstance(r, dict) and r.get("kind") == "compile")
     n_ckpt = sum(1 for r in records
                  if isinstance(r, dict) and r.get("kind") == "ckpt")
-    return len(records), n_steps, n_compiles, n_ckpt, problems
+    n_bench = sum(1 for r in records
+                  if isinstance(r, dict) and r.get("kind") == "bench")
+    return len(records), n_steps, n_compiles, n_ckpt, n_bench, problems
 
 
 def check_compile_records(records, path):
@@ -163,6 +166,39 @@ def check_ckpt_records(records, path):
     return problems
 
 
+def check_bench_records(records, path):
+    """Cross-record rules for typed bench results (kind=bench, the
+    perf-regression gate's input — see tools/bench_gate.py):
+
+    - metric names must be non-empty (an unnamed result can never be
+      gated against a baseline);
+    - the same metric for the same device/round must not repeat with
+      DIFFERENT units — the gate diffs values record-against-record and
+      a silent unit flip would fake a 1000x regression or win.
+
+    Per-record shape (value numeric/null, null carries an error note)
+    is already enforced by sink.validate_step_record.
+    """
+    problems = []
+    units = {}
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "bench":
+            continue
+        metric = rec.get("metric")
+        if not metric or not str(metric).strip():
+            problems.append(f"{path}:{i + 1}: bench record with empty "
+                            "metric name")
+            continue
+        key = (str(metric), rec.get("device"), rec.get("round"))
+        unit = rec.get("unit")
+        if key in units and units[key] != unit:
+            problems.append(
+                f"{path}:{i + 1}: bench metric {metric!r} repeats with "
+                f"unit {unit!r} after {units[key]!r}")
+        units[key] = unit
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -200,11 +236,11 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, n_steps, n_compiles, n_ckpt, problems = \
+    n_rec, n_steps, n_compiles, n_ckpt, n_bench, problems = \
         check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
-             "n_events": 0, "ranks": set()}
+             "n_bench": n_bench, "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -246,6 +282,8 @@ def main(argv):
         msg += f" ({stats['n_compiles']} compile events)"
     if stats.get("n_ckpt"):
         msg += f" ({stats['n_ckpt']} ckpt events)"
+    if stats.get("n_bench"):
+        msg += f" ({stats['n_bench']} bench results)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
