@@ -29,6 +29,15 @@ Rules (family SH):
                            PartitionSpec allows it, the mesh_axes apply
                            path does not (it drops the entry wholesale,
                            replicating the tensor).
+- SH208 rule-coverage    — over a regex partition-rule set (the
+                           planner's placement-as-data form,
+                           `paddle_tpu.planner.rules`): a rule whose
+                           pattern matches no parameter (dead rule — a
+                           typo'd pattern silently stops sharding what
+                           it was written for), and a parameter no rule
+                           matches, which falls through to fully
+                           replicated under a sharded layout
+                           (emitted by `lint_partition_rules`).
 
 `project_hbm` reports the projected per-device bytes for params, a
 same-size gradient, and the optimizer states under the given mesh and
@@ -165,6 +174,67 @@ def lint_model_sharding(model_or_named, mesh, zero_stage=0,
                     "full copy",
                     suggestion="pad a dim to a multiple of the dp size "
                                "or accept the replication explicitly"))
+    return findings
+
+
+def lint_partition_rules(rules, model_or_named, mesh,
+                         large_param_bytes=LARGE_PARAM_BYTES):
+    """SH208 partition-rule coverage, both directions.
+
+    `rules` is an ordered [(regex, axes)] list matched against dotted
+    parameter names, first match wins (`planner.rules` semantics).
+    Scalar/size-1 parameters are exempt from the fall-through direction
+    (never worth sharding, replicating them is not a decision anyone
+    needs to record) but still count as a rule's match.
+
+    - direction 1 (param -> no rule): under a sharded layout (any mesh
+      axis > 1) a parameter no rule matches silently replicates on
+      every rank — an ERROR for large parameters, a warning otherwise.
+    - direction 2 (rule -> no param): a pattern matching NO parameter
+      name is a dead rule — whatever it was written to shard is NOT
+      being sharded (renamed parameter, typo'd regex). Deliberately
+      order-independent: a catch-all shadowed by earlier, more
+      specific rules still matches names and is not dead. Always a
+      warning: the rule set may legitimately span model families.
+    """
+    import re
+
+    findings = []
+    named = _named_params(model_or_named)
+    sharded = any(int(mesh.shape[a]) > 1 for a in mesh.axis_names)
+    rule_hit = [False] * len(rules)
+    for name, p in named:
+        shape = tuple(p._value.shape) if hasattr(p, "_value") \
+            else tuple(p.shape)
+        nelem = int(np.prod(shape or (1,)))
+        matched = False
+        for i, (pattern, _axes) in enumerate(rules):
+            if re.search(pattern, name):
+                rule_hit[i] = True
+                matched = True
+        if matched or not shape or nelem <= 1:
+            continue
+        if sharded:
+            nbytes = nelem * np.dtype(
+                getattr(p._value if hasattr(p, "_value") else p,
+                        "dtype", np.float32)).itemsize
+            sev = SEV_ERROR if nbytes >= large_param_bytes else SEV_WARNING
+            findings.append(Finding(
+                "SH208", sev, name,
+                f"no partition rule matches '{name}' (shape {shape}, "
+                f"{nbytes / 1e6:.1f} MB): it silently falls through to "
+                "fully replicated on every rank of the sharded layout",
+                suggestion="add a rule for it, or an explicit "
+                           "catch-all ('.*', ()) so the replication "
+                           "is a recorded decision"))
+    for i, ((pattern, _axes), hit) in enumerate(zip(rules, rule_hit)):
+        if not hit:
+            findings.append(Finding(
+                "SH208", SEV_WARNING, f"rule[{i}] {pattern!r}",
+                f"partition rule {pattern!r} matches no parameter: a "
+                "dead rule — whatever it was written to shard is not "
+                "being sharded (typo'd pattern or renamed parameters)",
+                suggestion="fix the pattern or delete the rule"))
     return findings
 
 

@@ -34,6 +34,8 @@ from .pipeline import (  # noqa: F401
     PipelineParallel, pipeline_apply, pipeline_apply_tensors,
     pipeline_train_step_1f1b, pipeline_train_step_interleaved,
 )
+# memory planner lives in paddle_tpu.planner now (auto-sharding search
+# + Graph Doctor verification); .planner is the back-compat shim
 from .planner import (gpt_memory_plan, MemoryPlan, HBM_BYTES,  # noqa: F401
                       search_plan)
 from .recompute import recompute  # noqa: F401

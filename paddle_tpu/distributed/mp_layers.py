@@ -17,6 +17,13 @@ from ..core.tensor import Tensor, apply
 from ..nn import Layer
 from ..nn import functional as F
 from ..nn.initializer import XavierUniform, Normal, Constant
+# canonical Megatron placement tuples — ONE owner shared with the
+# auto-sharding planner's regex partition rules, so a tag change here
+# cannot silently diverge from what plan() projects and verifies
+from ..planner.rules import (
+    COLUMN_PARALLEL_BIAS_AXES, COLUMN_PARALLEL_WEIGHT_AXES,
+    ROW_PARALLEL_WEIGHT_AXES, VOCAB_PARALLEL_WEIGHT_AXES,
+)
 from . import env
 
 
@@ -67,7 +74,7 @@ class VocabParallelEmbedding(Layer):
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 0.02))
-        self.weight.mesh_axes = ("mp", None)
+        self.weight.mesh_axes = VOCAB_PARALLEL_WEIGHT_AXES
 
     def forward(self, x):
         return F.embedding(x, self.weight)
@@ -81,11 +88,11 @@ class ColumnParallelLinear(Layer):
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=XavierUniform())
-        self.weight.mesh_axes = (None, "mp")
+        self.weight.mesh_axes = COLUMN_PARALLEL_WEIGHT_AXES
         self.bias = self.create_parameter([out_features], is_bias=True) \
             if has_bias else None
         if self.bias is not None:
-            self.bias.mesh_axes = ("mp",)
+            self.bias.mesh_axes = COLUMN_PARALLEL_BIAS_AXES
         self.gather_output = gather_output
 
     def forward(self, x):
@@ -103,7 +110,7 @@ class RowParallelLinear(Layer):
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=XavierUniform())
-        self.weight.mesh_axes = ("mp", None)
+        self.weight.mesh_axes = ROW_PARALLEL_WEIGHT_AXES
         self.bias = self.create_parameter([out_features], is_bias=True) \
             if has_bias else None
         self.input_is_parallel = input_is_parallel

@@ -778,6 +778,32 @@ class PipelineParallel(Layer):
         self.resilience = None
         self._resilience_mgr = None
         self._resilience_key = None
+        # auto-sharding planner wiring (attribute-style like self.lint):
+        # apply_plan(plan) configures the schedule from a verified plan
+        self.plan = None
+
+    def apply_plan(self, plan):
+        """Configure the pipeline from a `paddle_tpu.planner.Plan`:
+        validates that the process mesh's pp axis matches the plan's
+        pipeline degree (a schedule built for pp=4 silently falling
+        back to sequential accumulation on a pp=1 mesh is exactly the
+        kind of drift the planner exists to kill) and raises the
+        microbatch count to the plan's 1F1B in-flight bound so the
+        bubble the cost model priced is the bubble the schedule runs.
+        Returns self."""
+        from . import env
+        mesh = env.current_mesh()
+        pp = int(plan.layout.pp)
+        if mesh is not None:
+            have = int(mesh.shape["pp"]) if "pp" in mesh.axis_names else 1
+            if have != pp:
+                raise ValueError(
+                    f"plan {plan.layout.describe()} wants pp={pp} but "
+                    f"the process mesh has pp={have} — build the mesh "
+                    "with plan.build_mesh() first")
+        self._num_micro = max(self._num_micro, 2 * pp if pp > 1 else 1)
+        self.plan = plan
+        return self
 
     def _resilience_manager(self):
         """Normalize+cache self.resilience (attribute-style hook)."""

@@ -27,10 +27,16 @@ from ..jit import bind_tensors
 from . import env
 
 
-def shard_model(model, mesh=None):
+def shard_model(model, mesh=None, rules=None):
     """Place every parameter/buffer according to its mesh_axes tag
     (replicated if untagged). The analog of
-    `fleet.distributed_model` (`fleet_base.py:881`)."""
+    `fleet.distributed_model` (`fleet_base.py:881`). `rules` optionally
+    tags untagged parameters first from a regex partition-rule list
+    (`paddle_tpu.planner.rules` — planner output instead of
+    hand-written per-layer tags)."""
+    if rules is not None:
+        from ..planner.rules import apply_partition_rules
+        apply_partition_rules(model, rules)
     mesh = mesh or env.current_mesh()
     for n, p in model.named_parameters():
         if p is None:
@@ -93,9 +99,37 @@ class ShardedTrainStep:
     DistributedStrategy when the optimizer is fleet-wrapped."""
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=None,
-                 seq_shard_batch=False, donate=True, offload=None,
-                 lint=False, health=None, resilience=None):
+                 seq_shard_batch=None, donate=True, offload=None,
+                 lint=False, health=None, resilience=None, plan=None):
+        # auto-sharding planner wiring: a paddle_tpu.planner.Plan (or
+        # anything carrying .layout/.rules) configures zero_stage /
+        # seq_shard_batch and re-tags untagged params from its verified
+        # partition rules; explicit kwargs win over the plan's values
+        self.plan = plan
         self.mesh = mesh or env.current_mesh()
+        if plan is not None:
+            # validate the mesh BEFORE touching the model: a rejected
+            # plan must not leave its tags behind
+            if self.mesh is not None:
+                want = plan.layout.mesh_shape()
+                have = {a: int(self.mesh.shape[a])
+                        for a in self.mesh.axis_names}
+                bad = {a: (s, have.get(a, 1)) for a, s in want.items()
+                       if have.get(a, 1) != s}
+                if bad:
+                    raise ValueError(
+                        f"mesh does not match the plan's layout "
+                        f"{plan.layout.describe()}: axis sizes differ on "
+                        f"{bad} — build the mesh with plan.build_mesh() "
+                        "or pass the matching mesh")
+            if zero_stage is None:
+                zero_stage = int(plan.layout.zero_stage)
+            if seq_shard_batch is None:
+                seq_shard_batch = plan.layout.sp > 1
+            from ..planner.rules import apply_partition_rules
+            apply_partition_rules(model, plan.rules)
+        if seq_shard_batch is None:
+            seq_shard_batch = False
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer

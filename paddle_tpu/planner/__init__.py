@@ -1,0 +1,54 @@
+"""paddle_tpu.planner — the auto-sharding planner.
+
+Promotes the hand-enumerated multichip plans (formerly
+`distributed/planner.py`, still importable from there as a shim) into
+a cost-model-driven layout search that is STATICALLY verified: every
+candidate `plan()` returns has passed the Graph Doctor battery
+(`analysis.sharding_lint` SH201–SH208 with per-device HBM projection,
+`analysis.jaxpr_lint` over a traced-never-executed step,
+`analysis.collective_order` capture) with zero findings — before
+anything compiles, places, or executes.
+
+Layers:
+
+- `memory`  — per-chip HBM arithmetic (params/grads/opt/activations,
+              ZeRO + remat aware) and the legacy `search_plan`.
+- `rules`   — parameter placement as regex partition rules
+              (`match_partition_rules` / `parameter_spec_from_name`);
+              single owner of the Megatron axes tuples
+              `distributed/mp_layers.py` tags with.
+- `planner` — the search: `plan(model_cfg, mesh_shape, hbm_budget,
+              chip=...)` -> `Plan` (chosen `Layout`, rules, full
+              candidate ledger with rejection reasons, kind=plan
+              telemetry record); `evaluate_layout` for auditing a
+              hand-written spec through the same battery;
+              `calibration_from_records` closes the loop from the
+              compile observatory's measured `memory_analysis()`
+              bytes.
+
+CLI: `tools/autoshard.py` (plan table, per-candidate rejection
+reasons, JSON report, `--selfcheck`), gated in `tools/ci.sh` stage 3.
+"""
+from .memory import (  # noqa: F401
+    HBM_BYTES, MemoryPlan, gpt_memory_plan, gpt_params, search_plan,
+    tp_divisibility_issues,
+)
+from .rules import (  # noqa: F401
+    SpecLayout, apply_partition_rules, gpt_partition_rules,
+    match_partition_rules, parameter_spec_from_name,
+)
+from .planner import (  # noqa: F401
+    AbstractParam, Candidate, InfeasiblePlanError, Layout, MeshSpec,
+    Plan, calibration_from_records, evaluate_layout,
+    gpt_abstract_params, plan,
+)
+
+__all__ = [
+    "HBM_BYTES", "MemoryPlan", "gpt_memory_plan", "gpt_params",
+    "search_plan", "tp_divisibility_issues",
+    "SpecLayout", "apply_partition_rules", "gpt_partition_rules",
+    "match_partition_rules", "parameter_spec_from_name",
+    "AbstractParam", "Candidate", "InfeasiblePlanError", "Layout",
+    "MeshSpec", "Plan", "calibration_from_records", "evaluate_layout",
+    "gpt_abstract_params", "plan",
+]

@@ -1,0 +1,201 @@
+"""Hybrid-parallel memory accounting (the planner's feasibility half).
+
+Promoted from `distributed/planner.py` (a back-compat shim remains
+there): the HBM-accounting side of the reference's sharding/offload
+decisions (`fleet/meta_optimizers/sharding_optimizer.py:87` segment
+sizing, `sharding/offload_helper.py`) — given a GPT config and a
+(dp, mp, pp, sp) mesh factorization, compute per-chip bytes for params,
+grads, optimizer state (ZeRO stage aware) and live activations (remat
+aware), and check the plan fits a chip's HBM. Pure arithmetic — usable
+before any compilation. `paddle_tpu.planner.plan()` layers the regex
+partition rules, the Graph Doctor battery and the cost-model ranking on
+top of these numbers.
+"""
+from dataclasses import dataclass, field
+
+__all__ = ["gpt_memory_plan", "gpt_params", "MemoryPlan", "HBM_BYTES",
+           "search_plan", "tp_divisibility_issues"]
+
+# per-chip HBM capacities (bytes) for plan checks; every chip the cost
+# model's ICI_BW_BY_CHIP table prices must appear here too, or
+# plan(chip=...) dies on the budget lookup
+HBM_BYTES = {
+    "v5e": 16 * 2 ** 30,
+    "v5p": 95 * 2 ** 30,
+    "v4": 32 * 2 ** 30,
+    "v6e": 32 * 2 ** 30,
+}
+
+
+@dataclass
+class MemoryPlan:
+    params: int
+    param_bytes: int
+    grad_bytes: int
+    opt_bytes: int
+    activation_bytes: int
+    total_bytes: int
+    detail: dict = field(default_factory=dict)
+
+    def fits(self, chip="v5p", headroom=0.8):
+        """True if the plan fits `headroom` fraction of the chip's HBM
+        (the rest is left for XLA temp buffers / fragmentation)."""
+        return self.total_bytes <= HBM_BYTES[chip] * headroom
+
+
+def gpt_params(cfg):
+    """Exact parameter count of models.gpt.GPTForPretraining(cfg)."""
+    d, L, v, s = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                  cfg.max_seq_len)
+    f = cfg.ffn_hidden_size
+    per_block = (
+        3 * d * d + 3 * d          # qkv proj (w+b)
+        + d * d + d                # out proj
+        + d * f + f                # fc1
+        + f * d + d                # fc2
+        + 4 * d                    # 2 LayerNorms (g+b)
+    )
+    return v * d + s * d + L * per_block + 2 * d  # wte + wpe + blocks + ln_f
+
+
+def gpt_memory_plan(cfg, dp=1, mp=1, pp=1, sp=1, micro_batch=1,
+                    zero_stage=1, remat=True, param_dtype_bytes=4,
+                    grad_dtype_bytes=4, compute_dtype_bytes=2,
+                    optimizer="adamw"):
+    """Per-chip HBM accounting for a 3D/4D hybrid plan.
+
+    Model state follows the Megatron/ZeRO arithmetic: params and grads are
+    sharded over mp*pp (tensor+pipeline); optimizer moments additionally
+    over dp when zero_stage >= 1 (grads too at stage 2). Activations: with
+    remat, each of the L/pp local layers keeps only its block-boundary
+    input [micro_batch, seq/sp, d] (everything else is recomputed in
+    backward); the 1F1B schedule bounds in-flight microbatches by ~2*pp,
+    but its saved state is the same block-boundary inputs, so the bound
+    below covers both schedules.
+    """
+    n_params = gpt_params(cfg)
+    d, L = cfg.hidden_size, cfg.num_layers
+    # worst-stage accounting: the busiest pipeline stage holds ceil(L/pp)
+    # layers, so charge that stage's share of model state, not the average
+    local_layers = max(1, -(-L // pp))
+    stage_frac = local_layers / max(1, L)
+    stage_params = int(n_params * stage_frac) if pp > 1 else n_params
+    p_bytes = stage_params * param_dtype_bytes // mp
+    g_bytes = stage_params * grad_dtype_bytes // mp
+    if zero_stage >= 3:
+        p_bytes //= dp           # stage 3: parameters dp-sharded too
+    if zero_stage >= 2:
+        g_bytes //= dp
+
+    moments = 2 if optimizer.lower() in ("adam", "adamw", "lamb") else 1
+    o_bytes = stage_params * 4 * moments // mp
+    if zero_stage >= 1:
+        o_bytes //= dp
+
+    seq_local = cfg.max_seq_len // sp
+    boundary = micro_batch * seq_local * d * compute_dtype_bytes
+    # materialized [mb, heads/mp, s/sp, s] softmax matrix — zero when flash
+    # attention tiles it away inside the kernel
+    probs = 0
+    if not getattr(cfg, "use_flash_attention", True):
+        probs = (micro_batch * (cfg.num_heads // max(1, mp)) * seq_local *
+                 cfg.max_seq_len * compute_dtype_bytes)
+    if remat:
+        # 1F1B + full remat accounting: the schedule's ring buffer holds one
+        # STAGE-INPUT boundary per in-flight microbatch (<= 2*pp), plus the
+        # one microbatch currently in backward keeps its recompute vjp
+        # residuals — local_layers block boundaries and one block's internal
+        # peak (ffn intermediate [mb, s/sp, ffn/mp], plus the probs matrix
+        # when flash attention is off). pp=1 degenerates to standard remat:
+        # ~L boundaries + one block's internals.
+        act = boundary * (2 * pp + local_layers)
+        act += (micro_batch * seq_local *
+                (cfg.ffn_hidden_size // mp) * compute_dtype_bytes) * 2
+        act += probs
+    else:
+        # ~10 tensors of [mb, s/sp, d] per layer survive to backward in a
+        # transformer block without remat (post-ln, qkv, probs-proj, ffn)
+        act = boundary * local_layers * 10
+        act += (micro_batch * seq_local *
+                (cfg.ffn_hidden_size // mp) * compute_dtype_bytes
+                ) * 2 * local_layers
+        act += probs * local_layers
+    # logits buffer on the last stage: [mb, s/sp, vocab/mp] in f32
+    logits = micro_batch * seq_local * (cfg.vocab_size // mp) * 4
+
+    total = p_bytes + g_bytes + o_bytes + act + logits
+    return MemoryPlan(
+        params=n_params,
+        param_bytes=p_bytes,
+        grad_bytes=g_bytes,
+        opt_bytes=o_bytes,
+        activation_bytes=act + logits,
+        total_bytes=total,
+        detail=dict(dp=dp, mp=mp, pp=pp, sp=sp, micro_batch=micro_batch,
+                    zero_stage=zero_stage, remat=remat, logits_bytes=logits),
+    )
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def tp_divisibility_issues(cfg, mp, sp=1):
+    """Mesh-factorization divisibility constraints that the sharding
+    lint (SH203) would reject on the default GPT partition rules —
+    checked HERE so the candidate enumeration never proposes a layout
+    the static analysis immediately kills.
+
+    mp shards: num_heads (attention head split), hidden_size
+    (row-parallel out_proj/fc2 input dim — NOT implied by the head
+    split when hidden % num_heads != 0 truncates head_dim),
+    ffn_hidden_size (fc1 output), vocab_size (vocab-parallel wte);
+    sp shards max_seq_len. Returns a list of human-readable issue
+    strings; [] means the factorization survives SH203.
+    """
+    issues = []
+    if mp > 1:
+        for dim_name, dim in (("num_heads", cfg.num_heads),
+                              ("hidden_size", cfg.hidden_size),
+                              ("ffn_hidden_size", cfg.ffn_hidden_size),
+                              ("vocab_size", cfg.vocab_size)):
+            if dim % mp:
+                issues.append(f"{dim_name} {dim} % mp {mp} != 0")
+    if sp > 1 and cfg.max_seq_len % sp:
+        issues.append(f"max_seq_len {cfg.max_seq_len} % sp {sp} != 0")
+    return issues
+
+
+def search_plan(cfg, n_chips, chip="v5p", micro_batch=1, zero_stage=1,
+                remat=True, max_mp=8):
+    """Enumerate dp x mp x pp factorizations of `n_chips` and return the
+    feasible MemoryPlans sorted by per-chip bytes (reference analog: the
+    human deciding sharding_configs + device_guard cuts; here the HBM
+    arithmetic does it). Candidate factorizations must survive
+    `tp_divisibility_issues` — the same divisibility rules SH203
+    enforces, so no plan this search returns can be one the sharding
+    lint rejects (hidden_size used to be unchecked: a config whose
+    hidden is not a multiple of mp slipped through and the lint killed
+    it at apply time). pp must divide num_layers. mp is capped
+    (default 8) because TP allreduces must stay on ICI-adjacent chips.
+    Returns [] when nothing fits — the caller decides whether that
+    means more chips or offload. For the full search (sp/ep axes, ZeRO
+    stage sweep, cost ranking, Graph Doctor verification) use
+    `paddle_tpu.planner.plan`.
+    """
+    plans = []
+    for mp in _divisors(n_chips):
+        if mp > max_mp or tp_divisibility_issues(cfg, mp):
+            continue
+        rest = n_chips // mp
+        for pp in _divisors(rest):
+            if cfg.num_layers % pp:
+                continue
+            dp = rest // pp
+            plan = gpt_memory_plan(
+                cfg, dp=dp, mp=mp, pp=pp, micro_batch=micro_batch,
+                zero_stage=zero_stage, remat=remat)
+            if plan.fits(chip):
+                plans.append(plan)
+    plans.sort(key=lambda p: p.total_bytes)
+    return plans

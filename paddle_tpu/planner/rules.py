@@ -1,0 +1,148 @@
+"""Regex partition rules: parameter placement as data, not code.
+
+The hand-written world tags each parameter inside the layer that owns
+it (`models/gpt.py` `_tag`, `distributed/mp_layers.py`); the planner
+needs the same placement as a standalone, inspectable artifact it can
+search over, lint (SH208 coverage), serialize into a plan report, and
+apply to a model it never instantiated. The shape follows the
+`match_partition_rules` / `parameter_spec_from_name` idiom of
+JAX LLM trainers: an ordered list of `(regex, axes)` rules, first
+match wins, matched against the dotted parameter name.
+
+`axes` entries are `mesh_axes`-style tuples (the tag
+`distributed.env.param_sharding` consumes), NOT jax PartitionSpecs —
+the planner stays importable without placing anything. The canonical
+tensor-parallel tuples live here as module constants and
+`distributed/mp_layers.py` imports them, so the Megatron placement has
+exactly one owner.
+"""
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "COLUMN_PARALLEL_WEIGHT_AXES", "COLUMN_PARALLEL_BIAS_AXES",
+    "ROW_PARALLEL_WEIGHT_AXES", "VOCAB_PARALLEL_WEIGHT_AXES",
+    "REPLICATED", "SpecLayout", "gpt_partition_rules",
+    "parameter_spec_from_name", "match_partition_rules",
+    "apply_partition_rules",
+]
+
+# Megatron placement, single source of truth (mp_layers + models/gpt
+# use the same tuples): column-parallel splits the OUTPUT dim over mp,
+# row-parallel the INPUT dim, vocab-parallel embedding the vocab dim.
+COLUMN_PARALLEL_WEIGHT_AXES = (None, "mp")
+COLUMN_PARALLEL_BIAS_AXES = ("mp",)
+ROW_PARALLEL_WEIGHT_AXES = ("mp", None)
+VOCAB_PARALLEL_WEIGHT_AXES = ("mp", None)
+# explicit replication: () normalizes to an all-None spec; distinct
+# from "no rule matched" (which SH208 flags under a sharded layout)
+REPLICATED = ()
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Mesh-axis naming for a rule set. The defaults are the process
+    mesh's axes (`distributed.env.MESH_AXES`); fsdp/ZeRO is not a
+    separate axis here — it rides the dp axis via the trainer's
+    zero_stage (see ShardedTrainStep), so rules never name it."""
+    data_axis: str = "dp"
+    tp_axis: str = "mp"
+    sp_axis: str = "sp"
+    ep_axis: str = "ep"
+
+    def _mp(self, axes):
+        if self.tp_axis == "mp":
+            return axes
+        return tuple(self.tp_axis if a == "mp" else a for a in axes)
+
+    def column_parallel(self):
+        return self._mp(COLUMN_PARALLEL_WEIGHT_AXES)
+
+    def column_parallel_bias(self):
+        return self._mp(COLUMN_PARALLEL_BIAS_AXES)
+
+    def row_parallel(self):
+        return self._mp(ROW_PARALLEL_WEIGHT_AXES)
+
+    def vocab_parallel(self):
+        return self._mp(VOCAB_PARALLEL_WEIGHT_AXES)
+
+
+def gpt_partition_rules(layout=None):
+    """The in-repo GPT family's placement as ordered (regex, axes)
+    rules — byte-identical to the `_tag` calls in `models/gpt.py`
+    (asserted by tests/test_planner.py's parity test, so the two can
+    never drift silently). Ends with an explicit replicate-everything
+    catch-all: layernorms, row-parallel biases and the position table
+    are replicated ON PURPOSE, and the catch-all is what makes that
+    visible to the SH208 coverage lint (a param matching NO rule is a
+    finding; a param matching the catch-all is a decision)."""
+    lo = layout or SpecLayout()
+    return [
+        (r"\bwte\.weight$", lo.vocab_parallel()),
+        (r"\bwpe\.weight$", REPLICATED),
+        (r"\b(qkv_proj|fc1)\.weight$", lo.column_parallel()),
+        (r"\b(qkv_proj|fc1)\.bias$", lo.column_parallel_bias()),
+        (r"\b(out_proj|fc2)\.weight$", lo.row_parallel()),
+        (r"\b(ln1|ln2|ln_f)\.(weight|bias)$", REPLICATED),
+        (r".*", REPLICATED),
+    ]
+
+
+def parameter_spec_from_name(param_name, layout=None, rules=None):
+    """Heuristic mesh_axes assignment from a dotted parameter name —
+    the first matching rule's axes (None when nothing matches, which
+    the coverage lint treats as silent replication)."""
+    for pattern, axes in (rules if rules is not None
+                          else gpt_partition_rules(layout)):
+        if re.search(pattern, param_name):
+            return axes
+    return None
+
+
+def match_partition_rules(rules, named_params, on_miss="raise"):
+    """Resolve every (name, param) through the ordered rule list.
+
+    Returns [(name, axes, rule_index)]; scalar/size-1 leaves resolve to
+    REPLICATED without consulting the rules (never worth sharding).
+    on_miss: "raise" (a param no rule covers is a rule-set bug — the
+    planner's default, mirrored softly by SH208) or "replicate"."""
+    out = []
+    for name, p in named_params:
+        shape = tuple(getattr(p, "shape", ()) or ())
+        n = 1
+        for s in shape:
+            n *= int(s)
+        if not shape or n <= 1:
+            out.append((name, REPLICATED, None))
+            continue
+        for i, (pattern, axes) in enumerate(rules):
+            if re.search(pattern, name):
+                out.append((name, tuple(axes or ()), i))
+                break
+        else:
+            if on_miss == "raise":
+                raise ValueError(
+                    f"no partition rule matches parameter '{name}' "
+                    f"(shape {shape}); add a rule or an explicit "
+                    "catch-all ('.*', ()) so the replication is a "
+                    "decision, not an accident")
+            out.append((name, None, None))
+    return out
+
+
+def apply_partition_rules(model, rules=None, overwrite=False):
+    """Tag a live model's parameters from a rule list (sets
+    `mesh_axes`, the tag `shard_model`/`ShardedTrainStep` consume).
+    Existing tags win unless overwrite=True — a hand-tuned exception on
+    one layer survives a planner re-tag. Returns the model."""
+    rules = rules if rules is not None else gpt_partition_rules()
+    resolved = dict()
+    named = [(n, p) for n, p in model.named_parameters() if p is not None]
+    for name, axes, _ in match_partition_rules(rules, named):
+        resolved[name] = axes
+    for name, p in named:
+        if overwrite or getattr(p, "mesh_axes", None) is None:
+            axes = resolved[name]
+            p.mesh_axes = tuple(axes) if axes else None
+    return model

@@ -195,6 +195,57 @@ def make_ckpt_record(event, step, rank=0, save_ms=None, bytes=None,  # noqa: A00
 
 BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
 
+# required keys of an auto-sharding plan record (paddle_tpu.planner);
+# optional: chip, n_chips, projected_hbm_bytes, measured_hbm_bytes,
+# hbm_budget_bytes, cost_step_s, calibration, verify
+PLAN_RECORD_KEYS = ("schema", "kind", "rank", "model", "chosen",
+                    "candidates_considered", "candidates_rejected")
+
+
+def make_plan_record(model, chosen, candidates_considered,
+                     candidates_rejected, rank=0, chip=None, n_chips=None,
+                     projected_hbm_bytes=None, measured_hbm_bytes=None,
+                     hbm_budget_bytes=None, cost_step_s=None,
+                     calibration=None, verify=None, **extra):
+    """One auto-sharding decision as a first-class record (kind='plan',
+    paddle_tpu.planner.Plan.to_record). `chosen` is the layout dict
+    (dp/pp/mp/sp/ep/zero_stage/...); `candidates_rejected` is the
+    rejection ledger ([{layout, reason}] — every reason non-empty, the
+    validator enforces it). `measured_hbm_bytes` is attached after the
+    compile observatory measures the chosen layout's first compile;
+    tools/trace_check.py fails the plan when measured drifts >15% from
+    `projected_hbm_bytes` (the PR-4 hbm_projection_drift rule applied
+    to the planner's own numbers)."""
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "plan",
+        "rank": int(rank),
+        "model": str(model),
+        "chosen": dict(chosen),
+        "candidates_considered": int(candidates_considered),
+        "candidates_rejected": [dict(r) for r in candidates_rejected],
+    }
+    if chip is not None:
+        rec["chip"] = str(chip)
+    if n_chips is not None:
+        rec["n_chips"] = int(n_chips)
+    if projected_hbm_bytes is not None:
+        rec["projected_hbm_bytes"] = int(projected_hbm_bytes)
+    if measured_hbm_bytes is not None:
+        rec["measured_hbm_bytes"] = int(measured_hbm_bytes)
+    if hbm_budget_bytes is not None:
+        rec["hbm_budget_bytes"] = int(hbm_budget_bytes)
+    if cost_step_s is not None:
+        rec["cost_step_s"] = float(cost_step_s)
+    if calibration is not None:
+        rec["calibration"] = float(calibration)
+    if verify is not None:
+        rec["verify"] = verify
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
 
 def make_bench_record(metric, value, unit=None, rank=0, device=None,
                       bench_round=None, baseline=None, **extra):
@@ -349,6 +400,49 @@ def validate_step_record(rec):
         if v is None and "error" not in rec:
             problems.append("bench record with null value carries no "
                             "'error' note")
+        return problems
+    if kind == "plan":
+        for key in PLAN_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"plan record missing '{key}'")
+        chosen = rec.get("chosen")
+        if chosen is not None:
+            if not isinstance(chosen, dict):
+                problems.append(f"'chosen' not a layout dict: {chosen!r}")
+            else:
+                for axis in ("dp", "pp", "mp"):
+                    v = chosen.get(axis)
+                    if not isinstance(v, int) or v < 1:
+                        problems.append(
+                            f"chosen layout '{axis}' not a positive "
+                            f"int: {v!r}")
+        n = rec.get("candidates_considered")
+        rejected = rec.get("candidates_rejected")
+        if n is not None and (not isinstance(n, int) or n < 1):
+            problems.append(
+                f"'candidates_considered' not a positive int: {n!r}")
+        if rejected is not None:
+            if not isinstance(rejected, list):
+                problems.append("'candidates_rejected' not a list")
+            else:
+                if isinstance(n, int) and len(rejected) >= n:
+                    problems.append(
+                        f"{len(rejected)} rejected candidates but only "
+                        f"{n} considered — the chosen layout cannot be "
+                        "among them")
+                for j, r in enumerate(rejected):
+                    if not isinstance(r, dict) or \
+                            not str(r.get("reason", "")).strip():
+                        problems.append(
+                            f"rejected candidate {j} carries no reason "
+                            "— a rejection the ledger cannot explain")
+        for key in ("projected_hbm_bytes", "measured_hbm_bytes",
+                    "hbm_budget_bytes"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
         return problems
     if kind == "ckpt":
         for key in CKPT_RECORD_KEYS:

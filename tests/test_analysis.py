@@ -249,6 +249,56 @@ def test_project_hbm_accounts_sharding(mesh24):
     assert "SH206" in _rules(findings)
 
 
+def test_sh208_param_fallthrough_flagged(mesh24):
+    """Direction 1: under a sharded layout, a parameter no rule
+    matches silently replicates — error for large params, warning for
+    small ones; a catch-all rule makes it clean."""
+    rules = [(r"weight$", (None, "mp"))]
+    big = paddle.create_parameter([512, 1024], "float32")   # 2 MB
+    w = paddle.create_parameter([16, 32], "float32")   # keeps rule live
+    findings = sharding_lint.lint_partition_rules(
+        rules, [("blk.fc.weight", w), ("blk.untagged", big)], mesh24)
+    assert [f.rule_id for f in findings] == ["SH208"]
+    assert findings[0].severity == SEV_ERROR
+    assert "falls through" in findings[0].message
+    assert findings[0].location == "blk.untagged"
+    small = paddle.create_parameter([8], "float32")
+    findings = sharding_lint.lint_partition_rules(
+        rules, [("blk.fc.weight", w), ("blk.tiny", small)], mesh24)
+    assert [f.severity for f in findings] == ["warning"]
+    # explicit catch-all: replication becomes a decision, not a finding
+    covered = rules + [(r".*", ())]
+    assert sharding_lint.lint_partition_rules(
+        covered, [("blk.fc.weight", w), ("blk.untagged", big)],
+        mesh24) == []
+
+
+def test_sh208_dead_rule_flagged(mesh24):
+    """Direction 2: a rule whose pattern matches no parameter is dead
+    — whatever it was written to shard is NOT being sharded."""
+    p = paddle.create_parameter([16, 32], "float32")
+    rules = [(r"qkv_proj\.weight$", (None, "mp")), (r".*", ())]
+    findings = sharding_lint.lint_partition_rules(
+        rules, [("blk.fc.weight", p)], mesh24)
+    assert [f.rule_id for f in findings] == ["SH208"]
+    assert findings[0].severity == "warning"
+    assert "matches no parameter" in findings[0].message
+    assert "qkv_proj" in findings[0].location
+    # a matching param set is clean
+    assert sharding_lint.lint_partition_rules(
+        rules, [("blk.attn.qkv_proj.weight", p)], mesh24) == []
+
+
+def test_sh208_scalars_exempt_from_fallthrough(mesh24):
+    """Scalar / size-1 leaves are never worth sharding: no finding
+    even when no rule matches them."""
+    scalar = paddle.create_parameter([1], "float32")
+    findings = sharding_lint.lint_partition_rules(
+        [(r"weight$", (None, "mp"))], [("step_count", scalar)], mesh24)
+    # only the dead-rule warning may fire — never a fall-through error
+    assert all("matches no parameter" in f.message for f in findings)
+
+
 def test_apply_time_rank_validation_names_param(mesh24):
     """Satellite: ShardedTrainStep/shard_model raise a clear error
     naming the parameter instead of an opaque JAX trace error."""

@@ -137,6 +137,51 @@ def test_apply_time_spec_rank_error_names_parameter(mp_mesh):
                               mesh=mp_mesh)
 
 
+def test_search_plan_skips_sh203_killable_factorizations():
+    """Satellite fix: `_divisors`-based enumeration used to propose
+    mp factorizations the sharding lint immediately kills —
+    hidden_size % mp was unchecked (mp | num_heads does not imply
+    mp | hidden when hidden is not a multiple of the head count), so
+    the row-parallel out_proj weight tripped SH203 at apply time."""
+    from paddle_tpu.analysis import sharding_lint
+    from paddle_tpu.distributed import search_plan
+    from paddle_tpu.distributed.planner import tp_divisibility_issues
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.planner import MeshSpec, gpt_abstract_params
+    from paddle_tpu.planner.rules import (gpt_partition_rules,
+                                          match_partition_rules)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=100, num_heads=6,
+                    ffn_hidden_size=396, num_layers=6, max_seq_len=64)
+    assert tp_divisibility_issues(cfg, 6)       # the SH203 bait
+    plans = search_plan(cfg, 6, chip="v5p")
+    assert plans, "search must still find mp=1/2/3 factorizations"
+    assert all(p.detail["mp"] != 6 for p in plans)
+    # every returned factorization lints clean under the GPT rules
+    rules = gpt_partition_rules()
+    named = gpt_abstract_params(cfg)
+    for p in plans:
+        mesh = MeshSpec(dp=p.detail["dp"], pp=p.detail["pp"],
+                        mp=p.detail["mp"])
+        tagged = [(n, type(ap)(ap.shape, ap.dtype, axes or None))
+                  for (n, ap), (_n, axes, _i)
+                  in zip(named, match_partition_rules(rules, named))]
+        assert sharding_lint.lint_model_sharding(tagged, mesh) == [], \
+            f"search_plan returned an SH203-dirty plan: {p.detail}"
+
+
+def test_search_plan_back_compat_shim():
+    """The old import path and the distributed package export keep
+    working after the move to paddle_tpu.planner."""
+    import paddle_tpu.distributed.planner as shim
+    from paddle_tpu import planner as pkg
+    assert shim.search_plan is pkg.search_plan
+    assert shim.gpt_memory_plan is pkg.gpt_memory_plan
+    assert shim.MemoryPlan is pkg.MemoryPlan
+    assert shim.HBM_BYTES is pkg.HBM_BYTES
+    from paddle_tpu.distributed import search_plan as exported
+    assert exported is pkg.search_plan
+
+
 def test_search_plan_13b_feasible_on_v5p_pods():
     """BASELINE config 5: gpt3_13b must have feasible dp x mp x pp plans
     on v5p-32 and v5p-64; the planner enumerates them."""

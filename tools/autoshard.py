@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Auto-sharding planner CLI: search, verify, and explain a
+parallelism plan WITHOUT compiling or executing anything.
+
+Runs `paddle_tpu.planner.plan` over an in-repo GPT preset (or a
+specimen config file), prints the candidate table with per-candidate
+rejection reasons, and writes a JSON report + a kind=plan telemetry
+record. Every plan this tool emits has passed the full Graph Doctor
+battery — sharding_lint SH201–SH208 with project_hbm per-device
+accounting, jaxpr_lint over a traced (never executed) step, and the
+collective_order capture — with zero findings.
+
+    JAX_PLATFORMS=cpu python tools/autoshard.py --model 1.3b \
+        --chips 32 --chip v5p --report /tmp/plan.json
+
+    python tools/autoshard.py --model 13b --mesh dp=2,mp=8 --dp-over-dcn
+
+`--selfcheck` (the CI gate, tools/ci.sh stage 3) proves the planner
+can still see what it gates on:
+  a) the checked-in infeasible specimen
+     (tools/specimens/autoshard_infeasible.json — an HBM budget too
+     small for the model) must be REJECTED with the binding
+     constraint named;
+  b) a feasible GPT-125M config must produce a plan that passes the
+     graph-doctor battery clean — including re-linting the planner's
+     tags on the LIVE model over a real device mesh — and whose
+     kind=plan record validates under tools/trace_check.py (with the
+     >15% projection-drift rule demonstrably firing on a doctored
+     copy).
+
+Exit codes: 0 plan found; 8 no feasible plan (the rejection ledger is
+printed); 9 a selfcheck leg failed to fire (the planner itself is
+broken). Distinct from pytest/graphdoctor codes so CI logs
+disambiguate.
+"""
+import argparse
+import json
+import os
+import sys
+
+# 8 virtual CPU devices BEFORE jax loads (same recipe as
+# tests/conftest.py) so the live-model selfcheck leg has a real mesh
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPECIMEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "specimens", "autoshard_infeasible.json")
+
+_PRESETS = {
+    "tiny": "gpt_tiny", "125m": "gpt3_125m", "350m": "gpt3_350m",
+    "1.3b": "gpt3_1_3b", "13b": "gpt3_13b",
+}
+
+
+def build_config(name, max_seq_len=None):
+    from paddle_tpu.models.gpt import GPTConfig, gpt_tiny_config
+    if name not in _PRESETS:
+        raise SystemExit(f"unknown model {name!r} "
+                         f"(presets: {sorted(_PRESETS)})")
+    if name == "tiny":
+        return gpt_tiny_config()
+    kw = {"max_seq_len": max_seq_len} if max_seq_len else {}
+    return getattr(GPTConfig, _PRESETS[name])(**kw)
+
+
+def parse_mesh(spec):
+    """'dp=2,mp=8' -> {'dp': 2, 'mp': 8}."""
+    out = {}
+    for part in spec.split(","):
+        axis, _, size = part.partition("=")
+        out[axis.strip()] = int(size)
+    return out
+
+
+def run_plan(args):
+    from paddle_tpu import planner
+
+    cfg = build_config(args.model, args.max_seq_len)
+    mesh_shape = parse_mesh(args.mesh) if args.mesh else args.chips
+    budget = int(args.budget_gib * 2 ** 30) if args.budget_gib else None
+    calibration = None
+    if args.calibrate_from:
+        from paddle_tpu.telemetry.sink import read_jsonl
+        calibration = read_jsonl(args.calibrate_from)
+    kwargs = dict(
+        hbm_budget=budget, chip=args.chip, verify=args.verify,
+        zero_stages=tuple(int(z) for z in args.zero_stages.split(",")),
+        micro_batches=tuple(int(m) for m in
+                            args.micro_batches.split(",")),
+        dp_over_dcn=args.dp_over_dcn, calibration=calibration,
+        model_name=args.model)
+    if args.global_batch:
+        kwargs["global_batch"] = args.global_batch
+    return planner.plan(cfg, mesh_shape, **kwargs)
+
+
+def emit(plan, args, rank=0):
+    print(f"autoshard: {plan.model} on {plan.n_chips} x {plan.chip} "
+          f"(budget {plan.hbm_budget / 2**30:.1f} GiB, "
+          f"calibration x{plan.calibration:.2f})")
+    print(plan.summary_table())
+    c = plan.chosen
+    print(f"chosen: {plan.layout.describe()} — projected "
+          f"{plan.projected_hbm_bytes / 2**30:.2f} GiB/device, "
+          f"est {c.step_time_s * 1e3:.2f} ms/step "
+          f"({c.cost.get('comm_frac', 0) * 100:.1f}% comm), "
+          f"verified: {'+'.join(plan.verify.get('families_checked', []))} "
+          f"with {plan.verify.get('findings_on_chosen', {}).get('n', 0)} "
+          "finding(s)")
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(plan.to_dict(), f, indent=2, sort_keys=True)
+        print(f"report: {args.report}")
+    if args.telemetry:
+        from paddle_tpu.telemetry.sink import JsonlSink
+        JsonlSink(args.telemetry).write(plan.to_record(rank=rank))
+        print(f"telemetry: kind=plan record -> {args.telemetry}")
+
+
+def run_selfcheck():
+    """Two-sided gate (the graphdoctor selfcheck pattern). Returns 0
+    or 9."""
+    from paddle_tpu import planner
+    from paddle_tpu.telemetry import sink as tsink
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from trace_check import check_metrics_jsonl
+
+    # ---- leg a: the infeasible specimen must be rejected, naming the
+    # binding constraint --------------------------------------------------
+    with open(SPECIMEN) as f:
+        spec = json.load(f)
+    cfg = build_config(spec["model"], spec.get("max_seq_len"))
+    try:
+        planner.plan(cfg, spec["chips"], chip=spec["chip"],
+                     hbm_budget=int(spec["hbm_budget_gib"] * 2 ** 30),
+                     verify="sharding")
+    except planner.InfeasiblePlanError as e:
+        msg = str(e)
+        want = spec["expect"]["message_contains"]
+        missing = [w for w in want if w not in msg]
+        if missing:
+            print(f"SELFCHECK FAILED: infeasible specimen rejected but "
+                  f"the message names no binding constraint "
+                  f"(missing {missing}): {msg}", file=sys.stderr)
+            return 9
+        if not e.candidates:
+            print("SELFCHECK FAILED: rejection carries no candidate "
+                  "ledger", file=sys.stderr)
+            return 9
+        print(f"selfcheck a OK: specimen rejected "
+              f"({len(e.candidates)} candidates, binding constraint "
+              "named)")
+    else:
+        print("SELFCHECK FAILED: the infeasible specimen "
+              f"({spec['model']} on {spec['chips']} x {spec['chip']}, "
+              f"{spec['hbm_budget_gib']} GiB budget) produced a plan",
+              file=sys.stderr)
+        return 9
+
+    # ---- leg b: a feasible GPT-125M plan, graph-doctor clean, with a
+    # validating kind=plan record -----------------------------------------
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig.gpt3_125m()
+    plan = planner.plan(cfg, 8, chip="v5p", verify="full",
+                        model_name="125m")
+    findings = plan.chosen.findings
+    fams = plan.verify.get("families_checked", [])
+    if findings or set(fams) != {"sharding", "jaxpr", "collective_order"}:
+        print(f"SELFCHECK FAILED: 125M plan not doctor-clean "
+              f"(families {fams}, {len(findings)} finding(s): "
+              f"{[f.rule_id for f in findings]})", file=sys.stderr)
+        return 9
+
+    # the plan's tags must lint clean on the LIVE model over a REAL
+    # mesh — the same pass tools/graphdoctor.py gates the repo configs
+    # with, here gating the planner's own output
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import sharding_lint
+    from paddle_tpu.distributed import env
+    from paddle_tpu.models.gpt import GPTForPretraining
+    from paddle_tpu.planner.rules import apply_partition_rules
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    apply_partition_rules(model, plan.rules, overwrite=True)
+    lo = plan.layout
+    mesh = env.build_mesh(dp=lo.dp, pp=lo.pp, mp=lo.mp, sp=lo.sp,
+                          ep=lo.ep)
+    try:
+        live = sharding_lint.lint_model_sharding(
+            model, mesh, zero_stage=lo.zero_stage)
+        live += sharding_lint.lint_partition_rules(
+            plan.rules, list(model.named_parameters()), mesh)
+    finally:
+        env.clear_mesh()
+    if live:
+        print(f"SELFCHECK FAILED: planner tags lint dirty on the live "
+              f"125M model: {[f.rule_id for f in live]}", file=sys.stderr)
+        return 9
+
+    # record round-trip + the drift gate must demonstrably fire
+    rec = plan.to_record()
+    probs = tsink.validate_step_record(rec)
+    if probs:
+        print(f"SELFCHECK FAILED: plan record invalid: {probs}",
+              file=sys.stderr)
+        return 9
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        f.write(json.dumps(rec) + "\n")
+        good = f.name
+    *_counts, problems = check_metrics_jsonl(good)
+    drifted = dict(rec)
+    drifted["measured_hbm_bytes"] = int(rec["projected_hbm_bytes"] * 1.5)
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        f.write(json.dumps(drifted) + "\n")
+        bad = f.name
+    *_bad_counts, bad_problems = check_metrics_jsonl(bad)
+    os.unlink(good)
+    os.unlink(bad)
+    if problems:
+        print(f"SELFCHECK FAILED: clean plan record failed "
+              f"trace_check: {problems}", file=sys.stderr)
+        return 9
+    if not any("drift" in p for p in bad_problems):
+        print("SELFCHECK FAILED: 50% projection drift did not trip "
+              "the trace_check plan rule", file=sys.stderr)
+        return 9
+    print(f"selfcheck b OK: 125M plan {plan.layout.describe()} "
+          f"doctor-clean ({plan.verify.get('jaxpr_eqns', 0)} jaxpr "
+          "eqns, live-model lint clean, plan record valid, drift gate "
+          "fires)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=sorted(_PRESETS), default="125m")
+    ap.add_argument("--chips", type=int, default=8,
+                    help="chip count (every axis free)")
+    ap.add_argument("--mesh", default=None,
+                    help="fix axes, e.g. dp=2,mp=8 (overrides --chips)")
+    ap.add_argument("--chip", default="v5p",
+                    choices=["v4", "v5e", "v5p", "v6e"])
+    ap.add_argument("--budget-gib", type=float, default=None,
+                    help="per-chip HBM budget (default: 0.8 * chip HBM)")
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--zero-stages", default="1,2,3")
+    ap.add_argument("--micro-batches", default="1")
+    ap.add_argument("--global-batch", type=int, default=None,
+                    help="sequences per step to cost at (default: one "
+                         "per chip)")
+    ap.add_argument("--dp-over-dcn", action="store_true",
+                    help="dp is the outer axis of a two-level plan "
+                         "(its collectives cross DCN, not ICI)")
+    ap.add_argument("--verify", choices=["full", "sharding"],
+                    default="full")
+    ap.add_argument("--calibrate-from", default=None,
+                    help="compile-observatory JSONL whose measured "
+                         "memory_analysis() bytes calibrate the "
+                         "projections")
+    ap.add_argument("--report", default=None,
+                    help="write the JSON plan report here")
+    ap.add_argument("--telemetry", default=None,
+                    help="append the kind=plan record to this JSONL")
+    ap.add_argument("--selfcheck", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selfcheck:
+        return run_selfcheck()
+
+    from paddle_tpu.planner import InfeasiblePlanError
+    try:
+        plan = run_plan(args)
+    except InfeasiblePlanError as e:
+        print(f"autoshard: NO FEASIBLE PLAN — {e}", file=sys.stderr)
+        for c in getattr(e, "candidates", [])[:40]:
+            print(f"  {c.layout.describe():28} "
+                  f"{c.projected_hbm_bytes / 2**30:8.2f} GiB  "
+                  f"{c.reason}", file=sys.stderr)
+        return 8
+    emit(plan, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

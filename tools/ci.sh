@@ -55,6 +55,14 @@ echo "== [3/7] graph doctor + framework lint =="
 JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
     --report /tmp/graphdoctor_ci.json
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
+# auto-sharding planner gate (tools/autoshard.py), same two-sided
+# pattern: the checked-in infeasible specimen (HBM budget too small,
+# tools/specimens/autoshard_infeasible.json) must be rejected with the
+# binding constraint named, and a feasible GPT-125M config must
+# produce a plan that passes the full graph-doctor battery clean —
+# including re-linting the planner's tags on the live model — with a
+# kind=plan record that validates under tools/trace_check.py
+JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 
 echo "== [4/7] training health + compile observatory gate =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays

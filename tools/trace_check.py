@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
-    n_ckpt_records, n_bench_records, problems).
+    n_ckpt_records, n_bench_records, n_plan_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -33,8 +33,9 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, [f"{path}: empty metrics file (0 bytes): "
-                                   "no step was ever recorded"]
+            return 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics file "
+                                      "(0 bytes): no step was ever "
+                                      "recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -45,7 +46,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -54,6 +55,7 @@ def check_metrics_jsonl(path):
     problems += check_compile_records(records, path)
     problems += check_ckpt_records(records, path)
     problems += check_bench_records(records, path)
+    problems += check_plan_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -62,7 +64,10 @@ def check_metrics_jsonl(path):
                  if isinstance(r, dict) and r.get("kind") == "ckpt")
     n_bench = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "bench")
-    return len(records), n_steps, n_compiles, n_ckpt, n_bench, problems
+    n_plan = sum(1 for r in records
+                 if isinstance(r, dict) and r.get("kind") == "plan")
+    return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
+            problems)
 
 
 def check_compile_records(records, path):
@@ -199,6 +204,59 @@ def check_bench_records(records, path):
     return problems
 
 
+# plan-record projection drift threshold — the same 15% bound the
+# compile observatory's hbm_projection_drift rule uses (PR 4): past it
+# the planner's feasibility decisions were made on fiction
+PLAN_DRIFT_FRAC = 0.15
+
+
+def check_plan_records(records, path):
+    """Cross-record rules for auto-sharding plan records (kind=plan,
+    paddle_tpu.planner; per-record schema lives in
+    sink.validate_step_record):
+
+    - the chosen layout's axis product must equal n_chips when both
+      are present — a plan whose mesh does not multiply out to its
+      chip count never factorized anything;
+    - when both projected_hbm_bytes and measured_hbm_bytes are present
+      (the compile observatory measured the chosen layout), they must
+      agree within PLAN_DRIFT_FRAC — a plan whose projection drifted
+      >15% from what XLA actually allocated chose its layout on
+      numbers that were wrong, and the search must be re-run with the
+      measured calibration.
+    """
+    problems = []
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "plan":
+            continue
+        chosen = rec.get("chosen")
+        n_chips = rec.get("n_chips")
+        if isinstance(chosen, dict) and isinstance(n_chips, int):
+            prod = 1
+            for axis in ("dp", "pp", "mp", "sp", "ep"):
+                v = chosen.get(axis, 1)
+                prod *= v if isinstance(v, int) and v > 0 else 1
+            if prod != n_chips:
+                problems.append(
+                    f"{path}:{i + 1}: chosen layout multiplies to "
+                    f"{prod} chips but the plan claims n_chips="
+                    f"{n_chips}")
+        projected = rec.get("projected_hbm_bytes")
+        measured = rec.get("measured_hbm_bytes")
+        if isinstance(projected, (int, float)) and \
+                isinstance(measured, (int, float)) and measured > 0:
+            drift = abs(measured - projected) / float(measured)
+            if drift > PLAN_DRIFT_FRAC:
+                problems.append(
+                    f"{path}:{i + 1}: plan projection drift "
+                    f"{drift * 100:.1f}% (projected "
+                    f"{projected / 2**30:.2f} GiB vs measured "
+                    f"{measured / 2**30:.2f} GiB) exceeds "
+                    f"{PLAN_DRIFT_FRAC * 100:.0f}% — re-plan with "
+                    "calibration from the compile observatory")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -236,11 +294,12 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, n_steps, n_compiles, n_ckpt, n_bench, problems = \
+    n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, problems = \
         check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
-             "n_bench": n_bench, "n_events": 0, "ranks": set()}
+             "n_bench": n_bench, "n_plan": n_plan,
+             "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -284,6 +343,8 @@ def main(argv):
         msg += f" ({stats['n_ckpt']} ckpt events)"
     if stats.get("n_bench"):
         msg += f" ({stats['n_bench']} bench results)"
+    if stats.get("n_plan"):
+        msg += f" ({stats['n_plan']} plan records)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
