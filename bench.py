@@ -624,7 +624,7 @@ def bench_decode_wo8(on_tpu):
     headline serving lever."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-    from paddle_tpu.quant import quantize_weights_int8
+    from paddle_tpu.quant import quantize_for_decode
 
     paddle.seed(0)
     if on_tpu:
@@ -652,7 +652,9 @@ def bench_decode_wo8(on_tpu):
         return B * new * reps / dt
 
     bf16_tps = timed()
-    quantize_weights_int8(model)
+    # the serving engine's weights="wo8" mode and this phase share ONE
+    # quantization entry (paddle_tpu/quant/wo8.py quantize_for_decode)
+    quantize_for_decode(model)
     wo8_tps = timed()
     return {"bf16_tokens_per_sec": round(bf16_tps, 1),
             "wo8_tokens_per_sec": round(wo8_tps, 1),

@@ -20,16 +20,26 @@ class Config:
     """AnalysisConfig analog. `Config(model_path)` points at the artifact
     written by save_inference_model (without extension).
 
-    Engine-selection switches from the reference (TensorRT, MKLDNN, IR
-    pass toggles) have no effect here — the engine is always the
-    XLA-compiled StableHLO module — so each one emits a UserWarning
-    saying so instead of being silently swallowed."""
+    Two kinds of reference switches:
+
+    - device/precision selection now ROUTES to the serving engine
+      (`paddle_tpu.serving.EngineConfig.from_inference_config`):
+      `disable_gpu()` pins the engine + its paged-KV arenas to the host
+      CPU device, `enable_use_gpu(memory_pool_init_size_mb=N)` selects
+      the accelerator and budgets N MB of paged KV cache, and
+      `enable_tensorrt_engine(precision_mode=...)` picks the decode
+      precision (Int8 -> weight-only-int8 W8A16, Half/Bfloat16 -> bf16
+      compute, Float32 -> the params' dtype);
+    - graph-pipeline toggles (MKLDNN, IR passes, memory optim) still
+      have no effect — XLA owns those — and each emits a UserWarning
+      saying so instead of being silently swallowed."""
 
     def __init__(self, prog_file=None, params_file=None):
         self.model_path = prog_file
         self._params_file = params_file
         self._use_tpu = True
         self._memory_pool_mb = 0
+        self._serving_precision = None
 
     @staticmethod
     def _ignored(switch, why):
@@ -37,23 +47,42 @@ class Config:
             f"Config.{switch} has no effect in paddle_tpu: {why}",
             UserWarning, stacklevel=3)
 
-    # --- compatibility switches (engine selection is XLA's job) ---
+    # --- device + precision switches (routed to the serving engine) ---
     def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
-        self._ignored("enable_use_gpu",
-                      "the predictor runs on the JAX default backend "
-                      "(TPU when available); there is no CUDA engine")
+        self._use_tpu = True
+        self._memory_pool_mb = int(memory_pool_init_size_mb)
+        warnings.warn(
+            "Config.enable_use_gpu: no CUDA engine in paddle_tpu — "
+            "routed to the serving engine instead: accelerator device "
+            f"selected, memory_pool_init_size_mb={memory_pool_init_size_mb}"
+            " budgets the paged KV-cache arena "
+            "(serving.EngineConfig.from_inference_config)",
+            UserWarning, stacklevel=2)
 
     def disable_gpu(self):
+        # a REAL switch since the serving engine landed: the engine and
+        # its KV arenas are placed on the host CPU device
+        # (EngineConfig.from_inference_config reads _use_tpu). The
+        # classic Predictor path still follows the process backend, so
+        # say so instead of going silent for that consumer.
         self._use_tpu = False
-        self._ignored("disable_gpu",
-                      "backend selection is fixed at process start (JAX "
-                      "platform); run with jax_platforms=cpu to serve "
-                      "on CPU")
+        warnings.warn(
+            "Config.disable_gpu: honored by the serving engine "
+            "(EngineConfig.from_inference_config places the engine and "
+            "its KV arenas on the host CPU device); the classic "
+            "Predictor still runs on the process's JAX backend — start "
+            "with jax_platforms=cpu to move that too",
+            UserWarning, stacklevel=2)
 
-    def enable_tensorrt_engine(self, **kwargs):
-        self._ignored("enable_tensorrt_engine",
-                      "subgraph engines are replaced by whole-program "
-                      "XLA compilation")
+    def enable_tensorrt_engine(self, precision_mode=None, **kwargs):
+        self._serving_precision = precision_mode
+        warnings.warn(
+            "Config.enable_tensorrt_engine: subgraph engines are "
+            "replaced by whole-program XLA compilation; precision_mode "
+            "is routed to the serving engine's decode dtype (Int8 -> "
+            "weight-only int8 W8A16, Half/Bfloat16 -> bf16, Float32 -> "
+            "param dtype); other kwargs are ignored",
+            UserWarning, stacklevel=2)
 
     def enable_mkldnn(self):
         self._ignored("enable_mkldnn",

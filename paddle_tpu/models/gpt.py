@@ -117,6 +117,16 @@ class GPTAttention(Layer):
         return (mesh is not None and "sp" in mesh.axis_names and
                 mesh.shape["sp"] > 1)
 
+    def project_qkv(self, x):
+        """Shared q/k/v projection: [b, s, d] -> three [b, s, n, h]
+        Tensors. Single source of truth for the qkv reshape/split so
+        the serving engine's paged-cache step (paddle_tpu/serving)
+        computes bit-identical projections to this module's forward."""
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        return qkv.unbind(axis=2)
+
     def forward(self, x, cache=None, offset=None):
         """cache: optional (k_buf, v_buf) Tensors of FIXED shape —
         FLAT [b, max_len, n*h] on the fused pallas decode path, 4-D
@@ -128,9 +138,7 @@ class GPTAttention(Layer):
         answer to the reference's growing-concat decode caches,
         `fluid/layers/rnn.py:1583` dynamic_decode)."""
         b, s = x.shape[0], x.shape[1]
-        qkv = self.qkv_proj(x)
-        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unbind(axis=2)
+        q, k, v = self.project_qkv(x)
         if cache is not None:
             off = offset if isinstance(offset, Tensor) else \
                 Tensor(jnp.asarray(0 if offset is None else offset,
@@ -351,8 +359,16 @@ class GPTForPretraining(Layer):
         if caches is not None:
             h, new_caches = self.gpt(input_ids, position_ids, caches=caches,
                                      offset=offset)
-        else:
-            h = self.gpt(input_ids, position_ids)
+            return self.lm_head(h), new_caches
+        h = self.gpt(input_ids, position_ids)
+        return self.lm_head(h)
+
+    def lm_head(self, h):
+        """Vocab projection of hidden states [b, s, d] over the tied
+        wte table (quantized or not) -> logits Tensor. Factored out of
+        forward so the serving engine's paged decode step projects
+        logits through EXACTLY this code path (including the wo8
+        int8-matvec dispatch) instead of a copy that could drift."""
         wte = self.gpt.wte
         if hasattr(wte, "wq"):
             # weight-only-int8 tied table (quant/wo8.py): the table is
@@ -367,10 +383,9 @@ class GPTForPretraining(Layer):
 
             def head_q(hh, wq, ws):
                 from ..amp import amp_state
-                import jax as _jax
+                from ..ops.pallas_int8 import int8_matvec_preferred
                 b, s, d = hh.shape
-                if (_jax.default_backend() == "tpu" and b * s <= 64
-                        and not grad_live):
+                if int8_matvec_preferred(b * s) and not grad_live:
                     # decode-sized rows: pallas int8 matvec streams the
                     # int8 tiles into VMEM (XLA won't fuse the
                     # int8->bf16 convert into a dot operand and instead
@@ -388,10 +403,7 @@ class GPTForPretraining(Layer):
                 out = out * ws.astype(jnp.float32)[None, None, :]
                 out = out[..., :V]
                 return out.astype(cdt) if amp_state().enabled else out
-            logits = apply(head_q, h, wte.wq, wte.w_scale)
-            if caches is not None:
-                return logits, new_caches
-            return logits
+            return apply(head_q, h, wte.wq, wte.w_scale)
         w = wte.weight
         from ..amp import maybe_cast_to_compute as _amp
 
@@ -412,10 +424,7 @@ class GPTForPretraining(Layer):
             # accumulator output so a hand-bf16 model still gets f32 CE
             from ..amp import amp_state
             return out.astype(hh.dtype) if amp_state().enabled else out
-        logits = apply(head, h, w)
-        if caches is not None:
-            return logits, new_caches
-        return logits
+        return apply(head, h, w)
 
     def generate(self, input_ids, max_new_tokens=32, decode_strategy="greedy",
                  top_k=0, top_p=1.0, temperature=1.0, num_beams=1,

@@ -151,6 +151,163 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, s_ref, e_ref, out_ref,
         out_ref[0] = (acc_sc[:1] / denom_nh).reshape(out_ref.shape[1:])
 
 
+def _paged_kernel(tab_ref, ctx_ref, q_ref, k_ref, v_ref, s_ref, e_ref,
+                  out_ref, m_sc, l_sc, acc_sc, *, scale, bs, nl):
+    """Paged variant of `_kernel`: the L-tiles are PHYSICAL cache blocks
+    reached through the scalar-prefetched block table (the index_map
+    already resolved logical block `li` of row `b` to a physical arena
+    block), and the causal mask is computed in-kernel from the logical
+    position `li*bs + j` vs the row's context length — no mask input
+    exists because the logical->physical mapping differs per row."""
+    b = pl.program_id(0)
+    li = pl.program_id(1)
+    ctx = ctx_ref[b]
+
+    @pl.when(li == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # blocks wholly past the context hold no valid key (their table
+    # entries point at the null block); skip their accumulation
+    @pl.when(li * bs <= ctx)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)                # [1, NH]
+        k = k_ref[0].astype(jnp.float32)                # [bs, NH]
+        v = v_ref[0].astype(jnp.float32)                # [bs, NH]
+        s = s_ref[...]                                  # [NH, COLS]
+        e = e_ref[...]                                  # [COLS, NH]
+        pos = li * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (bs, _COLS), 0)
+        mask = jnp.where(pos <= ctx, 0.0, -1e30).astype(jnp.float32)
+        qs = s * q.T                                    # [NH, COLS]
+        logits = jax.lax.dot_general(
+            k, qs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bs, COLS]
+        logits = logits + mask
+        m_prev = m_sc[:1]                               # [1, COLS]
+        m_cur = jnp.max(logits, axis=0, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                 # [1, COLS]
+        p = jnp.exp(logits - m_new)                     # [bs, COLS]
+        l_new = alpha * l_sc[:1] + jnp.sum(p, axis=0, keepdims=True)
+        pexp = jax.lax.dot_general(
+            p, e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bs, NH]
+        alpha_nh = jax.lax.dot_general(
+            alpha, e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [1, NH]
+        acc_sc[:1] = acc_sc[:1] * alpha_nh + jnp.sum(
+            pexp * v, axis=0, keepdims=True)            # [1, NH]
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(li == nl - 1)
+    def _finalize():
+        denom = l_sc[:1]                                # [1, COLS]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        e = e_ref[...]
+        denom_nh = jax.lax.dot_general(
+            denom, e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [1, NH]
+        out_ref[0] = (acc_sc[:1] / denom_nh).reshape(out_ref.shape[1:])
+
+
+def paged_decode_supported(block_size, hidden, n_heads, itemsize=2):
+    """Gate for the fused PAGED decode kernel (the block-pool serving
+    cache, paddle_tpu/serving/kv_cache.py): same TPU tiling constraints
+    as the dense gate, applied to one cache BLOCK instead of the whole
+    contiguous buffer — the kernel streams physical blocks through VMEM
+    one at a time via the scalar-prefetched block table."""
+    if block_size % 8 or hidden % 128 or n_heads > _COLS:
+        return False
+    return max(_SUB, block_size) * _per_row_bytes(hidden, itemsize) \
+        <= _VMEM_BUDGET
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, ctx_lens,
+                           n_heads, use_kernel=None):
+    """Decode attention (q_len == 1) over a PAGED KV cache.
+
+    q [S, 1, N*H]; k_pages/v_pages [num_blocks, block_size, N*H] — the
+    shared physical arenas; block_tables [S, max_blocks] int32 mapping
+    each row's logical block i to a physical block (unallocated tail
+    entries point at the reserved null block 0); ctx_lens [S] int32 —
+    each row's current position (keys at logical positions 0..ctx are
+    valid, matching `off` in `decode_attention`). Returns [S, 1, N*H]
+    in q's dtype.
+
+    Two paths, one contract:
+    - fused Pallas kernel (TPU + `paged_decode_supported`): blocks
+      stream through VMEM via the scalar-prefetched table with online
+      softmax — the cache is never materialized contiguously;
+    - gather+dense fallback everywhere else: gather the physical
+      blocks into a dense [S, L, N, H] view and run the SAME composed
+      masked-attention math as models/gpt._cached_attention, so a CPU
+      serving engine is token-for-token identical to `run_generate`.
+    """
+    S, one, nh = q.shape
+    if one != 1:
+        raise ValueError("paged_decode_attention is q_len==1 only")
+    N = n_heads
+    H = nh // N
+    num_blocks, bs, _ = k_pages.shape
+    mb = block_tables.shape[1]
+    scale = 1.0 / float(np.sqrt(H))
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and paged_decode_supported(
+                          bs, nh, N, k_pages.dtype.itemsize))
+    if not use_kernel:
+        # gather+dense: EXACTLY the composed einsum path of
+        # models/gpt._cached_attention (dtypes included) over the
+        # gathered pages — bit-parity with the dense decode cache is
+        # what makes the CPU serving smoke token-identical
+        L = mb * bs
+        k4 = k_pages[block_tables].reshape(S, L, N, H)
+        v4 = v_pages[block_tables].reshape(S, L, N, H)
+        q4 = q.reshape(S, 1, N, H)
+        logits = jnp.einsum("bqnh,bknh->bnqk", q4, k4.astype(q.dtype),
+                            preferred_element_type=jnp.float32) * scale
+        key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
+        logits = jnp.where(key_pos <= ctx_lens[:, None, None, None],
+                           logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bnqk,bknh->bqnh", probs, v4.astype(q.dtype))
+        return out.reshape(S, 1, nh)
+
+    sm, em = _seg_mats(N, H)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, nh), lambda b, i, tab, ctx: (b, 0, 0)),
+            pl.BlockSpec((1, bs, nh),
+                         lambda b, i, tab, ctx: (tab[b, i], 0, 0)),
+            pl.BlockSpec((1, bs, nh),
+                         lambda b, i, tab, ctx: (tab[b, i], 0, 0)),
+            pl.BlockSpec((nh, _COLS), lambda b, i, tab, ctx: (0, 0)),
+            pl.BlockSpec((_COLS, nh), lambda b, i, tab, ctx: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nh),
+                               lambda b, i, tab, ctx: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((_SUB, _COLS), jnp.float32),
+            pltpu.VMEM((_SUB, _COLS), jnp.float32),
+            pltpu.VMEM((_SUB, nh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, bs=bs, nl=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, 1, nh), jnp.float32),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q, k_pages, v_pages, sm, em)
+    return out.astype(q.dtype)
+
+
 def decode_attention(q, k_buf, v_buf, off, n_heads):
     """q [B, 1, N*H]; k_buf/v_buf FLAT [B, L, N*H] (L multiple of 8,
     N*H multiple of 128, N <= 128); off scalar int32 — q's position

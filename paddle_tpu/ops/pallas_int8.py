@@ -26,6 +26,17 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+def int8_matvec_preferred(rows):
+    """Single source of truth for WHEN the pallas int8 head matvec
+    beats the XLA einsum: decode-sized row counts on TPU (measured on
+    v5e at the 125M head: pallas 11.1k tok/s vs einsum 10.8k vs bf16
+    11.8k — see quant/wo8.py NOTE). Shared by the training model's
+    quantized head branch (models/gpt.py head_q) and the serving
+    engine's decode step, whose batch IS `rows` — a continuous-batching
+    slot count above this bound should take the einsum instead."""
+    return jax.default_backend() == "tpu" and rows <= 64
+
+
 def _kernel(h_ref, wq_ref, s_ref, out_ref):
     hh = h_ref[...].astype(jnp.bfloat16)            # [Bp, D]
     w = wq_ref[...].astype(jnp.bfloat16)            # [bv, D]

@@ -14,6 +14,7 @@ from .qat import (FakeQuantAbsMax, QuantizedLinear, QuantizedConv2D,  # noqa: F4
                   QuantizedConv2DBN, QAT, quant_dequant,
                   quant_dequant_channelwise)
 from .wo8 import (WeightOnlyInt8Linear, WeightOnlyInt8Embedding,  # noqa: F401
-                  quantize_weights_int8, channelwise_int8)
+                  quantize_weights_int8, quantize_for_decode,
+                  channelwise_int8)
 from .ptq import (PTQ, AbsmaxQuantizer, HistQuantizer, KLQuantizer,  # noqa: F401
                   Int8Linear, Int8Conv2D, fold_conv_bn)

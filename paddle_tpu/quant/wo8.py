@@ -24,7 +24,8 @@ from .. import nn
 from ..core.tensor import Tensor, apply
 
 __all__ = ["WeightOnlyInt8Linear", "WeightOnlyInt8Embedding",
-           "quantize_weights_int8", "channelwise_int8"]
+           "quantize_weights_int8", "quantize_for_decode",
+           "channelwise_int8"]
 
 
 def channelwise_int8(w, bits=8):
@@ -125,6 +126,39 @@ class WeightOnlyInt8Embedding(nn.Layer):
         from ..core.tensor import apply as _apply
         from ..tensor._helpers import ensure_tensor
         return _apply(fn, ensure_tensor(x), self.wq, self.w_scale)
+
+
+def _holds_wo8(layer):
+    for child in layer._sub_layers.values():
+        if isinstance(child, (WeightOnlyInt8Linear, WeightOnlyInt8Embedding)):
+            return True
+        if _holds_wo8(child):
+            return True
+    return False
+
+
+def quantize_for_decode(model, bits=8, min_features=0):
+    """THE weight-only-int8 entry for decode consumers — bench.py's
+    `decode_wo8` phase and the serving engine's `weights="wo8"` mode
+    share this one implementation (ISSUE 8 satellite: no bench-local
+    quantization drift). Thin discipline over `quantize_weights_int8`:
+
+    - idempotent: an already-quantized model is a no-op (returns 0),
+      so an engine built over a pre-quantized checkpoint doesn't
+      double-quantize (which would quantize the int8 *scales*);
+    - loud: a model with NOTHING to quantize raises instead of
+      silently serving fp weights under a "wo8" label.
+
+    Returns the number of swapped layers."""
+    if _holds_wo8(model):
+        return 0
+    swapped = quantize_weights_int8(model, bits=bits,
+                                    min_features=min_features)
+    if swapped == 0:
+        raise ValueError(
+            "quantize_for_decode: model holds no quantizable nn.Linear "
+            "layers — refusing to serve full-precision weights as wo8")
+    return swapped
 
 
 def quantize_weights_int8(layer, bits=8, min_features=0,

@@ -1,0 +1,38 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+The "millions of users" layer (ROADMAP): a long-lived engine process
+that serves many concurrent generation streams from ONE compiled
+decode step over a paged KV cache, instead of one run_generate program
+per request.
+
+- `kv_cache` — block-pool allocator + paged K/V arenas
+  ([num_blocks, block_size, hidden] per layer; PagedAttention layout).
+- `scheduler` — token-granular continuous batching: admit/evict at
+  every step, chunked prefill interleaved with decode, preemption by
+  recompute (Orca/vLLM scheduling).
+- `engine` — `ServingEngine`: fixed-shape compiled prefill/decode
+  steps (recompile-free steady state, compile-observatory-checkable),
+  per-slot greedy/top-k/top-p sampling, streaming token handles,
+  `serving.*` metrics on the monitor registry. `EngineConfig
+  .from_inference_config` routes the `paddle_tpu.inference.Config`
+  compat switches (device, memory pool, precision) into real engine
+  behavior.
+- `http` — stdlib streaming HTTP front (`POST /generate`, `/metrics`,
+  `/healthz`), riding the PR-3 MetricsServer pattern.
+
+Benchmarked by `bench_serving.py` (offered-load sweep -> typed
+kind=bench `serving.*` records gated by tools/bench_gate.py); smoked in
+CI by `tools/serving_smoke.py` (token parity with run_generate +
+eviction selfcheck).
+"""
+from .kv_cache import BlockPool, PagedKVCache  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Request, RequestHandle, SamplingParams, Scheduler)
+from .engine import EngineConfig, ServingEngine  # noqa: F401
+from .http import ServingHTTPServer  # noqa: F401
+
+__all__ = [
+    "BlockPool", "PagedKVCache", "Request", "RequestHandle",
+    "SamplingParams", "Scheduler", "EngineConfig", "ServingEngine",
+    "ServingHTTPServer",
+]

@@ -1,0 +1,664 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The decode loop run_generate compiles is perfect for ONE request; a
+serving process needs the loop inverted: a long-lived engine holding
+ONE compiled decode step over a fixed batch of SLOTS, with requests
+flowing through the slots at token granularity (scheduler.py) and K/V
+living in the shared block arena (kv_cache.py). Every engine step is
+at most one chunked-prefill dispatch plus one decode dispatch, both at
+FIXED shapes — after warmup the steady state is recompile-free, and the
+PR-4 compile observatory can prove it (`telemetry.observed_dispatch`
+routes both steps through the signature-keyed AOT cache when an
+observatory is active).
+
+Numerics contract: the engine computes the EXACT math of
+`generation.run_generate`'s composed decode path — the same Layer
+objects (project_qkv/out_proj/_add_ln2/mlp/lm_head), the same masked
+f32-softmax attention (ops.pallas_decode.paged_decode_attention's
+gather+dense fallback mirrors models/gpt._cached_attention), the same
+f32 argmax — so a greedy stream through the batched engine is
+token-for-token identical to a single run_generate call
+(tools/serving_smoke.py gates this in CI). Sampling slots use
+per-REQUEST fold_in(token_index) keys, so a sampled stream is also
+independent of what else shares the batch.
+
+Metrics: `serving.*` gauges/counters on the process monitor registry —
+scrape them from any `telemetry.MetricsServer` or the serving HTTP
+front (serving/http.py): queue depth, KV-block utilization, preemption
+count, per-request TTFT/TPOT p50/p99.
+"""
+import contextlib
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import monitor
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..generation import _cast_params
+from ..jit import bind_tensors
+from ..ops.pallas_decode import paged_decode_attention
+from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache
+from .scheduler import (PREFILL, RequestHandle, Request, SamplingParams,
+                        Scheduler)
+
+__all__ = ["EngineConfig", "ServingEngine"]
+
+_NEG_INF = -1e30
+
+
+class EngineConfig:
+    """Engine shape/capacity knobs. Everything that feeds a compiled
+    step shape is fixed here at construction — that is what keeps the
+    steady state recompile-free."""
+
+    def __init__(self, max_slots=4, block_size=16, num_blocks=None,
+                 max_model_len=None, prefill_chunk=32, dtype="bfloat16",
+                 weights="native", kv_memory_mb=None, device=None):
+        if weights not in ("native", "wo8"):
+            raise ValueError(f"weights must be 'native' or 'wo8', "
+                             f"got {weights!r}")
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
+        self.max_model_len = max_model_len
+        self.prefill_chunk = int(prefill_chunk)
+        self.dtype = dtype
+        self.weights = weights
+        self.kv_memory_mb = kv_memory_mb
+        self.device = device
+
+    @classmethod
+    def from_inference_config(cls, config, **overrides):
+        """Build from a `paddle_tpu.inference.Config` — the compat
+        surface's device/precision switches select real engine
+        behavior here (see inference/predictor.py):
+
+        - `disable_gpu()` -> the engine and its KV arenas live on the
+          host CPU device;
+        - `enable_use_gpu(memory_pool_init_size_mb=N)` -> accelerator
+          device, and N megabytes budget the paged-KV arena size;
+        - `enable_tensorrt_engine(precision_mode=...)` -> decode
+          compute dtype: Int8 -> weight-only-int8 weights with bf16
+          activations (the W8A16 serving recipe), Half/Bfloat16 ->
+          bf16, Float32 -> the parameters' own dtype.
+        """
+        kw = {}
+        if not getattr(config, "_use_tpu", True):
+            kw["device"] = jax.devices("cpu")[0]
+        pool_mb = getattr(config, "_memory_pool_mb", 0)
+        if pool_mb:
+            kw["kv_memory_mb"] = int(pool_mb)
+        precision = getattr(config, "_serving_precision", None)
+        if precision is not None:
+            from ..inference.predictor import PrecisionType
+            if precision == PrecisionType.Int8:
+                kw["weights"] = "wo8"
+                kw["dtype"] = "bfloat16"
+            elif precision in (PrecisionType.Half, PrecisionType.Bfloat16):
+                kw["dtype"] = "bfloat16"
+            elif precision == PrecisionType.Float32:
+                kw["dtype"] = None
+        kw.update(overrides)
+        return cls(**kw)
+
+
+class ServingEngine:
+    """submit(prompt, params) -> streaming RequestHandle; step() runs
+    one scheduler iteration (one prefill chunk + one decode batch);
+    start()/stop() run the loop on a background thread.
+
+    `model` must expose the incremental-GPT protocol: `.gpt` core with
+    `wte/wpe/drop/blocks/ln_f` (each block: `ln1/attn/_add_ln2/mlp/
+    dropout`, attn: `project_qkv/out_proj`) plus `.lm_head(h)` —
+    i.e. GPTForPretraining, quantized or not.
+    """
+
+    def __init__(self, model, config=None, **overrides):
+        self.cfg = config or EngineConfig(**overrides)
+        cfg = self.cfg
+        self.model = model
+        mcfg = model.config
+        if cfg.weights == "wo8":
+            from ..quant import quantize_for_decode
+            quantize_for_decode(model)
+        self.n_heads = mcfg.num_heads
+        self.hidden = mcfg.hidden_size
+        self.head_dim = self.hidden // self.n_heads
+        self.max_model_len = int(cfg.max_model_len or mcfg.max_seq_len)
+        self.block_size = cfg.block_size
+        self.max_blocks_per_seq = PagedKVCache.blocks_for_tokens(
+            self.max_model_len, self.block_size)
+        self._compute_dtype = cfg.dtype or mcfg.dtype
+
+        if cfg.device is not None:
+            # serve from the configured device: move the weights once
+            # (the tools/serve_13b_w8a16.py recipe), arenas follow
+            for p in model.parameters():
+                p._value = jax.device_put(p._value, cfg.device)
+            for b in model.buffers():
+                if b is not None:
+                    b._value = jax.device_put(b._value, cfg.device)
+
+        num_blocks = self._resolve_num_blocks()
+        self.pool = BlockPool(num_blocks)
+        with jax.default_device(cfg.device) if cfg.device is not None \
+                else contextlib.nullcontext():
+            self.cache = PagedKVCache(
+                mcfg.num_layers, num_blocks, self.block_size, self.hidden,
+                dtype=self._compute_dtype)
+        self.sched = Scheduler(self.pool, self.block_size, cfg.max_slots,
+                               self.max_model_len)
+
+        named = list(model.named_parameters()) + [
+            (n, b) for n, b in model.named_buffers() if b is not None]
+        self._bound = [p for _, p in named]
+        self._build_fns()
+
+        self._mu = threading.RLock()
+        self._cv = threading.Condition(self._mu)
+        self._thread = None
+        self._stopping = False
+        self._ttft_ms = []
+        self._tpot_ms = []
+        self._lat_dirty = False
+        self._finished = 0
+        self.kv_peak_utilization = 0.0
+        monitor.set_gauge("serving.kv_blocks_total", self.pool.capacity)
+        self._update_gauges()
+
+    # ------------------------------------------------------------------
+    # sizing
+    # ------------------------------------------------------------------
+    def _resolve_num_blocks(self):
+        cfg = self.cfg
+        if cfg.num_blocks is not None:
+            return int(cfg.num_blocks)
+        mcfg = self.model.config
+        if cfg.kv_memory_mb:
+            per_block = (2 * mcfg.num_layers * self.block_size
+                         * self.hidden
+                         * jnp.dtype(self._compute_dtype).itemsize)
+            n = int(cfg.kv_memory_mb) * 2 ** 20 // per_block
+            return max(2, n)
+        # default: every slot can hold a full-length sequence (+ null)
+        return cfg.max_slots * self.max_blocks_per_seq + 1
+
+    # ------------------------------------------------------------------
+    # compiled step functions
+    # ------------------------------------------------------------------
+    def _build_fns(self):
+        model = self.model
+        core = model.gpt
+        bound = self._bound
+        dtype = self.cfg.dtype
+        n_heads = self.n_heads
+        nh = self.hidden
+        bs_blk = self.block_size
+        mb = self.max_blocks_per_seq
+        S = self.cfg.max_slots
+        C = self.cfg.prefill_chunk
+        kv_dt = jnp.dtype(self._compute_dtype)
+
+        def block_step(block, h, attend, write):
+            """One GPTBlock at decode/prefill time over the paged cache
+            — the exact cache-branch math of GPTBlock.forward, with
+            attention routed through `attend` and K/V through `write`."""
+            y = block.ln1(h)
+            q, k, v = block.attn.project_qkv(y)
+            kp, vp = write(k._value, v._value)
+            out = attend(q._value, kp, vp)
+            a = block.attn.out_proj(Tensor(out))
+            y2, h2 = block._add_ln2(h, block.dropout(a))
+            h = h2 + block.dropout(block.mlp(y2))
+            return h, kp, vp
+
+        def select(last, rngs, temp, top_k, top_p, greedy,
+                   sampling=True):
+            """Per-slot token selection: run_generate's _make_selector
+            math with the knobs as ARRAYS (one compiled program serves
+            every per-request sampling config). temperature division is
+            exact for 1.0, dynamic top-k via the k-th order statistic,
+            dynamic top-p via the same sorted-cumsum mask.
+
+            sampling=False builds the GREEDY-ONLY program — no sorts,
+            no rng: the sort/categorical machinery measures ~1/3 of the
+            whole decode step on the CPU smoke, and a decode batch whose
+            active slots are all greedy shouldn't pay it (the engine
+            dispatches the variant per step; each compiles once)."""
+            V = last.shape[-1]
+            lg = last.astype(jnp.float32) / temp[:, None]
+            greedy_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            if not sampling:
+                tok = greedy_tok
+            else:
+                sorted_desc = jnp.sort(lg, axis=-1)[:, ::-1]
+                k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+                kth = jnp.take_along_axis(sorted_desc,
+                                          (k_eff - 1)[:, None], 1)
+                lg_s = jnp.where(lg < kth, _NEG_INF, lg)
+                sort_idx = jnp.argsort(-lg_s, axis=-1)
+                sorted_logits = jnp.take_along_axis(lg_s, sort_idx, axis=-1)
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = (cum - probs) < top_p[:, None]  # top tok always kept
+                masked = jnp.where(keep, sorted_logits, _NEG_INF)
+                inv = jnp.argsort(sort_idx, axis=-1)
+                lg_s = jnp.take_along_axis(masked, inv, axis=-1)
+                sampled = jax.vmap(jax.random.categorical)(rngs, lg_s) \
+                    .astype(jnp.int32)
+                tok = jnp.where(greedy, greedy_tok, sampled)
+            logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+            tok_logp = jnp.take_along_axis(logp, tok[:, None], 1)[:, 0]
+            return tok, tok_logp
+
+        def decode_fn(param_vals, k_pages, v_pages, tokens, ctx, tables,
+                      keys, counts, temp, top_k, top_p, greedy,
+                      sampling=True):
+            param_vals = _cast_params(param_vals, dtype)
+            with autograd.fresh_tape(), autograd.no_grad(), \
+                    bind_tensors(bound, param_vals):
+                ids = Tensor(tokens[:, None])
+                pos = Tensor(ctx[:, None])
+                h = core.wte(ids) + core.wpe(pos)
+                h = core.drop(h)
+                blk = jnp.take_along_axis(
+                    tables, (ctx // bs_blk)[:, None], axis=1)[:, 0]
+                off = ctx % bs_blk
+                new_k, new_v = [], []
+
+                def write_l(layer):
+                    def write(kv, vv):
+                        kp = k_pages[layer].at[blk, off].set(
+                            kv.reshape(S, nh).astype(kv_dt))
+                        vp = v_pages[layer].at[blk, off].set(
+                            vv.reshape(S, nh).astype(kv_dt))
+                        return kp, vp
+                    return write
+
+                def attend(qv, kp, vp):
+                    return paged_decode_attention(
+                        qv.reshape(S, 1, nh), kp, vp, tables, ctx,
+                        n_heads)
+
+                for li, block in enumerate(core.blocks):
+                    h, kp, vp = block_step(block, h, attend, write_l(li))
+                    new_k.append(kp)
+                    new_v.append(vp)
+                last = model.lm_head(core.ln_f(h))._value[:, -1]
+                rngs = jax.vmap(jax.random.fold_in)(keys, counts) \
+                    if sampling else keys
+                tok, logp = select(last, rngs, temp, top_k, top_p,
+                                   greedy, sampling=sampling)
+            return tok, logp, tuple(new_k), tuple(new_v)
+
+        def prefill_fn(param_vals, k_pages, v_pages, ids, p0, n_real,
+                       table_row, key, count, temp, top_k, top_p, greedy):
+            """One chunk of ONE request: ids [1, C] (tail past n_real is
+            padding -> null-block writes), positions p0..p0+C-1. Also
+            samples the next token from the last REAL position — used
+            only when the host knows this was the final chunk."""
+            param_vals = _cast_params(param_vals, dtype)
+            with autograd.fresh_tape(), autograd.no_grad(), \
+                    bind_tensors(bound, param_vals):
+                positions = p0 + jnp.arange(C, dtype=jnp.int32)
+                h = core.wte(Tensor(ids)) + core.wpe(Tensor(positions[None]))
+                h = core.drop(h)
+                tmask = jnp.arange(C, dtype=jnp.int32) < n_real
+                blk = jnp.where(
+                    tmask,
+                    table_row[jnp.clip(positions // bs_blk, 0, mb - 1)],
+                    NULL_BLOCK)
+                off = positions % bs_blk
+                N, H = n_heads, nh // n_heads
+                L = mb * bs_blk
+                scale = 1.0 / float(np.sqrt(H))
+
+                def write(kv, vv):
+                    kp = k_pages_cur.at[blk, off].set(
+                        kv.reshape(C, nh).astype(kv_dt))
+                    vp = v_pages_cur.at[blk, off].set(
+                        vv.reshape(C, nh).astype(kv_dt))
+                    return kp, vp
+
+                def attend(qv, kp, vp):
+                    # composed masked attention over the gathered pages
+                    # — models/gpt._cached_attention's prefill math
+                    k4 = kp[table_row].reshape(1, L, N, H)
+                    v4 = vp[table_row].reshape(1, L, N, H)
+                    logits = jnp.einsum(
+                        "bqnh,bknh->bnqk", qv, k4.astype(qv.dtype),
+                        preferred_element_type=jnp.float32) * scale
+                    key_pos = jnp.arange(L, dtype=jnp.int32)[
+                        None, None, None, :]
+                    q_pos = positions[None, None, :, None]
+                    logits = jnp.where(key_pos <= q_pos, logits, _NEG_INF)
+                    probs = jax.nn.softmax(logits, axis=-1) \
+                        .astype(qv.dtype)
+                    out = jnp.einsum("bnqk,bknh->bqnh", probs,
+                                     v4.astype(qv.dtype))
+                    return out.reshape(1, C, nh)
+
+                new_k, new_v = [], []
+                for li, block in enumerate(core.blocks):
+                    k_pages_cur = k_pages[li]
+                    v_pages_cur = v_pages[li]
+                    h, kp, vp = block_step(block, h, attend, write)
+                    new_k.append(kp)
+                    new_v.append(vp)
+                hf = core.ln_f(h)
+                h_last = jax.lax.dynamic_slice(
+                    hf._value, (0, n_real - 1, 0), (1, 1, hf.shape[-1]))
+                last = model.lm_head(Tensor(h_last))._value[:, -1]
+                rngs = jax.random.fold_in(key, count)[None]
+                tok, logp = select(last, rngs, temp[None], top_k[None],
+                                   top_p[None], greedy[None])
+            return tok[0], logp[0], tuple(new_k), tuple(new_v)
+
+        import functools
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        self._decode_jit = jax.jit(
+            functools.partial(decode_fn, sampling=True),
+            donate_argnums=donate)
+        self._decode_greedy_jit = jax.jit(
+            functools.partial(decode_fn, sampling=False),
+            donate_argnums=donate)
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
+
+    def _dispatch(self, family, jitted, args):
+        """Route through the PR-4 compile observatory when one is
+        active: every (re)compile of the serving steps becomes a
+        kind=compile record with a cause diff, and the recompile-free
+        steady state is checkable from the telemetry alone."""
+        from ..telemetry import observed_dispatch
+        return observed_dispatch(family, jitted, args)
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids, params=None, **kw):
+        """Queue one generation; returns a RequestHandle whose
+        `.tokens()` stream yields ids as the engine emits them."""
+        params = params or SamplingParams(**kw)
+        if params.seed is not None:
+            base = jax.random.PRNGKey(int(params.seed))
+        elif params.greedy:
+            base = jax.random.PRNGKey(0)    # unused by greedy slots
+        else:
+            from ..core.random import default_generator
+            base = default_generator().split()
+        req = Request(prompt_ids, params, np.asarray(base))
+        with self._cv:
+            self.sched.submit(req)
+            monitor.incr("serving.requests")
+            self._update_gauges()
+            self._cv.notify_all()
+        return RequestHandle(req)
+
+    # ------------------------------------------------------------------
+    # the engine loop
+    # ------------------------------------------------------------------
+    def step(self):
+        """One scheduler iteration: admit, at most one prefill chunk,
+        one decode batch. Returns True when any work was done."""
+        with self._mu:
+            self.sched.admit()
+            did = self._prefill_one()
+            did = self._decode_once() or did
+            self._update_gauges()
+            return did
+
+    def run_until_idle(self, max_steps=None):
+        n = 0
+        while self.sched.has_work():
+            self.step()
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="paddle-tpu-serving-engine",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                # join timed out (e.g. mid-compile): keep the reference
+                # so a later start() cannot race a SECOND loop against
+                # this one — the stale loop exits at its next _stopping
+                # check, and start() stays a no-op until it has
+                return
+            self._thread = None
+
+    def _serve_loop(self):
+        while True:
+            with self._cv:
+                if self._stopping:
+                    return
+                if not self.sched.has_work():
+                    self._cv.wait(timeout=0.1)
+                    continue
+            try:
+                did = self.step()
+            except Exception as e:      # noqa: BLE001 — long-lived loop
+                # a dead serve thread strands every open stream forever;
+                # fail the in-flight requests LOUDLY and keep serving
+                self._on_step_error(e)
+                continue
+            if not did:
+                # work exists but none runnable (prefill waiting on
+                # blocks): don't spin the lock hot
+                time.sleep(0.002)
+
+    def _on_step_error(self, exc):
+        """A compiled step raised mid-flight (device OOM, runtime
+        error): the in-flight requests' KV state — and, under donation,
+        the arenas themselves — are suspect. Fail every ACTIVE request
+        with the error (their streams raise instead of hanging), rebuild
+        the arenas/pool clean, and leave the queued (not-yet-started)
+        requests to be served fresh. Manual step() callers see the
+        exception raw — this path is the background loop's."""
+        import traceback
+        monitor.incr("serving.engine_errors")
+        msg = f"{type(exc).__name__}: {exc}"
+        traceback.print_exc()
+        with self._mu:
+            active = list(self.sched.prefilling) + [
+                r for r in self.sched.running if r is not None]
+            for req in active:
+                self.sched.finish(req, error=msg)
+            self.pool = BlockPool(self.pool.num_blocks)
+            self.sched.pool = self.pool
+            with jax.default_device(self.cfg.device) \
+                    if self.cfg.device is not None \
+                    else contextlib.nullcontext():
+                self.cache = PagedKVCache(
+                    self.cache.num_layers, self.cache.num_blocks,
+                    self.cache.block_size, self.cache.hidden,
+                    dtype=self.cache.dtype)
+            self._update_gauges()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    # device-step drivers
+    # ------------------------------------------------------------------
+    def _prefill_one(self):
+        sched = self.sched
+        # prefill growth normally WAITS for blocks instead of evicting
+        # (a not-yet-streaming request must never thrash the decode
+        # batch) — but when NOTHING is decoding, waiting would deadlock
+        # a pool fully held by fellow prefills, so the oldest prefill
+        # may then evict its way forward
+        allow_evict = sched.num_running() == 0
+        for idx, req in enumerate(list(sched.prefilling)):
+            seq = req.tokens_all
+            p0 = req.n_prefilled
+            c_real = min(self.cfg.prefill_chunk, len(seq) - p0)
+            if c_real <= 0:                     # defensive; place it
+                sched.place(req)
+                continue
+            if not sched.ensure_blocks(req, p0 + c_real,
+                                       evict=allow_evict and idx == 0):
+                continue                        # wait for free blocks
+            C = self.cfg.prefill_chunk
+            ids = np.zeros((1, C), np.int32)
+            ids[0, :c_real] = seq[p0:p0 + c_real]
+            table_row = self._table_row(req)
+            p = req.params
+            g = len(req.out_tokens)
+            args = (self._param_vals(), self.cache.k, self.cache.v,
+                    ids,
+                    np.int32(p0), np.int32(c_real),
+                    table_row,
+                    req.rng_key, np.int32(g),
+                    np.float32(p.temperature), np.int32(p.top_k),
+                    np.float32(p.top_p), np.bool_(p.greedy))
+            tok, logp, new_k, new_v = self._dispatch(
+                "serving_prefill", self._prefill_jit, args)
+            self.cache.swap(new_k, new_v)
+            monitor.incr("serving.prefill_chunks")
+            req.n_prefilled = p0 + c_real
+            if req.n_prefilled >= len(seq):
+                # final chunk: the sampled token is the next stream token
+                # (the engine IS the API boundary: tokens must land on
+                # the host to stream; the second fetch copies a buffer
+                # the first already waited for)
+                self._emit(req, int(np.asarray(tok)),
+                           float(np.asarray(logp)))
+                if req.state == PREFILL:    # _emit finishes done ones
+                    sched.place(req)
+            return True
+        return False
+
+    def _decode_once(self):
+        sched = self.sched
+        # grow blocks oldest-first so eviction lands on the youngest
+        for req in list(sched.admit_order):
+            if req.slot is None:
+                continue
+            sched.ensure_blocks(req, req.n_prefilled + 1, evict=True)
+        active = [(i, r) for i, r in enumerate(sched.running)
+                  if r is not None]
+        if not active:
+            return False
+        S = self.cfg.max_slots
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((S,), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        tables = np.full((S, mb), NULL_BLOCK, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        counts = np.zeros((S,), np.int32)
+        temp = np.ones((S,), np.float32)
+        top_k = np.zeros((S,), np.int32)
+        top_p = np.ones((S,), np.float32)
+        greedy = np.ones((S,), np.bool_)
+        for i, req in active:
+            p = req.params
+            tokens[i] = req.tokens_all[req.n_prefilled]
+            ctx[i] = req.n_prefilled
+            tables[i, :len(req.blocks)] = req.blocks
+            keys[i] = req.rng_key
+            counts[i] = len(req.out_tokens)
+            temp[i] = p.temperature
+            top_k[i] = p.top_k
+            top_p[i] = p.top_p
+            greedy[i] = p.greedy
+        # numpy args go straight into the jitted call: the C++ dispatch
+        # path transfers them, which profiles ~2x cheaper per step than
+        # a python-level jnp.asarray round for each array
+        args = (self._param_vals(), self.cache.k, self.cache.v,
+                tokens, ctx, tables, keys, counts, temp, top_k, top_p,
+                greedy)
+        # all-greedy batches take the sort-free program (distinct
+        # compile FAMILY, not a recompile — each variant compiles once)
+        sampling = any(not r.params.greedy for _, r in active)
+        tok, logp, new_k, new_v = self._dispatch(
+            "serving_decode_sampling" if sampling else "serving_decode",
+            self._decode_jit if sampling else self._decode_greedy_jit,
+            args)
+        self.cache.swap(new_k, new_v)
+        # host sync: the engine is the API boundary — the sampled
+        # tokens must land on the host to stream/route; logp's buffer
+        # is ready once tok's fetch has waited
+        tok = np.asarray(tok)
+        logp = np.asarray(logp)
+        monitor.incr("serving.decode_steps")
+        now = time.monotonic()
+        for i, req in active:
+            req.n_prefilled += 1
+            self._emit(req, int(tok[i]), float(logp[i]), now=now)
+        return True
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _param_vals(self):
+        return [p._value for p in self._bound]
+
+    def _table_row(self, req):
+        row = np.full((self.max_blocks_per_seq,), NULL_BLOCK, np.int32)
+        row[:len(req.blocks)] = req.blocks
+        return row
+
+    def _emit(self, req, tok, logp, now=None):
+        req.push_token(tok, now=now)
+        monitor.incr("serving.tokens_generated")
+        if req.done:
+            self.sched.finish(req)
+            self._finished += 1
+            monitor.incr("serving.finished")
+            t = req.ttft_ms()
+            if t is not None:
+                self._ttft_ms.append(t)
+                del self._ttft_ms[:-2048]
+            t = req.tpot_ms()
+            if t is not None:
+                self._tpot_ms.append(t)
+                del self._tpot_ms[:-2048]
+            self._lat_dirty = True
+
+    def _update_gauges(self):
+        monitor.set_gauge("serving.queue_depth", len(self.sched.waiting))
+        monitor.set_gauge("serving.running", self.sched.num_running())
+        monitor.set_gauge("serving.prefilling", len(self.sched.prefilling))
+        monitor.set_gauge("serving.kv_blocks_used", self.pool.num_used)
+        util = self.pool.utilization()
+        monitor.set_gauge("serving.kv_block_utilization", util)
+        self.kv_peak_utilization = max(self.kv_peak_utilization, util)
+        if self._lat_dirty:      # percentiles only when a request landed
+            self._lat_dirty = False
+            for name, vals in (("ttft", self._ttft_ms),
+                               ("tpot", self._tpot_ms)):
+                if vals:
+                    monitor.set_gauge(f"serving.{name}_p50_ms",
+                                      float(np.percentile(vals, 50)))
+                    monitor.set_gauge(f"serving.{name}_p99_ms",
+                                      float(np.percentile(vals, 99)))
+
+    def metrics_snapshot(self):
+        """Point-in-time serving stats (the /metrics serving.* family,
+        as a dict)."""
+        snap = monitor.snapshot()
+        return {k: v for k, v in snap.items()
+                if k.startswith("serving.")}

@@ -1,0 +1,170 @@
+"""Stdlib HTTP front for the serving engine.
+
+Rides the PR-3 `telemetry.metrics_http.MetricsServer` pattern: a
+threaded `http.server` endpoint with zero serving dependencies, so the
+engine process is scrapeable and servable with nothing but the stdlib.
+
+- **POST /generate** — body `{"prompt": [ids...], "max_new_tokens": N,
+  "decode_strategy": "greedy"|"sampling", "top_k", "top_p",
+  "temperature", "eos_token_id", "seed", "stream": bool}`.
+  `stream=true` answers chunked `application/jsonl`: one
+  `{"token": id}` line per generated token AS THE ENGINE EMITS IT
+  (continuous batching means concurrent streams interleave at token
+  granularity), then a `{"done": true, "tokens": [...]}` tail.
+  `stream=false` blocks and answers `{"tokens": [...]}` once.
+- **GET /metrics** — Prometheus text: the whole monitor registry,
+  which includes the engine's `serving.*` gauges/counters (queue
+  depth, KV-block utilization, preemptions, TTFT/TPOT p50/p99).
+- **GET /healthz** — engine liveness + the serving.* snapshot.
+
+    engine = ServingEngine(model, max_slots=8).start()
+    srv = ServingHTTPServer(engine, port=8000).start()
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..telemetry.metrics_http import prometheus_text
+from .scheduler import SamplingParams
+
+__all__ = ["ServingHTTPServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-serving/1"
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code, body, ctype="application/json"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        engine = self.server.engine
+        if self.path == "/metrics":
+            self._send(200, prometheus_text(),
+                       ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif self.path in ("/", "/healthz"):
+            body = {"status": "ok",
+                    "serving": engine.metrics_snapshot()}
+            self._send(200, json.dumps(body, indent=2, default=repr))
+        else:
+            self._send(404, json.dumps(
+                {"error": f"unknown path {self.path!r}",
+                 "endpoints": ["POST /generate", "/metrics", "/healthz"]}))
+
+    def do_POST(self):
+        if self.path != "/generate":
+            self._send(404, json.dumps({"error": "POST /generate only"}))
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            prompt = req["prompt"]
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError("'prompt' must be a non-empty id list")
+            params = SamplingParams(
+                max_new_tokens=req.get("max_new_tokens", 32),
+                decode_strategy=req.get("decode_strategy", "greedy"),
+                top_k=req.get("top_k", 0),
+                top_p=req.get("top_p", 1.0),
+                temperature=req.get("temperature", 1.0),
+                eos_token_id=req.get("eos_token_id"),
+                seed=req.get("seed"))
+            stream = bool(req.get("stream", False))
+        except (KeyError, ValueError, TypeError,
+                json.JSONDecodeError) as e:
+            self._send(400, json.dumps({"error": str(e)}))
+            return
+        try:
+            handle = self.server.engine.submit([int(t) for t in prompt],
+                                               params)
+        except ValueError as e:       # over-length request etc.
+            self._send(429, json.dumps({"error": str(e)}))
+            return
+        if not stream:
+            try:
+                toks = handle.result(timeout=self.server.request_timeout)
+            except Exception as e:
+                self._send(500, json.dumps({"error": str(e)}))
+                return
+            self._send(200, json.dumps({"tokens": toks,
+                                        "stats": handle.stats}))
+            return
+        # chunked token stream: one JSON line per token as it lands
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj):
+            data = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                             + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            toks = []
+            for tok in handle.tokens(timeout=self.server.request_timeout):
+                toks.append(tok)
+                chunk({"token": tok})
+            chunk({"done": True, "tokens": toks, "stats": handle.stats})
+        except Exception as e:
+            chunk({"error": str(e)})
+        self.wfile.write(b"0\r\n\r\n")
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+class ServingHTTPServer:
+    """Threaded HTTP endpoint over a running ServingEngine. start() is
+    non-blocking; the engine's own loop thread does the work."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 request_timeout=300.0):
+        self.engine = engine
+        self.host = host
+        self.port = int(port)
+        self.request_timeout = float(request_timeout)
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        httpd.daemon_threads = True
+        httpd.engine = self.engine
+        httpd.request_timeout = self.request_timeout
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="paddle-tpu-serving-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

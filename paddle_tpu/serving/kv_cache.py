@@ -1,0 +1,141 @@
+"""Paged KV cache: a block-pool allocator over preallocated HBM arenas.
+
+The dense per-request decode cache (`GPTModel.init_cache`) reserves
+`max_seq_len` positions for every request up front — at serving batch
+sizes almost all of it is padding, and admission is all-or-nothing.
+PagedAttention (vLLM, SOSP '23) showed the fix: carve the cache into
+fixed-size BLOCKS in one shared physical arena, give each request a
+block TABLE mapping logical positions to physical blocks, and
+allocate/free blocks at token granularity. Utilization becomes
+~100% - half a block per request, and eviction is O(blocks) pointer
+surgery instead of buffer copies.
+
+Two layers, split host/device:
+
+- `BlockPool` — the HOST-side allocator: a free list of physical block
+  ids with per-request ownership tracking. Pure Python, deterministic
+  (LIFO free list) so a seeded request schedule replays bit-identically.
+  Block 0 is RESERVED as the null block: padded batch slots and masked
+  prefill tails write their garbage there, so the compiled step needs
+  no branches.
+- `PagedKVCache` — the DEVICE-side arenas: per layer, K and V as
+  `[num_blocks, block_size, hidden]` jnp arrays (the flat [*, n*h]
+  minor layout the fused decode kernels require — see
+  ops/pallas_decode.py). The arrays are handed to the engine's compiled
+  step functions, updated functionally, and stored back; `swap()` is
+  the single mutation point so donation stays sound.
+
+The attention over this layout is `ops.pallas_decode.paged_decode_attention`.
+"""
+import jax.numpy as jnp
+
+__all__ = ["BlockPool", "PagedKVCache", "NULL_BLOCK"]
+
+# physical block 0 is never allocated: it is the write target for
+# padded batch slots and masked prefill tails (their values are
+# garbage by construction and never read back)
+NULL_BLOCK = 0
+
+
+class BlockPool:
+    """Free-list allocator over `num_blocks` physical blocks (block 0
+    reserved). Any free block serves any request — paging means
+    fragmentation cannot strand capacity — and the LIFO discipline
+    makes allocation deterministic under a replayed schedule."""
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(
+                f"BlockPool needs >= 2 blocks (one is the reserved null "
+                f"block), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO stack; low ids allocated first for readable tests
+        self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
+        self._owner = {}          # block id -> owner tag
+
+    @property
+    def capacity(self):
+        """Allocatable blocks (the null block is not capacity)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return self.capacity - len(self._free)
+
+    def utilization(self):
+        return self.num_used / self.capacity
+
+    def can_alloc(self, n):
+        return len(self._free) >= n
+
+    def alloc(self, n, owner=None):
+        """Allocate `n` blocks for `owner`. Returns the block-id list,
+        or None when the pool cannot satisfy the request (the caller
+        decides whether to evict; a partial allocation is never made)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if len(self._free) < n:
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = owner
+        return blocks
+
+    def free(self, blocks):
+        """Return blocks to the pool (eviction/finish reclaim)."""
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("attempt to free the reserved null block")
+            if b in self._owner:
+                del self._owner[b]
+            elif b in self._free:
+                raise ValueError(f"double free of block {b}")
+            else:
+                raise ValueError(f"free of unallocated block {b}")
+            self._free.append(b)
+
+    def owner_of(self, block):
+        return self._owner.get(block)
+
+
+class PagedKVCache:
+    """Per-layer K/V arenas of shape [num_blocks, block_size, hidden].
+
+    `hidden` is n_heads * head_dim; the minor dim stays flat so the
+    paged pallas kernel can stream blocks without a reshape copy (the
+    same constraint as the dense decode cache — see GPTModel.init_cache).
+    """
+
+    def __init__(self, num_layers, num_blocks, block_size, hidden,
+                 dtype="bfloat16"):
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.hidden = int(hidden)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_blocks, self.block_size, self.hidden)
+        self.k = tuple(jnp.zeros(shape, self.dtype)
+                       for _ in range(self.num_layers))
+        self.v = tuple(jnp.zeros(shape, self.dtype)
+                       for _ in range(self.num_layers))
+
+    @property
+    def nbytes(self):
+        return sum(a.nbytes for a in self.k) + \
+            sum(a.nbytes for a in self.v)
+
+    def swap(self, new_k, new_v):
+        """Install the updated arenas returned by a compiled step. The
+        old arrays may have been DONATED to that step — they must never
+        be read again, which is why this is the one mutation point."""
+        self.k = tuple(new_k)
+        self.v = tuple(new_v)
+
+    @staticmethod
+    def blocks_for_tokens(n_tokens, block_size):
+        """Blocks needed to hold `n_tokens` positions."""
+        return -(-int(n_tokens) // int(block_size))

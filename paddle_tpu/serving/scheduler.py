@@ -1,0 +1,345 @@
+"""Token-granular continuous-batching scheduler (Orca, OSDI '22).
+
+The predictor-era serving model admitted one request, ran it to
+completion, and only then looked at the queue — a long generation
+stalls every short one behind it. Iteration-level scheduling flips the
+unit of work from REQUEST to TOKEN: every engine step re-decides which
+requests occupy the fixed decode batch slots, new requests join the
+running batch the moment a slot and KV blocks are free, finished ones
+leave immediately, and long prompts prefill in CHUNKS interleaved with
+decode steps so they never stall the decode batch.
+
+This module is the pure-host half: request lifecycle, slot assignment,
+chunked-prefill bookkeeping, KV-block accounting against the
+`BlockPool`, and preemption (evict-by-recompute: the youngest running
+request frees its blocks and re-queues; its streamed tokens are kept
+and re-prefilled, so per-token RNG indexing keeps the stream
+deterministic across evictions). Device work — the compiled prefill and
+decode steps — lives in engine.py.
+"""
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .kv_cache import BlockPool, PagedKVCache
+
+__all__ = ["SamplingParams", "Request", "RequestHandle", "Scheduler",
+           "WAITING", "PREFILL", "RUNNING", "FINISHED", "FAILED"]
+
+WAITING = "waiting"
+PREFILL = "prefill"
+RUNNING = "running"
+FINISHED = "finished"
+FAILED = "failed"
+
+_SENTINEL = object()
+
+
+class SamplingParams:
+    """Per-request decode controls (the run_generate knobs, minus beam
+    search — a serving slot holds one stream)."""
+
+    def __init__(self, max_new_tokens=32, decode_strategy="greedy",
+                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
+                 seed=None):
+        if decode_strategy not in ("greedy", "sampling"):
+            raise ValueError(
+                f"unknown decode_strategy {decode_strategy!r} (the "
+                "serving engine decodes one stream per slot; use "
+                "run_generate for beam search)")
+        if temperature <= 0:
+            raise ValueError("temperature must be > 0")
+        self.max_new_tokens = int(max_new_tokens)
+        self.decode_strategy = decode_strategy
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.seed = seed
+
+    @property
+    def greedy(self):
+        return self.decode_strategy == "greedy"
+
+
+class Request:
+    """One in-flight generation. `tokens_all` = prompt + generated; the
+    positions 0..n_prefilled-1 have K/V in the paged cache. A decode
+    step consumes tokens_all[n_prefilled] (writing its K/V at that
+    position) and appends the next sampled token. Preemption resets
+    n_prefilled to 0 and frees the blocks — nothing else — so recompute
+    replays the identical stream."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, params, rng_key, submit_time=None):
+        self.rid = next(Request._ids)
+        self.prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.params = params
+        self.rng_key = rng_key              # base key; fold_in(token index)
+        self.state = WAITING
+        self.out_tokens = []                # streamed tokens, in order
+        self.n_prefilled = 0                # cache positions written
+        self.blocks = []                    # physical block ids (in order)
+        self.slot = None                    # decode batch slot, when RUNNING
+        self.preemptions = 0
+        self.error = None
+        self.submit_time = submit_time if submit_time is not None \
+            else time.monotonic()
+        self.first_token_time = None
+        self.finish_time = None
+        self._stream = queue.Queue()
+
+    # -- sequence accounting ------------------------------------------------
+    @property
+    def tokens_all(self):
+        return self.prompt + self.out_tokens
+
+    @property
+    def total_len(self):
+        return len(self.prompt) + self.params.max_new_tokens
+
+    def max_blocks_needed(self, block_size):
+        return PagedKVCache.blocks_for_tokens(self.total_len, block_size)
+
+    @property
+    def done(self):
+        if len(self.out_tokens) >= self.params.max_new_tokens:
+            return True
+        eos = self.params.eos_token_id
+        return (eos is not None and self.out_tokens
+                and self.out_tokens[-1] == int(eos))
+
+    # -- streaming ----------------------------------------------------------
+    def push_token(self, tok, now=None):
+        if self.first_token_time is None:
+            self.first_token_time = now if now is not None \
+                else time.monotonic()
+        self.out_tokens.append(int(tok))
+        self._stream.put(int(tok))
+
+    def close_stream(self):
+        self._stream.put(_SENTINEL)
+
+    # -- latency ------------------------------------------------------------
+    def ttft_ms(self):
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.submit_time) * 1000.0
+
+    def tpot_ms(self):
+        """Mean time-per-output-token after the first."""
+        if self.finish_time is None or self.first_token_time is None \
+                or len(self.out_tokens) < 2:
+            return None
+        return (self.finish_time - self.first_token_time) * 1000.0 \
+            / (len(self.out_tokens) - 1)
+
+
+class RequestHandle:
+    """Client-side view of a submitted request: a blocking token stream
+    plus a gather-all result."""
+
+    def __init__(self, request):
+        self._req = request
+
+    @property
+    def rid(self):
+        return self._req.rid
+
+    def tokens(self, timeout=None):
+        """Yield generated token ids as the engine streams them.
+        `timeout` bounds the TOTAL wall time across the whole stream
+        (not per token); expiry raises TimeoutError."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                tok = self._req._stream.get(
+                    timeout=None if deadline is None else
+                    max(0.001, deadline - time.monotonic()))
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self._req.rid}: no token within "
+                    f"{timeout}s (got {len(self._req.out_tokens)} so "
+                    "far)") from None
+            if tok is _SENTINEL:
+                if self._req.error is not None:
+                    raise RuntimeError(
+                        f"request {self._req.rid} failed: {self._req.error}")
+                return
+            yield tok
+
+    def result(self, timeout=None):
+        """Block until the request finishes; returns the full generated
+        token list. `timeout` is the total deadline."""
+        return list(self.tokens(timeout=timeout))
+
+    @property
+    def finished(self):
+        return self._req.state in (FINISHED, FAILED)
+
+    @property
+    def output_tokens(self):
+        return list(self._req.out_tokens)
+
+    @property
+    def stats(self):
+        r = self._req
+        return {"ttft_ms": r.ttft_ms(), "tpot_ms": r.tpot_ms(),
+                "preemptions": r.preemptions,
+                "n_tokens": len(r.out_tokens), "state": r.state}
+
+
+class Scheduler:
+    """Slot + block bookkeeping for the continuous-batching loop.
+
+    Invariants:
+    - `running[slot]` is None or a Request with state RUNNING and
+      n_prefilled == len(tokens_all) (its next decode consumes its own
+      last token... see Request docstring);
+    - a PREFILL request holds blocks for positions < n_prefilled plus
+      whatever the next chunk needs, but no slot until prefill is done;
+    - preemption frees ALL of a victim's blocks and re-queues it at the
+      FRONT of the waiting line (it already paid for its progress once).
+    """
+
+    def __init__(self, pool, block_size, max_slots, max_model_len):
+        self.pool = pool
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.max_model_len = int(max_model_len)
+        self.waiting = []                  # FIFO; preempted go to front
+        self.prefilling = []               # admitted, mid-prefill
+        self.running = [None] * self.max_slots
+        self.admit_order = []              # running/prefilling, oldest first
+        self.preemptions = 0
+
+    # -- queries ------------------------------------------------------------
+    def free_slots(self):
+        return [i for i, r in enumerate(self.running) if r is None]
+
+    def num_running(self):
+        return sum(1 for r in self.running if r is not None)
+
+    def has_work(self):
+        return bool(self.waiting or self.prefilling
+                    or self.num_running())
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, request):
+        if request.total_len > self.max_model_len:
+            raise ValueError(
+                f"request needs {request.total_len} positions "
+                f"(prompt {len(request.prompt)} + max_new_tokens "
+                f"{request.params.max_new_tokens}) > max_model_len "
+                f"{self.max_model_len}")
+        if request.max_blocks_needed(self.block_size) > self.pool.capacity:
+            raise ValueError(
+                f"request needs {request.max_blocks_needed(self.block_size)}"
+                f" KV blocks > pool capacity {self.pool.capacity}")
+        self.waiting.append(request)
+
+    def admit(self):
+        """Move waiting requests into prefill while a slot could
+        eventually take them: admission is bounded by slots (running +
+        prefilling) so the prefill pipeline never overfills the batch."""
+        admitted = []
+        while self.waiting and \
+                self.num_running() + len(self.prefilling) < self.max_slots:
+            req = self.waiting.pop(0)
+            req.state = PREFILL
+            req.n_prefilled = 0
+            req.blocks = []
+            self.prefilling.append(req)
+            self.admit_order.append(req)
+            admitted.append(req)
+        return admitted
+
+    # -- block growth + preemption ------------------------------------------
+    def ensure_blocks(self, req, n_positions, evict=True):
+        """Grow `req.blocks` to cover positions [0, n_positions).
+        Returns True when covered. With evict=True (decode growth —
+        the request is mid-stream and MUST make progress) an exhausted
+        pool preempts the youngest other block-holder and retries;
+        with evict=False (prefill growth — the request has streamed
+        nothing yet) it simply returns False and the chunk waits for
+        blocks to free naturally, so a preempted request can never
+        ping-pong-evict the running batch on its way back in."""
+        need = PagedKVCache.blocks_for_tokens(n_positions, self.block_size)
+        while len(req.blocks) < need:
+            got = self.pool.alloc(need - len(req.blocks), owner=req.rid)
+            if got is not None:
+                req.blocks.extend(got)
+                return True
+            if not evict:
+                return False
+            victim = self._pick_victim(exclude=req)
+            if victim is None:
+                # req is the only block-holder left; it cannot shrink
+                # itself, so it yields and retries after others finish
+                self.preempt(req)
+                return False
+            self.preempt(victim)
+        return True
+
+    def _pick_victim(self, exclude):
+        """Youngest admitted block-holder other than `exclude` — the
+        request that has sunk the least work (Orca/vLLM recompute
+        preemption policy)."""
+        for req in reversed(self.admit_order):
+            if req is not exclude and req.blocks:
+                return req
+        return None
+
+    def preempt(self, req):
+        """Evict-by-recompute: free every block, drop the slot, requeue
+        at the FRONT. Streamed tokens are kept (they are already on the
+        wire); re-prefill recomputes their K/V."""
+        from .. import monitor
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        if req.slot is not None:
+            self.running[req.slot] = None
+            req.slot = None
+        if req in self.prefilling:
+            self.prefilling.remove(req)
+        if req in self.admit_order:
+            self.admit_order.remove(req)
+        req.n_prefilled = 0
+        req.state = WAITING
+        req.preemptions += 1
+        self.preemptions += 1
+        monitor.incr("serving.preemptions")
+        self.waiting.insert(0, req)
+
+    def place(self, req):
+        """Prefill complete -> take a decode slot."""
+        slot = self.free_slots()[0]
+        req.slot = slot
+        req.state = RUNNING
+        self.running[slot] = req
+        self.prefilling.remove(req)
+        return slot
+
+    def finish(self, req, error=None):
+        """Reclaim everything; close the stream."""
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        if req.slot is not None:
+            self.running[req.slot] = None
+            req.slot = None
+        if req in self.prefilling:
+            self.prefilling.remove(req)
+        if req in self.admit_order:
+            self.admit_order.remove(req)
+        req.error = error
+        req.state = FAILED if error is not None else FINISHED
+        req.finish_time = time.monotonic()
+        req.close_stream()

@@ -195,6 +195,26 @@ def make_ckpt_record(event, step, rank=0, save_ms=None, bytes=None,  # noqa: A00
 
 BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
 
+# the SERVING bench-metric family (bench_serving.py over
+# paddle_tpu/serving): one source of truth for metric names + gate
+# directions so the bench emitter, the rolling baseline
+# (tools/bench_baseline.json), and tools/trace_check.py's serving
+# cross-rules cannot drift. Directions are the bench_gate vocabulary:
+# 'higher' fails when the value drops, 'lower' when it rises (latency),
+# 'info' is recorded but never gated.
+SERVING_BENCH_METRICS = {
+    "serving.single_stream_tokens_per_sec": "higher",
+    "serving.throughput_tokens_per_sec": "higher",
+    "serving.throughput_vs_single": "higher",
+    "serving.ttft_p50_ms": "lower",
+    "serving.ttft_p99_ms": "lower",
+    "serving.tpot_p50_ms": "lower",
+    "serving.tpot_p99_ms": "lower",
+    "serving.requests": "info",
+    "serving.preemptions": "info",
+    "serving.kv_block_utilization_peak": "info",
+}
+
 # required keys of an auto-sharding plan record (paddle_tpu.planner);
 # optional: chip, n_chips, projected_hbm_bytes, measured_hbm_bytes,
 # hbm_budget_bytes, cost_step_s, calibration, verify

@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/7] native build =="
+echo "== [1/8] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +37,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/7] api-surface audit =="
+echo "== [2/8] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/7] graph doctor + framework lint =="
+echo "== [3/8] graph doctor + framework lint =="
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -64,7 +64,7 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 # kind=plan record that validates under tools/trace_check.py
 JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 
-echo "== [4/7] training health + compile observatory gate =="
+echo "== [4/8] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
@@ -90,6 +90,20 @@ if grep -E "os\.fork" /tmp/bench_health_ci.err; then
   echo "FATAL: os.fork() under multithreaded JAX reappeared in the bench log"
   exit 1
 fi
+# serving bench (bench_serving.py): the offered-load sweep appends its
+# typed serving.* kind=bench records + the engine's compile records to
+# the SAME telemetry file, so the health/compile/bench gates below
+# cover the serving engine too (a recompiling engine loop or a missing
+# serving metric fails stage 4 exactly like a training regression).
+# --check-vs-single 1.3 is the hard floor for the continuous-batching
+# win on the 2-core CI host (measured 1.9-2.2x; CPU decode is
+# compute-bound so the batching yield is modest — the 2x+ headline
+# binds on weight-bandwidth-bound accelerators)
+JAX_PLATFORMS=cpu python bench_serving.py --cpu \
+    --telemetry /tmp/bench_health_ci.jsonl --check-vs-single 1.3 \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: serving bench failed"; exit 1; }
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \
@@ -114,7 +128,22 @@ JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
 JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
 JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
-echo "== [5/7] resilience chaos drill =="
+echo "== [5/8] serving engine smoke =="
+# continuous-batching serving gate (paddle_tpu/serving +
+# tools/serving_smoke.py), the two-sided pattern:
+#   a) N concurrent streamed requests through the real engine loop
+#      (background thread + HTTP front) must be token-for-token
+#      identical to single-request run_generate, with ZERO recompiles
+#      across the whole run (compile-observatory-verified) and the
+#      serving.* gauges live on /metrics;
+#   b) --selfcheck: an over-admitted schedule (block pool smaller than
+#      the offered load) must trip eviction and the
+#      serving.preemptions counter while every recomputed stream stays
+#      identical — proof the eviction path both exists and is safe.
+JAX_PLATFORMS=cpu python tools/serving_smoke.py
+JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
+
+echo "== [6/8] resilience chaos drill =="
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
 #   a) the checked-in corrupt-checkpoint specimen
 #      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
@@ -129,12 +158,12 @@ echo "== [5/7] resilience chaos drill =="
 #      telemetry ledger validating under tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
 
-echo "== [6/7] test suite =="
+echo "== [7/8] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [7/7] op benchmark gate =="
+echo "== [8/8] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

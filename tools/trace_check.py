@@ -83,7 +83,11 @@ def check_compile_records(records, path):
       producer forgot the n_compiles ordinal.
 
     Untracked records (jax.monitoring stream — no signature, so no
-    cause is derivable) are exempt from the cause rules.
+    cause is derivable) are exempt from the cause rules AND from the
+    monotonicity rule: their step counter is per-observatory-session,
+    and a rolling telemetry file legitimately appends several sessions
+    (bench.py then bench_serving.py in one CI stage), each restarting
+    the shared '(jax)' family at step 0.
     """
     problems = []
     last_step = {}
@@ -94,7 +98,7 @@ def check_compile_records(records, path):
             continue
         fam = rec.get("fn", "?")
         step = rec.get("step")
-        if isinstance(step, (int, float)):
+        if isinstance(step, (int, float)) and not rec.get("untracked"):
             clock = (rec.get("rank", 0), fam)
             prev = last_step.get(clock)
             if prev is not None and step < prev:
@@ -179,13 +183,23 @@ def check_bench_records(records, path):
       gated against a baseline);
     - the same metric for the same device/round must not repeat with
       DIFFERENT units — the gate diffs values record-against-record and
-      a silent unit flip would fake a 1000x regression or win.
+      a silent unit flip would fake a 1000x regression or win;
+    - the SERVING family (`serving.*`, bench_serving.py) additionally:
+      every gated serving metric must be one the family declares
+      (sink.SERVING_BENCH_METRICS — an undeclared name can never join
+      the baseline), must carry a unit, and within one device/round the
+      latency percentiles must be ordered (p50 <= p99 for TTFT and
+      TPOT — inverted percentiles mean the producer's accounting is
+      broken, and a gate fed broken percentiles gates nothing).
 
     Per-record shape (value numeric/null, null carries an error note)
     is already enforced by sink.validate_step_record.
     """
+    from paddle_tpu.telemetry.sink import SERVING_BENCH_METRICS
+
     problems = []
     units = {}
+    serving_vals = {}
     for i, rec in enumerate(records):
         if not isinstance(rec, dict) or rec.get("kind") != "bench":
             continue
@@ -194,13 +208,37 @@ def check_bench_records(records, path):
             problems.append(f"{path}:{i + 1}: bench record with empty "
                             "metric name")
             continue
-        key = (str(metric), rec.get("device"), rec.get("round"))
+        metric = str(metric)
+        key = (metric, rec.get("device"), rec.get("round"))
         unit = rec.get("unit")
         if key in units and units[key] != unit:
             problems.append(
                 f"{path}:{i + 1}: bench metric {metric!r} repeats with "
                 f"unit {unit!r} after {units[key]!r}")
         units[key] = unit
+        if metric.startswith("serving."):
+            if metric not in SERVING_BENCH_METRICS:
+                problems.append(
+                    f"{path}:{i + 1}: serving bench metric {metric!r} "
+                    "is not in the declared family "
+                    "(telemetry.sink.SERVING_BENCH_METRICS)")
+            elif unit is None:
+                problems.append(
+                    f"{path}:{i + 1}: serving bench metric {metric!r} "
+                    "carries no unit")
+            if isinstance(rec.get("value"), (int, float)):
+                serving_vals[key] = (i, float(rec["value"]))
+    for fam in ("ttft", "tpot"):
+        for (metric, device, rnd), (i, p50) in list(serving_vals.items()):
+            if metric != f"serving.{fam}_p50_ms":
+                continue
+            hit = serving_vals.get(
+                (f"serving.{fam}_p99_ms", device, rnd))
+            if hit is not None and p50 > hit[1]:
+                problems.append(
+                    f"{path}:{i + 1}: serving.{fam}_p50_ms {p50} > "
+                    f"serving.{fam}_p99_ms {hit[1]} — inverted "
+                    "percentiles")
     return problems
 
 
