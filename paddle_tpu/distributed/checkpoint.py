@@ -81,6 +81,14 @@ def load_checkpoint(path, model, optimizer=None):
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    # prime lazily-created optimizer slots: a FRESH process (auto-
+    # resume) has never run a step, so `_states` is empty and the
+    # restore target would be missing the checkpoint's optimizer
+    # subtree — orbax then rejects the structure and momentum/Adam
+    # state silently never came back (stateless SGD masked this)
+    if optimizer is not None:
+        for _, p in model.named_parameters():
+            optimizer._get_state(p)
     target = _state_pytree(model, optimizer)
     try:
         restore_args = ocp.checkpoint_utils.construct_restore_args(target)

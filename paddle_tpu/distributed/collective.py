@@ -14,6 +14,9 @@ barrier, split:1233). Two execution regimes:
    1:1 onto lax collectives (psum/all_gather/ppermute/all_to_all) — used by
    the pipeline and ring-attention implementations.
 """
+import contextlib
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -22,6 +25,100 @@ from .. import monitor
 from ..core.tensor import Tensor, apply
 from ..tensor._helpers import ensure_tensor
 from . import env
+
+
+# ---------------------------------------------------------------------------
+# collective deadline guard (elastic failure surfacing)
+# ---------------------------------------------------------------------------
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective failed to complete within the armed deadline.
+
+    On a pod this means a dead or wedged peer: without the guard the
+    survivor blocks in `block_until_ready` FOREVER (XLA collectives
+    have no timeout of their own) and the job hangs instead of
+    relaunching. Tagged `transient = True` so the elastic exit path
+    (`retry.classify_failure` -> `elastic_run`) converts it into the
+    ELASTIC_EXIT_CODE relaunch instead of treating it as a bug."""
+
+    transient = True
+
+    def __init__(self, op, deadline_s, axis=None, shape=None):
+        self.op = op
+        self.deadline_s = float(deadline_s)
+        self.axis = axis
+        tag = f" over axis {axis!r}" if axis else ""
+        tag += f" payload {shape}" if shape is not None else ""
+        super().__init__(
+            f"collective {op!r}{tag} did not complete within "
+            f"{deadline_s:.1f}s — a peer is dead or wedged; escalating "
+            "to the elastic relaunch path")
+
+
+_DEADLINE_S = [None]      # armed watchdog deadline (seconds), or None
+
+
+def set_collective_deadline(seconds):
+    """Arm (or with None, disarm) the process-wide collective deadline.
+    Returns the previous value."""
+    prev = _DEADLINE_S[0]
+    _DEADLINE_S[0] = float(seconds) if seconds is not None else None
+    return prev
+
+
+@contextlib.contextmanager
+def collective_deadline(seconds):
+    """Scope form: `with collective_deadline(30): train()` — every
+    host-blocking collective wait inside raises CollectiveTimeoutError
+    instead of hanging past the deadline."""
+    prev = set_collective_deadline(seconds)
+    try:
+        yield
+    finally:
+        set_collective_deadline(prev)
+
+
+def guarded_wait(name, value, axis_name=None, deadline_s=None):
+    """Bounded wait on a dispatched collective's result.
+
+    No deadline armed (the default): plain `block_until_ready`, zero
+    overhead beyond one list peek. Armed: the wait runs on a daemon
+    thread and the caller blocks at most `deadline_s` — on expiry the
+    waiter thread is abandoned (a hung XLA collective cannot be
+    cancelled; the process is about to exit 101 anyway, which is the
+    only real remedy) and a classified CollectiveTimeoutError raises.
+    Tracers and shardless values pass through untouched (no host wait
+    exists at trace time)."""
+    deadline = deadline_s if deadline_s is not None else _DEADLINE_S[0]
+    wait = getattr(value, "block_until_ready", None)
+    if wait is None or isinstance(value, jax.core.Tracer):
+        return value
+    if deadline is None:
+        wait()
+        return value
+    done = threading.Event()
+    err = []
+
+    def _waiter():
+        try:
+            wait()
+        except Exception as e:          # surfaced to the caller below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_waiter, daemon=True,
+                         name=f"collective-wait-{name}")
+    t.start()
+    shape = getattr(value, "shape", None)
+    if not done.wait(deadline):
+        monitor.incr("elastic.collective_timeouts")
+        raise CollectiveTimeoutError(name, deadline, axis=axis_name,
+                                     shape=tuple(shape) if shape is not None
+                                     else None)
+    if err:
+        raise err[0]
+    return value
 
 
 def _comm_span(name, tensor=None, axis_name=None):
@@ -126,14 +223,24 @@ def get_rank(group=None):
     return jax.process_index()
 
 
+def _maybe_guard(name, value, axis_name=None):
+    """Deadline-guard a dispatched collective's host wait. Armed: the
+    wait is bounded (CollectiveTimeoutError past the deadline — see
+    guarded_wait). Unarmed: NO-OP — the guard must not force a
+    synchronization the unguarded dispatch never had."""
+    if _DEADLINE_S[0] is not None:
+        guarded_wait(name, value, axis_name=axis_name)
+    return value
+
+
 def barrier(group=None):
     with _comm_span("barrier"):
-        jnp.zeros(()).block_until_ready()
+        guarded_wait("barrier", jnp.zeros(()))
 
 
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor):
-        tensor._value.block_until_ready()
+        guarded_wait("wait", tensor._value)
 
 
 # ---- global-view collectives (single-controller semantics) ----------------
@@ -156,6 +263,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
             sh = env.replicated(mesh)
             t._value = jax.device_put(t._value, sh) if not _is_traced(t) \
                 else jax.lax.with_sharding_constraint(t._value, sh)
+        if not _is_traced(t):
+            _maybe_guard("all_reduce", t._value)
         return t
 
 

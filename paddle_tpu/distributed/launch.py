@@ -22,6 +22,31 @@ import time
 
 ELASTIC_EXIT_CODE = 101
 
+# exit-code protocol (see README "Elastic mesh resilience"):
+#   101 ELASTIC_EXIT_CODE   relaunch onto a NEW world (mesh changed;
+#                           resume reshards via resilience.reshard)
+#   102 RESUMABLE_EXIT_CODE graceful preemption exit, state committed —
+#                           relaunch and auto-resume onto the SAME world
+# Both relaunch paths are CAPPED (101 by --max_restarts, 102 by
+# --max_resumes) and back off exponentially between attempts: an
+# unbounded relaunch loop around a deterministic failure used to burn
+# the fleet replaying the same crash forever.
+_sleep = time.sleep       # module-level so tests can pin the schedule
+
+
+def _restart_delay(restarts, base_s, cap_s=60.0):
+    """Exponential backoff before relaunch #`restarts` (1-based)."""
+    if base_s <= 0:
+        return 0.0
+    return min(float(cap_s), float(base_s) * (2.0 ** (restarts - 1)))
+
+
+def _backoff(restarts, base_s):
+    delay = _restart_delay(restarts, base_s)
+    if delay > 0:
+        _sleep(delay)
+    return delay
+
 
 def _parse_args(argv=None):
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
@@ -39,7 +64,16 @@ def _parse_args(argv=None):
                         "topology-driven on TPU")
     p.add_argument("--elastic_level", type=int, default=int(
         os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0")))
-    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="cap on ELASTIC_EXIT_CODE(101) relaunches")
+    p.add_argument("--max_resumes", type=int, default=32,
+                   help="cap on RESUMABLE_EXIT_CODE(102) resume "
+                        "relaunches (each one made checkpointed "
+                        "progress, so the cap is generous)")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="base seconds of the exponential relaunch "
+                        "backoff (doubles per consecutive restart, "
+                        "capped at 60s; 0 disables)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -97,19 +131,39 @@ def watch_local_trainers(procs, poll_interval=0.5):
         raise
 
 
+def _relaunch_decision(rc, args, restarts, resumes):
+    """Shared relaunch policy for both launcher paths. Returns
+    (relaunch?, restarts, resumes); a granted relaunch has already
+    slept its backoff."""
+    from ..resilience.preempt import RESUMABLE_EXIT_CODE
+    if rc == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
+            restarts < args.max_restarts:
+        restarts += 1
+        _backoff(restarts, args.restart_backoff)
+        return True, restarts, resumes
+    if rc == RESUMABLE_EXIT_CODE and resumes < args.max_resumes:
+        # a graceful preemption exit: state is committed, the relaunch
+        # auto-resumes — separate (generous) cap because every resume
+        # made real progress, unlike a crash loop
+        resumes += 1
+        _backoff(resumes, args.restart_backoff)
+        return True, restarts, resumes
+    return False, restarts, resumes
+
+
 def launch(argv=None):
     args = _parse_args(argv)
     if args.nproc_per_node > 1:
-        restarts = 0
+        restarts = resumes = 0
         while True:
             procs = start_local_trainers(args.nproc_per_node,
                                          args.training_script,
                                          args.training_script_args,
                                          master=args.master or None)
             rc = watch_local_trainers(procs)
-            if rc == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
-                    restarts < args.max_restarts:
-                restarts += 1
+            again, restarts, resumes = _relaunch_decision(
+                rc, args, restarts, resumes)
+            if again:
                 continue
             return rc
     os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
@@ -124,7 +178,7 @@ def launch(argv=None):
             num_processes=args.nnodes, process_id=args.node_rank)
 
     sys.argv = [args.training_script] + args.training_script_args
-    restarts = 0
+    restarts = resumes = 0
     while True:
         try:
             runpy.run_path(args.training_script, run_name="__main__")
@@ -132,9 +186,9 @@ def launch(argv=None):
         except SystemExit as e:
             if e.code in (0, None):
                 return 0
-            if e.code == ELASTIC_EXIT_CODE and args.elastic_level > 0 and \
-                    restarts < args.max_restarts:
-                restarts += 1
+            again, restarts, resumes = _relaunch_decision(
+                e.code, args, restarts, resumes)
+            if again:
                 continue
             raise
 

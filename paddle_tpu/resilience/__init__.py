@@ -7,7 +7,7 @@ auto-checkpoint subsystem (`fluid/incubate/checkpoint/auto_checkpoint.py`)
 and the elastic fleet relaunch protocol, rebuilt step-granular and
 integrity-checked for the single-controller TPU regime.
 
-Four pillars:
+Five pillars:
 
 - `ckpt`    — CheckpointManager: atomic step checkpoints (tmp-dir +
               manifest with per-leaf digests + fsync + one rename),
@@ -25,6 +25,13 @@ Four pillars:
 - `chaos`   — seeded fault injection (transient I/O errors, slow
               writes, corrupt-a-shard-after-write); the in-process half
               of `tools/chaos_drill.py`.
+- `reshard` — cross-layout checkpoint resharding: restore a manifest
+              checkpoint saved under layout A into any planner layout
+              B (elastic shrink/grow), leaf-by-leaf with target
+              Shardings; `resume()` routes through it automatically
+              when the stored layout mismatches the live one (the
+              `distributed.elastic.ElasticCoordinator` relaunch path;
+              drilled by `tools/elastic_drill.py`).
 
 `ckpt.*` counters/gauges land on the PR-3 `/metrics` endpoint; every
 checkpoint event is a `kind=ckpt` JSONL record validated by
@@ -34,6 +41,7 @@ checkpoint event is a `kind=ckpt` JSONL record validated by
 from . import chaos  # noqa: F401
 from . import ckpt  # noqa: F401
 from . import preempt  # noqa: F401
+from . import reshard  # noqa: F401
 from . import retry  # noqa: F401
 from .chaos import ChaosConfig, ChaosMonkey, corrupt_one_file  # noqa: F401
 from .ckpt import (  # noqa: F401
@@ -42,18 +50,23 @@ from .ckpt import (  # noqa: F401
 from .preempt import (  # noqa: F401
     RESUMABLE_EXIT_CODE, PreemptionHandler, ResilienceManager,
     as_resilience)
+from .reshard import (  # noqa: F401
+    layout_from_mesh, layouts_differ, normalize_layout, reshard_restore,
+    stored_layout)
 from .retry import (  # noqa: F401
-    RetryBudget, RetryError, RetryPolicy, is_transient, retrying,
-    with_retry)
+    RetryBudget, RetryError, RetryPolicy, classify_failure, is_transient,
+    retrying, with_retry)
 
 __all__ = [
     "CheckpointManager", "RunState", "CheckpointError",
     "CheckpointCorruptError", "build_manifest", "load_manifest",
     "verify_checkpoint", "checkpoint_bytes",
     "RetryPolicy", "RetryBudget", "RetryError", "with_retry", "retrying",
-    "is_transient",
+    "is_transient", "classify_failure",
     "RESUMABLE_EXIT_CODE", "PreemptionHandler", "ResilienceManager",
     "as_resilience",
+    "reshard_restore", "normalize_layout", "layout_from_mesh",
+    "layouts_differ", "stored_layout",
     "ChaosConfig", "ChaosMonkey", "corrupt_one_file",
-    "ckpt", "retry", "preempt", "chaos",
+    "ckpt", "retry", "preempt", "chaos", "reshard",
 ]

@@ -101,15 +101,20 @@ class RunState:
     rng_state      `core/random` default generator key (captured at save,
                    re-seeded on restore, so post-resume dropout masks /
                    data shuffles replay the uninterrupted run exactly)
+    layout         parallelism layout the run was saved under (axis
+                   dict, see resilience.reshard.normalize_layout) —
+                   what lets resume() detect a mesh change and route
+                   through the cross-layout reshard path
     extra          user dict (JSON-serializable)
     """
 
     def __init__(self, step=0, epoch=0, data_position=None, rng_state=None,
-                 extra=None):
+                 extra=None, layout=None):
         self.step = int(step)
         self.epoch = int(epoch)
         self.data_position = data_position
         self.rng_state = rng_state
+        self.layout = dict(layout) if layout else None
         self.extra = dict(extra or {})
 
     def capture_rng(self):
@@ -135,19 +140,24 @@ class RunState:
         persist (the live object keeps mutating afterwards)."""
         return RunState(step=self.step, epoch=self.epoch,
                         data_position=self.data_position,
-                        extra=dict(self.extra)).capture_rng()
+                        extra=dict(self.extra),
+                        layout=self.layout).capture_rng()
 
     def to_dict(self):
-        return {"schema": MANIFEST_SCHEMA, "step": self.step,
-                "epoch": self.epoch, "data_position": self.data_position,
-                "rng_state": self.rng_state, "extra": self.extra}
+        d = {"schema": MANIFEST_SCHEMA, "step": self.step,
+             "epoch": self.epoch, "data_position": self.data_position,
+             "rng_state": self.rng_state, "extra": self.extra}
+        if self.layout:
+            d["layout"] = self.layout
+        return d
 
     @classmethod
     def from_dict(cls, d):
         return cls(step=d.get("step", 0), epoch=d.get("epoch", 0),
                    data_position=d.get("data_position"),
                    rng_state=d.get("rng_state"),
-                   extra=d.get("extra"))
+                   extra=d.get("extra"),
+                   layout=d.get("layout"))
 
     def __repr__(self):
         return (f"RunState(step={self.step}, epoch={self.epoch}, "
@@ -609,7 +619,7 @@ class CheckpointManager:
     def verify(self, step, deep=True):
         return verify_checkpoint(self.step_dir(step), deep=deep)
 
-    def restore(self, step=None, model=None, optimizer=None):
+    def restore(self, step=None, model=None, optimizer=None, loader=None):
         """Restore model(+optimizer+RNG) in place; returns the RunState.
 
         step=None: newest VALID checkpoint — invalid ones (failed
@@ -618,6 +628,12 @@ class CheckpointManager:
         all; raises CheckpointCorruptError when checkpoints exist but
         none verifies. step=N: that exact checkpoint; corruption raises
         (explicit requests never silently fall back).
+
+        `loader(arrays_path, model, optimizer)` overrides the array
+        restore itself (default `distributed.checkpoint.load_checkpoint`)
+        while keeping this method's verification, fallback, retry and
+        telemetry semantics — the hook `resilience.reshard` routes its
+        cross-layout restore through.
         """
         model = model if model is not None else self.model
         optimizer = optimizer if optimizer is not None else self.optimizer
@@ -627,7 +643,8 @@ class CheckpointManager:
             problems = self.verify(step)
             if problems:
                 raise CheckpointCorruptError(self.step_dir(step), problems)
-            return self._restore_one(int(step), model, optimizer)
+            return self._restore_one(int(step), model, optimizer,
+                                     loader=loader)
         steps = self.steps()
         if not steps:
             return None
@@ -645,18 +662,20 @@ class CheckpointManager:
                      else "") + "); falling back to an older checkpoint",
                     RuntimeWarning, stacklevel=2)
                 continue
-            return self._restore_one(s, model, optimizer)
+            return self._restore_one(s, model, optimizer, loader=loader)
         raise CheckpointCorruptError(
             self.step_dir(last_problems[0]), last_problems[1])
 
-    def _restore_one(self, step, model, optimizer):
+    def _restore_one(self, step, model, optimizer, loader=None):
         from ..distributed.checkpoint import load_checkpoint
+        if loader is None:
+            loader = load_checkpoint
         path = os.path.join(self.step_dir(step), ARRAYS_SUBDIR)
         t0 = time.perf_counter()
 
         def _load():
             chaos.inject("restore")
-            return load_checkpoint(path, model, optimizer)
+            return loader(path, model, optimizer)
 
         try:
             self._io(_load, f"ckpt.restore(step={step})")
@@ -679,17 +698,11 @@ class CheckpointManager:
 
     # -- record plumbing ----------------------------------------------------
     def _emit(self, event, step, **fields):
-        from ..telemetry.sink import make_ckpt_record
+        from ..telemetry.sink import emit_record, make_ckpt_record
         rec = make_ckpt_record(event=event, step=step, rank=self.rank,
                                **fields)
         self.records.append(rec)
-        sink = self.sink
-        if sink is None:
-            from ..telemetry.recorder import current_recorder
-            r = current_recorder()
-            sink = r.sink if r is not None else None
-        if sink is not None:
-            sink.write(rec)
+        emit_record(rec, self.sink)
         if self.health is not None:
             # the same kind=ckpt record the JSONL carries is judged
             # in-flight, so live paging and offline replay agree

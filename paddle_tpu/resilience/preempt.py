@@ -137,7 +137,7 @@ class ResilienceManager:
                  keep_every=None, async_save=True, retry=None,
                  preempt=True, exit_on_preempt=True,
                  exit_code=RESUMABLE_EXIT_CODE, dump_dir=None, health=None,
-                 sink=None, rank=0):
+                 sink=None, rank=0, layout=None, elastic=None):
         if (checkpoint_dir is None) == (manager is None):
             raise ValueError("ResilienceManager: pass exactly one of "
                              "checkpoint_dir or manager")
@@ -150,8 +150,27 @@ class ResilienceManager:
         self.exit_on_preempt = bool(exit_on_preempt)
         self.exit_code = int(exit_code)
         self.dump_dir = dump_dir if dump_dir is not None else self.ckpt.dir
-        self.state = RunState()
+        # the layout this run trains under: stamped into every
+        # checkpoint's RunState so a relaunch onto a DIFFERENT layout
+        # is detected and resume() routes through the reshard path
+        # (planner Layout / axis dict / None — see resilience.reshard)
+        from .reshard import normalize_layout
+        self.layout = normalize_layout(layout)
+        # optional distributed.elastic.ElasticCoordinator: polled at
+        # every step boundary (heartbeat + failure detection + the
+        # shrink/grow replan-drain-relaunch protocol). Wiring must be
+        # TWO-way — the coordinator drains its final checkpoint through
+        # us — so route through its attach() (which also shares our
+        # telemetry sink) rather than a bare assignment.
+        self.elastic = None
+        if elastic is not None:
+            if hasattr(elastic, "attach"):
+                elastic.attach(self)     # sets self.elastic = elastic
+            else:
+                self.elastic = elastic
+        self.state = RunState(layout=self.layout)
         self.resumed_from = None
+        self.resumed_via = None
         self._shutdown_done = False
         if isinstance(preempt, PreemptionHandler):
             self.handler = preempt.install()
@@ -181,10 +200,14 @@ class ResilienceManager:
 
     def step_boundary(self, loss=None):
         """Called by the wired train step after each COMPLETED step.
-        Advances the step count; on an armed preemption request commits
-        a final checkpoint and exits resumable; otherwise saves on the
+        Advances the step count; polls the elastic coordinator (which
+        may itself drain + exit with ELASTIC_EXIT_CODE on a membership
+        change); on an armed preemption request commits a final
+        checkpoint and exits resumable; otherwise saves on the
         periodic schedule."""
         self.state.step += 1
+        if self.elastic is not None:
+            self.elastic.step_boundary(self.state.step)
         if self.handler is not None and self.handler.requested:
             self.graceful_shutdown()
             return
@@ -192,13 +215,18 @@ class ResilienceManager:
             self.ckpt.save(self.state.step,
                            run_state=self.state.snapshot())
 
-    def graceful_shutdown(self, reason=None):
+    def graceful_shutdown(self, reason=None, exit_code=None):
         """Drain + final synchronous checkpoint + black-box dump + (by
-        default) SystemExit(RESUMABLE_EXIT_CODE). Idempotent — a second
-        call (signal during shutdown) exits without re-saving."""
+        default) SystemExit. Idempotent — a second call (signal during
+        shutdown) exits without re-saving. `exit_code` overrides the
+        configured one for this exit: the elastic coordinator drains
+        through here with ELASTIC_EXIT_CODE (relaunch onto a NEW
+        world) instead of the default RESUMABLE_EXIT_CODE (resume onto
+        the same one)."""
+        code = int(exit_code) if exit_code is not None else self.exit_code
         if self._shutdown_done:
             if self.exit_on_preempt:
-                raise SystemExit(self.exit_code)
+                raise SystemExit(code)
             return
         self._shutdown_done = True
         sig = self.handler.signal_name if self.handler is not None else None
@@ -217,8 +245,7 @@ class ResilienceManager:
             ring=list(self.ckpt.records[-16:]),
             extra={"ckpt_step": self.state.step,
                    "ckpt_dir": self.ckpt.dir,
-                   "exit_code": self.exit_code if self.exit_on_preempt
-                   else None,
+                   "exit_code": code if self.exit_on_preempt else None,
                    "final_save_error": repr(err) if err else None})
         fields = {"signal": sig} if sig else {}
         self.ckpt._emit("preempt", self.state.step, **fields)
@@ -226,20 +253,46 @@ class ResilienceManager:
         if err is not None:
             raise err
         if self.exit_on_preempt:
-            raise SystemExit(self.exit_code)
+            raise SystemExit(code)
 
     # -- resume -------------------------------------------------------------
-    def resume(self, model=None, optimizer=None):
+    def resume(self, model=None, optimizer=None, mesh=None):
         """Auto-resume: restore the newest valid checkpoint (if any)
         into the attached model/optimizer + the RNG, adopt its
         RunState, and return the step to continue FROM (== completed
-        steps), or None when starting fresh."""
+        steps), or None when starting fresh.
+
+        When the newest checkpoint was saved under a DIFFERENT layout
+        than this run's (`layout=` at construction, or the live mesh's
+        when none was given) — an elastic relaunch landed on a new
+        world — the restore routes through the cross-layout reshard
+        path (`resilience.reshard.reshard_restore`) instead of the
+        same-layout one; `resumed_via` records which ("reshard" /
+        "direct")."""
         if model is not None or optimizer is not None:
             self.attach(model, optimizer)
-        rs = self.ckpt.restore()
+        from . import reshard
+        from ..distributed import env as dist_env
+        if mesh is None:
+            mesh = dist_env.current_mesh()
+        live = self.layout or reshard.layout_from_mesh(mesh)
+        stored = reshard.stored_layout(self.ckpt)
+        if stored is not None and live is not None and \
+                reshard.layouts_differ(stored, live):
+            rs = reshard.reshard_restore(
+                self.ckpt.dir, target_layout=live, mesh=mesh,
+                model=self.ckpt.model, optimizer=self.ckpt.optimizer,
+                manager=self.ckpt, rank=self.ckpt.rank)
+            self.resumed_via = "reshard"
+        else:
+            rs = self.ckpt.restore()
+            self.resumed_via = "direct" if rs is not None else None
         if rs is None:
             return None
         self.state = rs
+        # future checkpoints are stamped with the LIVE layout — the
+        # reshard already happened, the next resume is same-layout
+        self.state.layout = live or rs.layout
         self.resumed_from = rs.step
         monitor.set_gauge("ckpt.resumed_step", float(rs.step))
         return rs.step

@@ -33,7 +33,7 @@ import threading
 import time
 
 __all__ = ["RetryPolicy", "RetryBudget", "RetryError", "with_retry",
-           "retrying", "is_transient"]
+           "retrying", "is_transient", "classify_failure"]
 
 # errno values worth retrying: transient kernel/FS/network conditions.
 # Deliberately NOT here: ENOSPC/EDQUOT (disk full stays full), EACCES/
@@ -47,6 +47,15 @@ _TRANSIENT_ERRNOS = frozenset({
 
 _PERMANENT_TYPES = (FileNotFoundError, PermissionError, IsADirectoryError,
                     NotADirectoryError, ValueError, TypeError, KeyError)
+
+# programming errors: bugs in OUR code, not weather. The elastic exit
+# path (`distributed.elastic.elastic_run`) must let these fail LOUDLY
+# instead of converting them into a relaunch loop that replays the
+# same traceback forever at ELASTIC_EXIT_CODE.
+_PROGRAMMING_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                      AttributeError, AssertionError, NameError,
+                      NotImplementedError, ZeroDivisionError,
+                      RecursionError, UnboundLocalError)
 
 
 class RetryError(Exception):
@@ -81,6 +90,35 @@ def is_transient(exc):
     if type(exc).__name__ == "TimeoutExpired":
         return True
     return False
+
+
+def classify_failure(exc):
+    """Three-way failure taxonomy for the elastic exit-code protocol:
+
+    'transient'  — weather (per `is_transient`): storage blips, peer
+                   timeouts, anything tagged `.transient = True` (the
+                   collective deadline guard tags its timeouts) —
+                   relaunching is the fix;
+    'permanent'  — a programming or environment error (ValueError,
+                   TypeError, missing file, permissions, an explicit
+                   `.transient = False` tag) — relaunching replays the
+                   identical traceback, so fail loudly NOW;
+    'infra'      — everything else (RuntimeError, XLA runtime errors,
+                   a dead-peer collective failure without a tag):
+                   can't prove it's a bug, the relaunch protocol gets
+                   the benefit of the doubt.
+    """
+    tagged = getattr(exc, "transient", None)
+    if tagged is True:
+        return "transient"
+    if tagged is False:
+        return "permanent"
+    if is_transient(exc):
+        return "transient"
+    if isinstance(exc, _PERMANENT_TYPES) or isinstance(exc,
+                                                      _PROGRAMMING_TYPES):
+        return "permanent"
+    return "infra"
 
 
 class RetryBudget:

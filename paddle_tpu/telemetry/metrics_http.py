@@ -180,6 +180,21 @@ class MetricsServer:
                 "last_step": snap.get("ckpt.last_step"),
                 "last_save_ms": snap.get("ckpt.save_ms"),
             },
+            # elastic mesh resilience (distributed.elastic +
+            # resilience.reshard): has the failure detector fired, and
+            # did any resume cross a layout change
+            "elastic": {
+                "alive_hosts": snap.get("elastic.alive_hosts"),
+                "heartbeat_misses": snap.get(
+                    "elastic.heartbeat_miss", 0),
+                "declared_dead": snap.get("elastic.declared_dead", 0),
+                "replans": snap.get("elastic.replan", 0),
+                "relaunches": snap.get("elastic.relaunch", 0),
+                "reshard_restores": snap.get(
+                    "elastic.reshard_restores", 0),
+                "collective_timeouts": snap.get(
+                    "elastic.collective_timeouts", 0),
+            },
         }
         h = self.health
         if h is not None:

@@ -60,6 +60,22 @@ CKPT_RECORD_KEYS = ("schema", "kind", "rank", "step", "event")
 CKPT_EVENTS = ("save", "commit", "restore", "fallback", "failed", "gc",
                "preempt")
 
+# required keys of an elastic-membership record (distributed.elastic
+# ElasticCoordinator + resilience.reshard); optional: host, step,
+# miss_count, detect_s, world_from, world_to, layout_from, layout_to,
+# dead_hosts
+ELASTIC_RECORD_KEYS = ("schema", "kind", "rank", "event")
+# the declared-dead protocol's event vocabulary: a host misses a
+# heartbeat poll (per miss), is declared dead past the threshold, the
+# survivors replan via the auto-sharding planner, the drained
+# checkpoint reshards onto the new layout, the process relaunches.
+# tools/trace_check.py enforces the cross-record ordering (a
+# declared_dead needs a preceding heartbeat_miss for the same host; a
+# reshard_restore must reference a committed step and carry BOTH
+# layouts; a relaunch needs a preceding replan).
+ELASTIC_EVENTS = ("heartbeat_miss", "declared_dead", "replan",
+                  "reshard_restore", "relaunch")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -187,6 +203,47 @@ def make_ckpt_record(event, step, rank=0, save_ms=None, bytes=None,  # noqa: A00
         rec["save_ms"] = round(float(save_ms), 4)
     if bytes is not None:
         rec["bytes"] = int(bytes)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
+def make_elastic_record(event, rank=0, host=None, step=None,
+                        miss_count=None, detect_s=None, world_from=None,
+                        world_to=None, layout_from=None, layout_to=None,
+                        **extra):
+    """One elastic-membership lifecycle event as a first-class record
+    (kind='elastic'). `event` is one of ELASTIC_EVENTS; `layout_from`/
+    `layout_to` are axis dicts (resilience.reshard.normalize_layout
+    canonical form); `detect_s` is the detector's first-miss ->
+    declared-dead latency on its own clock (the drill asserts it stays
+    inside the configured threshold window)."""
+    if event not in ELASTIC_EVENTS:
+        raise ValueError(f"elastic event must be one of {ELASTIC_EVENTS}, "
+                         f"got {event!r}")
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "elastic",
+        "rank": int(rank),
+        "event": str(event),
+    }
+    if host is not None:
+        rec["host"] = str(host)
+    if step is not None:
+        rec["step"] = int(step)
+    if miss_count is not None:
+        rec["miss_count"] = int(miss_count)
+    if detect_s is not None:
+        rec["detect_s"] = float(detect_s)
+    if world_from is not None:
+        rec["world_from"] = int(world_from)
+    if world_to is not None:
+        rec["world_to"] = int(world_to)
+    if layout_from is not None:
+        rec["layout_from"] = dict(layout_from)
+    if layout_to is not None:
+        rec["layout_to"] = dict(layout_to)
     for k, v in extra.items():
         if v is not None:
             rec[k] = v
@@ -370,6 +427,29 @@ class JsonlSink:
         return self._n
 
 
+def emit_record(rec, *sinks):
+    """Write one record through THE standard sink fallback chain —
+    the first usable candidate wins, else the context-active
+    recorder's sink, else the record is returned unwritten. Each
+    candidate may be a sink object (anything with .write), a path
+    string (opened append as a JsonlSink), or None. This is the single
+    owner of the precedence rule the resilience/elastic emitters share
+    (explicit sink > manager's sink > active recorder)."""
+    out = None
+    for s in sinks:
+        if s is None:
+            continue
+        out = JsonlSink(s) if isinstance(s, str) else s
+        break
+    if out is None:
+        from .recorder import current_recorder
+        r = current_recorder()
+        out = r.sink if r is not None else None
+    if out is not None:
+        out.write(rec)
+    return rec
+
+
 def read_jsonl(path):
     """Load a metrics JSONL back into a list of dicts (round-trip)."""
     out = []
@@ -463,6 +543,53 @@ def validate_step_record(rec):
                                   or v < 0):
                 problems.append(
                     f"'{key}' not a non-negative number: {v!r}")
+        return problems
+    if kind == "elastic":
+        for key in ELASTIC_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"elastic record missing '{key}'")
+        ev = rec.get("event")
+        if ev is not None and ev not in ELASTIC_EVENTS:
+            problems.append(f"unknown elastic event {ev!r} "
+                            f"(expected one of {list(ELASTIC_EVENTS)})")
+        if ev in ("heartbeat_miss", "declared_dead"):
+            if not str(rec.get("host", "")).strip():
+                problems.append(f"elastic {ev} record names no host")
+            mc = rec.get("miss_count")
+            if mc is not None and (not isinstance(mc, int) or mc < 1):
+                problems.append(
+                    f"'miss_count' not a positive int: {mc!r}")
+        for key in ("world_from", "world_to"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, int) or v < 1):
+                problems.append(f"'{key}' not a positive int: {v!r}")
+        for key in ("layout_from", "layout_to"):
+            v = rec.get(key)
+            if v is None:
+                continue
+            if not isinstance(v, dict) or not v:
+                problems.append(f"'{key}' not a non-empty layout "
+                                f"dict: {v!r}")
+            else:
+                for a, s in v.items():
+                    if not isinstance(s, int) or s < 1:
+                        problems.append(
+                            f"'{key}' axis {a!r} not a positive "
+                            f"int: {s!r}")
+        if ev == "reshard_restore":
+            # the one event that must be fully anchored on its own:
+            # which committed step moved, from which layout, to which
+            if not isinstance(rec.get("step"), int):
+                problems.append(
+                    "elastic reshard_restore record references no step")
+            for key in ("layout_from", "layout_to"):
+                if not rec.get(key):
+                    problems.append(
+                        f"elastic reshard_restore record carries no "
+                        f"'{key}'")
+        v = rec.get("detect_s")
+        if v is not None and (not isinstance(v, (int, float)) or v < 0):
+            problems.append(f"'detect_s' not a non-negative number: {v!r}")
         return problems
     if kind == "ckpt":
         for key in CKPT_RECORD_KEYS:

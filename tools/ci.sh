@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] native build =="
+echo "== [1/9] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +37,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/8] api-surface audit =="
+echo "== [2/9] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/8] graph doctor + framework lint =="
+echo "== [3/9] graph doctor + framework lint =="
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -64,7 +64,7 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 # kind=plan record that validates under tools/trace_check.py
 JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 
-echo "== [4/8] training health + compile observatory + bench gates =="
+echo "== [4/9] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
@@ -128,7 +128,7 @@ JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
 JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
 JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
-echo "== [5/8] serving engine smoke =="
+echo "== [5/9] serving engine smoke =="
 # continuous-batching serving gate (paddle_tpu/serving +
 # tools/serving_smoke.py), the two-sided pattern:
 #   a) N concurrent streamed requests through the real engine loop
@@ -143,7 +143,7 @@ echo "== [5/8] serving engine smoke =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
 
-echo "== [6/8] resilience chaos drill =="
+echo "== [6/9] resilience chaos drill =="
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
 #   a) the checked-in corrupt-checkpoint specimen
 #      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
@@ -158,12 +158,29 @@ echo "== [6/8] resilience chaos drill =="
 #      telemetry ledger validating under tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
 
-echo "== [7/8] test suite =="
+echo "== [7/9] elastic mesh drill =="
+# host-loss gate (distributed.elastic + resilience.reshard +
+# tools/elastic_drill.py), the two-sided pattern:
+#   a) the checked-in cross-layout specimen
+#      (tools/specimens/ckpt_cross_layout, saved under dp=2) must
+#      reshard-restore under dp=1 AND under an mp=2 mesh with
+#      digest-equal logical weights + live momentum slots, and a
+#      tampered leaf must still be LEAF-NAMED across the reshard path;
+#   b) a dp=2 two-process pod loses one host to SIGKILL: the survivor
+#      must declare it dead within the miss threshold, replan via the
+#      auto-sharding planner to the 1-host layout, drain a final
+#      checkpoint and exit 101; the relaunch must resume THROUGH the
+#      reshard path with digest-equal weights and a finite continued
+#      loss — the whole sequence validated as kind=elastic telemetry
+#      by tools/trace_check.py.
+JAX_PLATFORMS=cpu python tools/elastic_drill.py --selfcheck
+
+echo "== [8/9] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [8/8] op benchmark gate =="
+echo "== [9/9] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

@@ -1,0 +1,637 @@
+#!/usr/bin/env python
+"""Elastic mesh drill: prove a run survives HOST LOSS end to end.
+
+PR 5's chaos drill proved a run survives its own death (SIGKILL ->
+bit-identical resume onto the SAME world). This drill kills somebody
+ELSE: a dp=2 two-process "pod" loses one host to SIGKILL, and the
+survivor must walk the whole elastic protocol —
+
+  detect    the dead peer via missed heartbeats (declared-dead
+            protocol, `distributed.elastic.ElasticCoordinator`);
+  replan    call the auto-sharding planner (`planner.plan()`) for the
+            surviving chip count and record the chosen layout;
+  drain     commit a final checkpoint through the PR-5 resilience
+            boundary (stamped with the OLD layout) and exit with
+            ELASTIC_EXIT_CODE=101;
+  reshard   the relaunched single-host process auto-resumes: the
+            stored layout mismatches the live planner layout, so
+            `resume()` routes through `resilience.reshard` — restored
+            logical weights must be DIGEST-EQUAL to the weights the
+            survivor drained;
+  resume    training continues and the loss stays finite.
+
+Every transition is a `kind=elastic` telemetry record; the drill fails
+unless the combined ledger (membership events + ckpt events) passes
+tools/trace_check.py, the declared-dead latency stays inside the
+configured threshold window, and the relaunch actually landed on the
+planner's 1-host layout.
+
+    python tools/elastic_drill.py                   # full drill (tmp dir)
+    python tools/elastic_drill.py --steps 6 --kill-after 2
+    python tools/elastic_drill.py --selfcheck       # CI gate:
+        # (a) the checked-in cross-layout specimen
+        #     (tools/specimens/ckpt_cross_layout, saved under dp=2)
+        #     must reshard-restore under dp=1 AND under an mp=2 mesh
+        #     with digest-equal logical values;
+        # (b) a tampered leaf must still be LEAF-NAMED across the
+        #     reshard path;
+        # (c) the mini host-loss drill must pass end to end.
+    python tools/elastic_drill.py --make-specimen   # (re)generate the
+        # specimen deterministically (checked in; run only when the
+        # checkpoint protocol changes)
+
+Exit codes: 0 ok; 8 drill failed; 9 selfcheck miss — the chaos_drill
+family (this is its v2), distinct from trace_check's 7 and
+healthwatch's 5/9.
+"""
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# the mp=2 specimen restore needs >= 2 CPU devices; force the virtual
+# platform BEFORE jax loads (child legs inherit it — harmless: no mesh
+# is built unless a leg builds one)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPECIMEN_DIR = os.path.join(REPO, "tools", "specimens",
+                            "ckpt_cross_layout")
+SPECIMEN_STEP = 2
+SPECIMEN_LAYOUT = {"dp": 2, "mp": 1}      # the layout it was saved under
+
+EXIT_DRILL_FAILED = 8
+EXIT_SELFCHECK_MISS = 9
+
+# detector knobs shared by both hosts (referenced by the parent's
+# detection-latency bound too). The timeout leaves room for the peer's
+# first-step JIT compile (its longest legitimate heartbeat gap).
+HEARTBEAT_TIMEOUT_S = 2.5
+MISS_THRESHOLD = 3
+POLL_SLEEP_S = 0.15
+
+
+# ---------------------------------------------------------------------------
+# the tiny-but-real training job (shared by every leg and the specimen
+# generator, so checkpoints are structurally identical everywhere)
+# ---------------------------------------------------------------------------
+
+def tiny_plan_cfg():
+    """The model config handed to planner.plan() for the replan leg —
+    tiny so the layout search is instant on CPU. The search itself is
+    the REAL planner battery (sharding lint + HBM projection), not a
+    stub."""
+    from paddle_tpu.models.gpt import GPTConfig
+    return GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=32, dropout=0.0)
+
+
+def build_model(seed):
+    """2-layer MLP + Momentum (stateful, so the reshard carries real
+    optimizer slots). The linear weights are TAGGED for tensor
+    parallelism — under a 1-device run the tags are inert, under the
+    specimen's mp=2 restore they shard."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    net[0].weight.mesh_axes = (None, "mp")
+    net[2].weight.mesh_axes = ("mp", None)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=net.parameters())
+    return net, opt
+
+
+def batch_at(i, batch_size=16):
+    import numpy as np
+    rs = np.random.RandomState(20_000 + i)
+    x = rs.randn(batch_size, 8).astype("float32")
+    y = rs.randn(batch_size, 8).astype("float32")
+    return x, y
+
+
+def weights_digest(net):
+    """Digest of the LOGICAL parameter values — placement-independent
+    by construction (np.asarray gathers the global array), so a dp=2
+    save and an mp=2 restore of the same weights digest identically."""
+    import numpy as np
+    h = hashlib.sha256()
+    for name, p in sorted(net.named_parameters()):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(p.numpy())).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# child legs
+# ---------------------------------------------------------------------------
+
+def run_host(args):
+    """One 'host' of the dp=2 pod. Host 0 is the chief: it owns the
+    checkpoints, the telemetry ledger and the coordinator protocol.
+    Host 1 just trains and heartbeats — and gets murdered."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.distributed.elastic import (ElasticCoordinator,
+                                                ElasticManager)
+    from paddle_tpu.resilience import ResilienceManager, RetryPolicy
+
+    host = str(args.host_id)
+    em = ElasticManager(args.registry, np=2, host_id=host,
+                        heartbeat_interval=POLL_SLEEP_S,
+                        timeout=HEARTBEAT_TIMEOUT_S,
+                        fault_tolerance_level=1).register()
+    net, opt = build_model(args.seed)
+    out = open(args.out, "a")
+
+    def log(**rec):
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+        os.fsync(out.fileno())
+
+    if host != "0":
+        # the victim: train + heartbeat until killed
+        step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt)
+        i = 0
+        while True:
+            x, y = batch_at(i)
+            loss = step(x, y)
+            em.heartbeat()
+            log(host=host, step=i + 1, loss=float(loss.numpy()))
+            i += 1
+            time.sleep(POLL_SLEEP_S)
+
+    res = ResilienceManager(
+        args.dir, save_every=1, preempt=False, sink=args.telemetry or None,
+        layout={"dp": 2}, rank=0,
+        retry=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                          max_delay_s=0.05))
+    cfg = tiny_plan_cfg()
+
+    def plan_fn(n_chips):
+        from paddle_tpu.planner import plan
+        return plan(cfg, n_chips=n_chips, verify="sharding")
+
+    # membership is LEARNED from observed heartbeats (no expected_hosts
+    # pre-seed): a peer that is still importing/compiling cannot be
+    # falsely declared dead before its first heartbeat was ever seen
+    coord = ElasticCoordinator(em, plan_fn=plan_fn,
+                               miss_threshold=MISS_THRESHOLD).attach(res)
+    step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt,
+                     resilience=res)
+    start = res.resume() or 0
+    i = start
+    try:
+        while True:
+            x, y = batch_at(i)
+            loss = step(x, y)     # resilience+elastic boundary inside
+            log(host=host, step=i + 1, loss=float(loss.numpy()))
+            i += 1
+            time.sleep(POLL_SLEEP_S)
+    except SystemExit as e:
+        detect = [r for r in coord.events
+                  if r["event"] == "declared_dead"]
+        log(summary=True, host=host, exit_code=e.code,
+            drained_step=res.state.step, weights=weights_digest(net),
+            events=[r["event"] for r in coord.events],
+            detect_s=detect[0].get("detect_s") if detect else None,
+            next_layout=coord.next_layout)
+        out.close()
+        raise
+
+
+def run_relaunch(args):
+    """The relaunched single-host leg: replan for the 1-chip world
+    through the REAL planner, resume (which must route through the
+    reshard path), keep training, prove the losses stay finite."""
+    import math
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.planner import plan
+    from paddle_tpu.resilience import ResilienceManager, RetryPolicy
+
+    p = plan(tiny_plan_cfg(), n_chips=1, verify="sharding")
+    net, opt = build_model(args.seed)
+    res = ResilienceManager(
+        args.dir, model=net, optimizer=opt, save_every=1, preempt=False,
+        sink=args.telemetry or None, layout=p.layout, rank=0,
+        retry=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                          max_delay_s=0.05))
+    start = res.resume() or 0
+    restored_digest = weights_digest(net)
+    step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt,
+                     resilience=res)
+    losses = []
+    for i in range(start, start + args.steps):
+        x, y = batch_at(i)
+        losses.append(float(step(x, y).numpy()))
+    res.ckpt.drain()
+    res.close()
+    with open(args.out, "a") as out:
+        out.write(json.dumps({
+            "summary": True, "relaunch": True,
+            "plan_layout": p.layout.to_dict(),
+            "resumed_from": res.resumed_from,
+            "resumed_via": res.resumed_via,
+            "restored_weights": restored_digest,
+            "losses": losses,
+            "losses_finite": all(math.isfinite(v) for v in losses),
+        }) + "\n")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def _spawn(extra, timeout=None, wait=True):
+    cmd = [sys.executable, os.path.abspath(__file__)] + extra
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if wait:
+        return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout or 600)
+    return subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _read_lines(path):
+    recs = []
+    if not os.path.exists(path):
+        return recs
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def _wait_for_step(path, step, timeout_s=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        recs = _read_lines(path)
+        if any(r.get("step", 0) >= step and r.get("host") == "0"
+               for r in recs):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def run_drill(root, steps=4, kill_after=2, seed=4321, verbose=True):
+    """The full host-loss drill. Returns failure strings ([] == pass)."""
+    failures = []
+
+    def say(msg):
+        if verbose:
+            print(f"elastic_drill: {msg}")
+
+    os.makedirs(root, exist_ok=True)
+    registry = os.path.join(root, "registry")
+    ckpt_dir = os.path.join(root, "ckpt")
+    ledger = os.path.join(root, "elastic_ledger.jsonl")
+    out0 = os.path.join(root, "host0.jsonl")
+    out1 = os.path.join(root, "host1.jsonl")
+    for p in (ledger, out0, out1):
+        if os.path.exists(p):
+            os.remove(p)
+
+    # -- leg 1: the dp=2 pod; SIGKILL host 1 once host 0 is training --------
+    common = ["--child-host", "--dir", ckpt_dir, "--registry", registry,
+              "--seed", str(seed)]
+    h0 = _spawn(common + ["--host-id", "0", "--out", out0,
+                          "--telemetry", ledger], wait=False)
+    h1 = _spawn(common + ["--host-id", "1", "--out", out1], wait=False)
+    try:
+        if not _wait_for_step(out0, kill_after):
+            h0.kill()
+            h1.kill()
+            so, se = h0.communicate(timeout=30)
+            return [f"host 0 never reached step {kill_after}: "
+                    f"{se[-800:]}"]
+        t_kill = time.time()
+        h1.send_signal(signal.SIGKILL)
+        say(f"SIGKILL'd host 1 at t=0; host 0 must detect within "
+            f"~{HEARTBEAT_TIMEOUT_S + MISS_THRESHOLD * POLL_SLEEP_S:.1f}s "
+            "+ drain")
+        try:
+            h0.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            h0.kill()
+            return ["host 0 never exited after the peer died — the "
+                    "failure detector is blind (the exact hang this "
+                    "drill exists to kill)"]
+        t_exit = time.time() - t_kill
+    finally:
+        for p in (h0, h1):
+            if p.poll() is None:
+                p.kill()
+        h1.communicate()
+    so0, se0 = h0.communicate()
+    from paddle_tpu.distributed.launch import ELASTIC_EXIT_CODE
+    if h0.returncode != ELASTIC_EXIT_CODE:
+        failures.append(
+            f"host 0 exited rc={h0.returncode}, expected "
+            f"ELASTIC_EXIT_CODE={ELASTIC_EXIT_CODE}: {se0[-600:]}")
+    recs0 = _read_lines(out0)
+    summ0 = next((r for r in recs0 if r.get("summary")), None)
+    if summ0 is None:
+        return failures + [f"host 0 wrote no summary: {se0[-600:]}"]
+    say(f"host 0: drained step {summ0['drained_step']}, exit "
+        f"{summ0['exit_code']}, wall detect->exit {t_exit:.1f}s, "
+        f"events {summ0['events']}")
+    for ev in ("heartbeat_miss", "declared_dead", "replan", "relaunch"):
+        if ev not in summ0["events"]:
+            failures.append(f"elastic event {ev!r} missing from the "
+                            "survivor's protocol sequence")
+    # detection latency: first miss -> declared dead, on the
+    # coordinator's own clock, must stay inside the threshold window
+    bound = HEARTBEAT_TIMEOUT_S + MISS_THRESHOLD * POLL_SLEEP_S + 5.0
+    if summ0.get("detect_s") is None:
+        failures.append("declared_dead record carries no detect_s")
+    elif summ0["detect_s"] > bound:
+        failures.append(
+            f"death detected in {summ0['detect_s']:.1f}s — outside the "
+            f"threshold window ({bound:.1f}s)")
+    if (summ0.get("next_layout") or {}).get("dp") != 1:
+        failures.append(f"replan did not land on the planner's 1-host "
+                        f"layout: {summ0.get('next_layout')}")
+
+    # -- leg 2: relaunch onto the planner's 1-host world --------------------
+    proc = _spawn(["--child-relaunch", "--dir", ckpt_dir,
+                   "--seed", str(seed), "--steps", str(steps),
+                   "--out", out0, "--telemetry", ledger], timeout=300)
+    if proc.returncode != 0:
+        return failures + [f"relaunch leg failed rc={proc.returncode}: "
+                           f"{proc.stderr[-800:]}"]
+    summ1 = next((r for r in _read_lines(out0)
+                  if r.get("summary") and r.get("relaunch")), None)
+    if summ1 is None:
+        return failures + ["relaunch leg wrote no summary"]
+    say(f"relaunch: plan {summ1['plan_layout']}, resumed from step "
+        f"{summ1['resumed_from']} via {summ1['resumed_via']}")
+    lay = summ1["plan_layout"]
+    if any(lay.get(a, 1) != 1 for a in ("dp", "pp", "mp", "sp", "ep")):
+        failures.append(f"planner 1-chip layout is not single-host: {lay}")
+    if summ1["resumed_via"] != "reshard":
+        failures.append(
+            f"resume took the {summ1['resumed_via']!r} path, not the "
+            "cross-layout reshard (stored dp=2 vs live dp=1 should "
+            "have routed it)")
+    if summ1["resumed_from"] != summ0["drained_step"]:
+        failures.append(
+            f"relaunch resumed from step {summ1['resumed_from']}, but "
+            f"the survivor drained step {summ0['drained_step']}")
+    if summ1["restored_weights"] != summ0["weights"]:
+        failures.append(
+            "resharded logical weights digest differs from the drained "
+            "checkpoint's — the reshard corrupted values")
+    else:
+        say("resharded weights digest-equal to the drained checkpoint")
+    if not summ1["losses_finite"] or not summ1["losses"]:
+        failures.append(f"post-reshard losses not finite: "
+                        f"{summ1['losses'][:4]}")
+    else:
+        say(f"loss continued finite for {len(summ1['losses'])} steps "
+            f"({summ1['losses'][0]:.4f} -> {summ1['losses'][-1]:.4f})")
+
+    # -- leg 3: the combined ledger must validate ---------------------------
+    from trace_check import check_pair
+    problems, stats = check_pair(ledger)
+    if problems:
+        failures.append(f"elastic telemetry ledger invalid: {problems[:3]}")
+    else:
+        say(f"ledger: {stats['n_elastic']} kind=elastic + "
+            f"{stats['n_ckpt']} kind=ckpt records validated")
+    events = [r.get("event") for r in _read_lines(ledger)
+              if r.get("kind") == "elastic"]
+    for ev in ("heartbeat_miss", "declared_dead", "replan", "relaunch",
+               "reshard_restore"):
+        if ev not in events:
+            failures.append(
+                f"kind=elastic ledger is missing the {ev!r} event — "
+                "the sequence is not fully visible in telemetry")
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# the cross-layout specimen
+# ---------------------------------------------------------------------------
+
+def make_specimen(verbose=True):
+    """(Re)generate tools/specimens/ckpt_cross_layout: a manifest
+    checkpoint saved under dp=2x mp=1 after two REAL train steps
+    (non-trivial momentum slots), plus expected.json with the logical
+    weights digest every cross-layout restore must reproduce."""
+    import shutil
+    import numpy as np
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.resilience import CheckpointManager, RunState
+
+    seed = 97
+    net, opt = build_model(seed)
+    step = TrainStep(net, lambda a, b: F.mse_loss(net(a), b), opt)
+    for i in range(SPECIMEN_STEP):
+        x, y = batch_at(i)
+        step(x, y)
+    if os.path.isdir(SPECIMEN_DIR):
+        shutil.rmtree(SPECIMEN_DIR)
+    mgr = CheckpointManager(SPECIMEN_DIR, model=net, optimizer=opt,
+                            async_save=False)
+    rs = RunState(step=SPECIMEN_STEP, layout=SPECIMEN_LAYOUT)
+    mgr.save(SPECIMEN_STEP, run_state=rs, block=True)
+    mgr.close()
+    os.remove(os.path.join(SPECIMEN_DIR, "latest"))  # a marker file
+    # would go stale in git; the directory scan is authoritative anyway
+    expected = {
+        "seed": seed, "step": SPECIMEN_STEP, "layout": SPECIMEN_LAYOUT,
+        "weights_digest": weights_digest(net),
+        "momentum_nonzero": bool(any(
+            np.abs(np.asarray(opt._states[id(p)]["velocity"])).max() > 0
+            for _, p in net.named_parameters())),
+    }
+    with open(os.path.join(SPECIMEN_DIR, "expected.json"), "w") as f:
+        json.dump(expected, f, indent=1, sort_keys=True)
+    if verbose:
+        print(f"elastic_drill: specimen written to {SPECIMEN_DIR} "
+              f"(digest {expected['weights_digest'][:12]}…)")
+    return 0
+
+
+def check_specimen(verbose=True):
+    """The --selfcheck specimen legs. Returns failure strings."""
+    import shutil
+    import numpy as np
+    import jax
+    from paddle_tpu.distributed import env as dist_env
+    from paddle_tpu.resilience import (CheckpointCorruptError,
+                                       corrupt_one_file, reshard_restore)
+
+    failures = []
+
+    def say(msg):
+        if verbose:
+            print(f"elastic_drill --selfcheck: {msg}")
+
+    with open(os.path.join(SPECIMEN_DIR, "expected.json")) as f:
+        expected = json.load(f)
+    if not expected.get("momentum_nonzero"):
+        failures.append("specimen carries no non-trivial optimizer "
+                        "state — the reshard test would prove nothing")
+
+    # (a) restore under dp=1 (no mesh): plain single-host relaunch
+    net, opt = build_model(expected["seed"] + 1)   # DIFFERENT init
+    rs = reshard_restore(SPECIMEN_DIR, target_layout={"dp": 1},
+                         mesh=None, model=net, optimizer=opt)
+    if rs is None or rs.step != expected["step"]:
+        failures.append(f"dp=1 restore returned {rs!r}, expected step "
+                        f"{expected['step']}")
+    d = weights_digest(net)
+    if d != expected["weights_digest"]:
+        failures.append("dp=1 restored weights digest mismatch — "
+                        f"{d[:12]} vs expected "
+                        f"{expected['weights_digest'][:12]}")
+    else:
+        say("dp=2 specimen restored under dp=1, digest-equal")
+    if rs is not None and (rs.layout or {}).get("dp") != 2:
+        failures.append(f"specimen RunState lost its stored layout: "
+                        f"{rs.layout}")
+
+    # (b) restore under an mp=2 MESH: the tagged weights must come
+    # back SHARDED over mp with the same logical values
+    prev_mesh = dist_env.current_mesh()
+    mesh = dist_env.build_mesh(
+        dp=1, mp=2, devices=np.asarray(jax.devices()[:2]))
+    try:
+        net2, opt2 = build_model(expected["seed"] + 2)
+        rs2 = reshard_restore(SPECIMEN_DIR,
+                              target_layout={"dp": 1, "mp": 2},
+                              mesh=mesh, model=net2, optimizer=opt2)
+        w = net2[0].weight._value
+        nshards = len({s.device for s in w.addressable_shards})
+        if nshards != 2:
+            failures.append(
+                f"mp=2 restore left the tagged weight on {nshards} "
+                "device(s) — the target Sharding was not applied")
+        d2 = weights_digest(net2)
+        if d2 != expected["weights_digest"]:
+            failures.append("mp=2 resharded weights digest mismatch")
+        else:
+            say(f"specimen restored under mp=2 ({nshards} shards), "
+                "digest-equal")
+        vel = opt2._states[id(net2[0].weight)]["velocity"]
+        if float(np.abs(np.asarray(vel)).max()) <= 0:
+            failures.append("mp=2 restore dropped the momentum slots")
+        _ = rs2
+    finally:
+        dist_env.set_mesh(prev_mesh)
+
+    # (c) a tampered leaf must be LEAF-NAMED across the reshard path
+    with tempfile.TemporaryDirectory(prefix="xlayout_tamper_") as td:
+        bad_root = os.path.join(td, "ckpt")
+        shutil.copytree(SPECIMEN_DIR, bad_root)
+        bad = corrupt_one_file(
+            os.path.join(bad_root, f"step_{expected['step']}"),
+            seed=7, prefer="arrays/model")
+        say(f"tampered {os.path.relpath(bad, bad_root)}")
+        net3, opt3 = build_model(expected["seed"] + 3)
+        try:
+            reshard_restore(bad_root, step=expected["step"],
+                            target_layout={"dp": 1}, mesh=None,
+                            model=net3, optimizer=opt3)
+            failures.append("tampered specimen was ACCEPTED by the "
+                            "reshard path — the verifier went blind")
+        except CheckpointCorruptError as e:
+            named = [p for p in e.problems if "leaf model." in p]
+            if not named:
+                failures.append(
+                    f"tamper detected but no leaf named: "
+                    f"{e.problems[:2]}")
+            else:
+                say(f"tamper rejected, leaf named: {named[0][:72]}")
+    return failures
+
+
+def run_selfcheck(verbose=True):
+    failures = check_specimen(verbose=verbose)
+    with tempfile.TemporaryDirectory(prefix="elastic_drill_") as td:
+        failures += run_drill(td, steps=3, kill_after=2, verbose=verbose)
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default=None,
+                    help="drill working dir (default: a temp dir)")
+    ap.add_argument("--steps", type=int, default=4,
+                    help="post-relaunch training steps")
+    ap.add_argument("--kill-after", type=int, default=2,
+                    help="SIGKILL the peer once host 0 passes this step")
+    ap.add_argument("--seed", type=int, default=4321)
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="CI gate: specimen cross-layout restores + "
+                         "tamper naming + mini host-loss drill")
+    ap.add_argument("--make-specimen", action="store_true",
+                    help="regenerate tools/specimens/ckpt_cross_layout")
+    ap.add_argument("--child-host", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--child-relaunch", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--host-id", default="0", help=argparse.SUPPRESS)
+    ap.add_argument("--registry", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--telemetry", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    import warnings
+    warnings.simplefilter("ignore", RuntimeWarning)
+
+    if args.child_host:
+        return run_host(args)
+    if args.child_relaunch:
+        return run_relaunch(args)
+    if args.make_specimen:
+        return make_specimen()
+
+    if args.selfcheck:
+        failures = run_selfcheck()
+        if failures:
+            for f in failures:
+                print(f"SELFCHECK FAILED: {f}", file=sys.stderr)
+            return EXIT_SELFCHECK_MISS
+        print("elastic_drill selfcheck OK: dp=2 specimen reshard-"
+              "restores under dp=1 and mp=2 digest-equal, a tampered "
+              "leaf is still leaf-named, and the host-loss drill "
+              "(detect -> replan -> drain -> reshard -> resume) passes")
+        return 0
+
+    root = args.dir or tempfile.mkdtemp(prefix="elastic_drill_")
+    failures = run_drill(root, steps=args.steps,
+                         kill_after=args.kill_after, seed=args.seed)
+    if failures:
+        for f in failures:
+            print(f"DRILL FAILED: {f}", file=sys.stderr)
+        return EXIT_DRILL_FAILED
+    print("elastic_drill OK: SIGKILL of one dp=2 host -> declared dead "
+          f"within the threshold, planner replan to the 1-host layout, "
+          "exit 101 with a drained checkpoint, reshard-restore with "
+          "digest-equal logical weights, finite continued loss — all "
+          "as validated kind=elastic telemetry")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
-    n_ckpt_records, n_bench_records, n_plan_records, problems).
+    n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
+    problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -33,9 +34,9 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics file "
-                                      "(0 bytes): no step was ever "
-                                      "recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics file "
+                                         "(0 bytes): no step was ever "
+                                         "recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -46,7 +47,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -56,6 +57,7 @@ def check_metrics_jsonl(path):
     problems += check_ckpt_records(records, path)
     problems += check_bench_records(records, path)
     problems += check_plan_records(records, path)
+    problems += check_elastic_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -66,8 +68,10 @@ def check_metrics_jsonl(path):
                   if isinstance(r, dict) and r.get("kind") == "bench")
     n_plan = sum(1 for r in records
                  if isinstance(r, dict) and r.get("kind") == "plan")
+    n_elastic = sum(1 for r in records
+                    if isinstance(r, dict) and r.get("kind") == "elastic")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
-            problems)
+            n_elastic, problems)
 
 
 def check_compile_records(records, path):
@@ -295,6 +299,67 @@ def check_plan_records(records, path):
     return problems
 
 
+def check_elastic_records(records, path):
+    """Cross-record rules for elastic-membership events (kind=elastic,
+    distributed.elastic ElasticCoordinator + resilience.reshard;
+    per-record schema lives in sink.validate_step_record):
+
+    - a declared_dead for host H requires a PRECEDING heartbeat_miss
+      for the same host — the protocol declares nobody dead without
+      recorded misses (an insta-declaration means the detector's
+      threshold accounting is broken or the ledger was doctored);
+    - a reshard_restore must reference a step some ckpt commit in the
+      file landed, when any commits are present at all (a reshard from
+      another run's directory is legitimate in a restore-only ledger)
+      — restoring an uncommitted step would mean the drain protocol
+      lost the atomic-commit guarantee; the both-layouts requirement
+      is per-record (sink validation);
+    - a relaunch requires a preceding replan — exiting 101 without a
+      recorded plan for the surviving world is a coordinator that
+      decided nothing yet relaunched anyway.
+    """
+    problems = []
+    missed_hosts = set()
+    committed = set()
+    any_commits = False
+    any_replan = False
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind == "ckpt" and rec.get("event") == "commit" and \
+                isinstance(rec.get("step"), (int, float)):
+            any_commits = True
+            committed.add(rec["step"])
+            continue
+        if kind != "elastic":
+            continue
+        event = rec.get("event")
+        host = rec.get("host")
+        if event == "heartbeat_miss":
+            missed_hosts.add(host)
+        elif event == "declared_dead":
+            if host not in missed_hosts:
+                problems.append(
+                    f"{path}:{i + 1}: host {host!r} declared dead with "
+                    "no preceding heartbeat_miss record")
+        elif event == "replan":
+            any_replan = True
+        elif event == "relaunch":
+            if not any_replan:
+                problems.append(
+                    f"{path}:{i + 1}: elastic relaunch with no "
+                    "preceding replan record")
+        elif event == "reshard_restore":
+            step = rec.get("step")
+            if any_commits and isinstance(step, (int, float)) and \
+                    step not in committed:
+                problems.append(
+                    f"{path}:{i + 1}: reshard_restore references step "
+                    f"{step} that no ckpt commit in this ledger landed")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -332,11 +397,12 @@ def check_pair(jsonl_path, trace_path=None):
     """Full validation. Returns (problems, stats): problems == [] means
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
-    n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, problems = \
-        check_metrics_jsonl(jsonl_path)
+    (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
+     problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
+             "n_elastic": n_elastic,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -383,6 +449,8 @@ def main(argv):
         msg += f" ({stats['n_bench']} bench results)"
     if stats.get("n_plan"):
         msg += f" ({stats['n_plan']} plan records)"
+    if stats.get("n_elastic"):
+        msg += f" ({stats['n_elastic']} elastic events)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
