@@ -1,0 +1,114 @@
+"""Collective wire-byte accounting over a traced (never executed) jaxpr.
+
+The planner's `cost_model.estimate_layout_cost` prices each mesh axis's
+collectives analytically (sp ring K/V hops, ep dispatch/combine
+all-to-all, ...). This module closes the honesty loop from the other
+side: walk the jaxpr of a REAL program (the ring-attention step, the
+MoE layer) and total the payload bytes each collective primitive
+actually moves — scan bodies multiplied by their trip count, shard_map
+bodies counted at their per-device shapes. The cost-model honesty test
+(tests/test_moe.py) asserts the analytic terms agree with this
+trace-derived accounting within tolerance, so the planner's ranking
+can't silently drift away from what the programs it ranks really do.
+
+Wire-fraction convention: `all_to_all`/`all_gather`/`reduce_scatter`
+contribute (n-1)/n of the operand bytes (each device keeps its own
+shard), `ppermute` the full operand (every element moves one hop),
+`psum`/`pmean` 2(n-1)/n (ring all-reduce). Axis sizes come from the
+`axis_sizes` argument; unknown axes count at full payload.
+"""
+import numpy as np
+
+__all__ = ["collective_wire_bytes", "trace_collective_wire_bytes"]
+
+# primitive name -> wire-fraction rule
+_FULL = ("ppermute",)
+_SHARD = ("all_to_all", "all_gather", "reduce_scatter")
+_ALLREDUCE = ("psum",)   # pmean lowers to psum + divide
+
+
+def _axis_size(eqn, axis_sizes):
+    names = eqn.params.get("axis_name", eqn.params.get("axes"))
+    if names is None:
+        return None
+    if not isinstance(names, (tuple, list)):
+        names = (names,)
+    n = 1
+    known = False
+    for a in names:
+        if a in (axis_sizes or {}):
+            n *= int(axis_sizes[a])
+            known = True
+    return n if known else None
+
+
+def _operand_bytes(eqn):
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        total += int(np.prod(aval.shape or (1,))) * \
+            np.dtype(aval.dtype).itemsize
+    return total
+
+
+def _wire_bytes(name, payload, n):
+    if n is None or n <= 1:
+        frac = 1.0
+    elif name in _SHARD:
+        frac = (n - 1) / n
+    elif name in _ALLREDUCE:
+        frac = 2.0 * (n - 1) / n
+    else:
+        frac = 1.0
+    return payload * frac
+
+
+def _walk(jaxpr, mult, axis_sizes, out):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _FULL + _SHARD + _ALLREDUCE:
+            entry = out.setdefault(name, {"calls": 0, "bytes": 0.0})
+            entry["calls"] += mult
+            entry["bytes"] += mult * _wire_bytes(
+                name, _operand_bytes(eqn), _axis_size(eqn, axis_sizes))
+        inner_mult = mult
+        if name == "scan":
+            inner_mult = mult * int(eqn.params.get("length", 1))
+        for sub in _sub_jaxprs(eqn):
+            _walk(sub, inner_mult, axis_sizes, out)
+    return out
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        yield from _jaxprs_in(v)
+
+
+def _jaxprs_in(v):
+    import jax.core as jcore
+    closed = getattr(jcore, "ClosedJaxpr", None)
+    jax_t = getattr(jcore, "Jaxpr", None)
+    if closed is not None and isinstance(v, closed):
+        yield v.jaxpr
+    elif jax_t is not None and isinstance(v, jax_t):
+        yield v
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _jaxprs_in(x)
+
+
+def collective_wire_bytes(closed_jaxpr, axis_sizes=None):
+    """{primitive: {calls, bytes}} over a ClosedJaxpr (recursing into
+    scan/cond/pjit/shard_map bodies; scan bodies weighted by length)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    return _walk(jaxpr, 1, axis_sizes or {}, {})
+
+
+def trace_collective_wire_bytes(fn, *args, axis_sizes=None):
+    """Trace `fn(*args)` with make_jaxpr (no execution) and account its
+    collectives. args may be arrays or ShapeDtypeStructs."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*args)
+    return collective_wire_bytes(closed, axis_sizes=axis_sizes)

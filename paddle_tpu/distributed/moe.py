@@ -1,4 +1,16 @@
-"""Mixture-of-Experts with expert parallelism.
+"""Mixture-of-Experts with expert parallelism — DEPRECATED reference
+layer.
+
+.. deprecated::
+    This einsum-mask layer is superseded by the production MoE
+    subsystem in ``paddle_tpu.moe`` (fused Pallas dispatch/combine
+    kernels with an exact fallback, explicit expert-parallel
+    all-to-all under the planner's ep axis, aux/z losses + moe.*
+    telemetry, and the GPTMoE model family). New code should use
+    ``paddle_tpu.moe.MoEFFN`` / ``paddle_tpu.moe.GPTMoE``; this module
+    stays importable for compatibility, and ``tests/test_moe.py`` pins
+    the new layer's numerics to this one (same routing math), so the
+    two cannot drift while both exist.
 
 The reference ships only the EP plumbing (`global_scatter`/`global_gather`
 all-to-all ops, `operators/collective/global_scatter_op.cc`,

@@ -232,6 +232,7 @@ class ShardedTrainStep:
     def _build_step_fn(self, check_nan_inf=False, health_taps=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
+        model = self.model
 
         def step(param_vals, opt_states, buffer_vals, lr, rng, batch_vals):
             with autograd.fresh_tape(), \
@@ -239,6 +240,11 @@ class ShardedTrainStep:
                     bind_tensors(buffers, buffer_vals), rng_guard(rng):
                 batch = [Tensor(v) for v in batch_vals]
                 loss = loss_fn(*batch)
+                # MoE routing-health taps: the forward above left the
+                # per-layer stats on the MoE layers; collect them as a
+                # device-side aux output (same pattern as health taps)
+                collect = getattr(model, "collect_moe_stats", None)
+                mstats = collect() if collect is not None else None
                 autograd.backward(loss)
                 grads = [p.grad._value if p.grad is not None
                          else jnp.zeros_like(p._value) for p in params]
@@ -274,7 +280,7 @@ class ShardedTrainStep:
                         loss._value, raw_grads, new_vals, param_vals)
                 new_buf = [b._value for b in buffers]
                 return (loss._value, new_vals, new_states, new_buf,
-                        checks, hstats)
+                        checks, hstats, mstats)
 
         return step
 
@@ -294,7 +300,7 @@ class ShardedTrainStep:
         buf_sh = [env.replicated(mesh)] * len(buffers)
         rep = env.replicated(mesh)
         in_sh = (param_sh, state_sh, buf_sh, rep, rep, None)
-        out_sh = (rep, param_sh, state_sh, buf_sh, None, None)
+        out_sh = (rep, param_sh, state_sh, buf_sh, None, None, None)
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(self._build_step_fn(check_nan_inf=check_nan_inf,
                                            health_taps=health_taps),
@@ -312,6 +318,11 @@ class ShardedTrainStep:
                     g.stage(self._last_health)
             else:
                 out = self._run_step(*batch)
+            if getattr(self, "_last_moe", None) is not None:
+                from ..moe.stats import note_step_stats
+                note_step_stats(_tw, self._last_moe,
+                                getattr(self.model, "moe_num_experts",
+                                        None))
             _tw.note(loss=out)
         if self.resilience is not None:
             self.resilience.step_boundary(loss=out)
@@ -351,7 +362,7 @@ class ShardedTrainStep:
         from ..telemetry import compile_obs
         with telemetry.span("sharded.step_dispatch", cat="dispatch"):
             (loss, new_vals, new_states, new_buf, checks,
-             hstats) = compile_obs.dispatch(
+             hstats, mstats) = compile_obs.dispatch(
                 f"{type(self).__name__}[{type(self.model).__name__}]",
                 self._jitted,
                 (param_vals, opt_states, buffer_vals, lr, rng, batch_vals),
@@ -362,6 +373,7 @@ class ShardedTrainStep:
                         "offload": self.offload},
                 donate=(0, 1, 2) if self._donate else ())
         self._last_health = hstats
+        self._last_moe = mstats
         if self.offload:
             # async D2H: evict the updated states back to pinned_host so
             # HBM is free of them between steps

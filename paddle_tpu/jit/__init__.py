@@ -285,6 +285,7 @@ class TrainStep:
     def _build_step_fn(self, check_nan_inf=False, health_taps=False):
         params, buffers, opt = self.params, self.buffers, self.optimizer
         loss_fn = self.loss_fn
+        model = self.model
 
         def step(param_vals, opt_states, buffer_vals, lr, rng, batch_vals):
             with autograd.fresh_tape(), \
@@ -292,6 +293,10 @@ class TrainStep:
                     bind_tensors(buffers, buffer_vals), rng_guard(rng):
                 batch = [Tensor(v) for v in batch_vals]
                 loss = loss_fn(*batch)
+                # MoE routing-health taps (paddle_tpu.moe): collected
+                # as a device-side aux output like the health taps
+                collect = getattr(model, "collect_moe_stats", None)
+                mstats = collect() if collect is not None else None
                 autograd.backward(loss)
                 grads = []
                 for p in params:
@@ -334,7 +339,7 @@ class TrainStep:
                         loss._value, raw_grads, new_vals, param_vals)
                 new_buf = [b._value for b in buffers]
                 return (loss._value, new_vals, new_states, new_buf,
-                        checks, hstats)
+                        checks, hstats, mstats)
 
         return step
 
@@ -360,6 +365,11 @@ class TrainStep:
                     g.stage(self._last_health)
             else:
                 out = self._run_step(*batch)
+            if getattr(self, "_last_moe", None) is not None:
+                from ..moe.stats import note_step_stats
+                note_step_stats(_tw, self._last_moe,
+                                getattr(self.model, "moe_num_experts",
+                                        None))
             _tw.note(loss=out)
         # resilience boundary AFTER the step record closes: periodic
         # checkpoint, and an armed preemption request drains + commits
@@ -397,7 +407,7 @@ class TrainStep:
         # carries the model class: two TrainSteps over different
         # models are different programs, not recompiles.
         from ..telemetry import compile_obs
-        loss, new_vals, new_states, new_buf, checks, hstats = \
+        loss, new_vals, new_states, new_buf, checks, hstats, mstats = \
             compile_obs.dispatch(
                 f"{type(self).__name__}[{type(self.model).__name__}]",
                 self._jitted,
@@ -409,6 +419,7 @@ class TrainStep:
                         "health_taps": taps},
                 donate=(0, 1, 2) if self._donate else ())
         self._last_health = hstats
+        self._last_moe = mstats
         # reassign state FIRST: the inputs were donated, so the tensors must
         # point at the fresh buffers even when the finite check fires (the
         # step itself was skipped on device in that case)

@@ -76,6 +76,20 @@ class GPTConfig:
         return GPTConfig._preset(
             dict(hidden_size=5120, num_layers=40, num_heads=40), kw)
 
+    @staticmethod
+    def gpt3_1_3b_128k(**kw):
+        """>=128k-context training preset: ring attention over the sp
+        mesh axis (the production long-context path — HBM per chip is
+        O(seq/sp)), per-block remat, flash attention for the local
+        blocks. At this sequence length the flash backward resolves to
+        block_q=512/block_k=1024 (ops/pallas_attention._resolve_blocks
+        for sq > 8192) — the r=2 triangle-grid decode covered by the
+        tests/test_pallas.py rect-block parity tests."""
+        return GPTConfig._preset(
+            dict(hidden_size=2048, num_layers=24, num_heads=16,
+                 max_seq_len=131072, sequence_parallel="ring",
+                 remat=True), kw)
+
 
 def _tag(param, axes):
     """Attach a GSPMD partition tag consumed by distributed.shard_model /
@@ -225,12 +239,17 @@ class GPTMLP(Layer):
 
 
 class GPTBlock(Layer):
+    # FFN factory hook: the MoE family (paddle_tpu.moe.GPTMoEBlock)
+    # swaps the dense MLP for the routed MoEFFN here instead of
+    # re-stating the ln/attn/dropout plumbing
+    mlp_cls = GPTMLP
+
     def __init__(self, config):
         super().__init__()
         self.ln1 = LayerNorm(config.hidden_size)
         self.attn = GPTAttention(config)
         self.ln2 = LayerNorm(config.hidden_size)
-        self.mlp = GPTMLP(config)
+        self.mlp = self.mlp_cls(config)
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x, cache=None, offset=None):
@@ -251,6 +270,11 @@ class GPTBlock(Layer):
 
 
 class GPTModel(Layer):
+    # block factory hook: model families that swap the block (the MoE
+    # family replaces the dense FFN, paddle_tpu.moe.GPTMoEModel) override
+    # this instead of re-stating the embedding/ln_f plumbing
+    block_cls = GPTBlock
+
     def __init__(self, config):
         super().__init__()
         self.config = config
@@ -260,7 +284,8 @@ class GPTModel(Layer):
         self.wpe = Embedding(c.max_seq_len, c.hidden_size, weight_attr=init)
         _tag(self.wte.weight, ("mp", None))  # vocab-parallel
         self.drop = Dropout(c.dropout)
-        self.blocks = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        self.blocks = LayerList([self.block_cls(c)
+                                 for _ in range(c.num_layers)])
         self.ln_f = LayerNorm(c.hidden_size)
 
     def init_cache(self, batch_size, max_len, dtype=None):
@@ -350,9 +375,12 @@ class GPTForPretraining(Layer):
     allreduce the reference handles at `pipeline_parallel.py:162`; with GSPMD
     the tied weight is just referenced twice and the compiler handles it)."""
 
+    # model factory hook (see GPTModel.block_cls)
+    model_cls = GPTModel
+
     def __init__(self, config):
         super().__init__()
-        self.gpt = GPTModel(config)
+        self.gpt = self.model_cls(config)
         self.config = config
 
     def forward(self, input_ids, position_ids=None, caches=None, offset=None):

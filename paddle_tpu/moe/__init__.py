@@ -1,0 +1,26 @@
+"""Mixture-of-Experts subsystem: fused dispatch/combine kernels,
+expert-parallel sharding, and the GPTMoE model family.
+
+Replaces the einsum-mask reference layer in `distributed/moe.py` (kept,
+deprecated, parity-pinned) as the production sparse path:
+
+  kernels.py  — Pallas dispatch (row gather) / combine (k-way weighted
+                gather) with an exact jnp fallback and shared index-form
+                backward;
+  router.py   — top-k routing, GShard capacity bucketing, aux/z losses,
+                routing-health stats;
+  layer.py    — MoEFFN: shard_map over the ep mesh axis with explicit
+                `lax.all_to_all` expert exchange (the collective the
+                auto-sharding planner's cost model prices);
+  model.py    — GPTMoEConfig/GPTMoE: GPT blocks with routed FFNs, aux
+                losses folded into loss(), moe.* telemetry stats.
+
+See README "MoE & long context" for the routing diagram and knobs.
+"""
+from .kernels import (combine_fallback, gather_fallback, moe_combine,
+                      moe_gather, moe_kernel_supported)  # noqa: F401
+from .layer import MoEFFN, moe_ffn_values  # noqa: F401
+from .model import (GPTMoE, GPTMoEBlock, GPTMoEConfig, GPTMoEModel,
+                    gpt_moe_tiny_config)  # noqa: F401
+from .router import capacity_for, route_top_k  # noqa: F401
+from .stats import note_step_stats  # noqa: F401

@@ -1,0 +1,257 @@
+"""Fused Pallas dispatch/combine kernels for the MoE layer.
+
+The legacy layer (`distributed/moe.py`) realizes dispatch and combine as
+einsums against dense [n, E, C] masks — O(n*E*C*d) MXU work for what is
+logically a permutation. Here both sides are index-driven Pallas
+programs over the router's slot maps (`router.route_top_k`):
+
+  - **dispatch** = `moe_gather(tokens, slot_token)`: one program gathers
+    token rows into their [E*C, d] expert buckets, zero-filling empty
+    slots — the rows stream HBM->VMEM once, O(E*C*d);
+  - **combine** = `moe_combine(expert_rows, comb_slot, comb_w)`: one
+    program accumulates each token's k weighted expert rows in f32 —
+    O(n*k*d), no [n, E, C] combine tensor ever exists.
+
+Slot maps ride the scalar-prefetch channel (`PrefetchScalarGridSpec`) so
+the index arithmetic happens in SMEM while the row DMA streams; the
+sentinel (index == n_rows) masks to zero in-kernel. `d % 128 == 0` is
+required on TPU (lane tiling); `moe_kernel_supported` is the single
+eligibility gate, and callers fall back to the pure-jnp forms below —
+`gather_fallback` / `combine_fallback` — which are the SAME index math
+via `jnp.take(mode="fill")`, so kernel and fallback are numerically
+interchangeable (pinned by tests/test_moe.py parity).
+
+Backward: both ops carry a custom_vjp whose backward is the index-form
+jnp math (gather^T = scatter-add, combine^T = gather + row-dot) — exact,
+and shared by both forward paths so the two can never diverge in grads.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["moe_gather", "moe_combine", "gather_fallback",
+           "combine_fallback", "moe_kernel_supported"]
+
+_BLOCK_ROWS = 128
+
+# the kernels keep the whole SOURCE array VMEM-resident (rows are
+# gathered by dynamic index, so no block partition of src is possible
+# without HBM streaming — a follow-up); one grid program must fit src
+# plus its output block under the per-core budget with double-buffer
+# headroom (same discipline as ops/pallas_decode.py)
+_VMEM_BUDGET = 10 * 2 ** 20
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def moe_kernel_supported(d, dtype=jnp.float32, n_src=None):
+    """Single eligibility gate for the fused path: the row width must
+    tile the 128-lane registers, the dtype must be a native vector
+    type, and — because the source array stays VMEM-resident — its
+    bytes (plus an output block) must fit the VMEM budget. Callers
+    (auto mode) fall back to the exact jnp forms otherwise."""
+    if d % 128 or jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                           jnp.dtype(jnp.bfloat16)):
+        return False
+    if n_src is not None:
+        itemsize = jnp.dtype(dtype).itemsize
+        src_bytes = (n_src + _BLOCK_ROWS) * d * itemsize
+        if src_bytes > _VMEM_BUDGET:
+            return False
+    return True
+
+
+def _pad_to(x, mult, fill):
+    r = (-x.shape[0]) % mult
+    if r:
+        x = jnp.concatenate(
+            [x, jnp.full((r,) + x.shape[1:], fill, x.dtype)])
+    return x
+
+
+# ---------------------------------------------------------------------------
+# dispatch: row gather with sentinel zero-fill
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(idx_ref, src_ref, out_ref, *, rows, n_src):
+    base = pl.program_id(0) * rows
+
+    def body(i, _):
+        t = idx_ref[base + i]
+        valid = (t < n_src).astype(src_ref.dtype)
+        safe = jnp.where(t < n_src, t, 0)
+        row = src_ref[pl.ds(safe, 1), :]
+        out_ref[pl.ds(i, 1), :] = row * valid
+        return 0
+
+    jax.lax.fori_loop(0, rows, body, 0)
+
+
+def _gather_pallas(src, idx):
+    n_src, d = src.shape
+    n_out = idx.shape[0]
+    idx_p = _pad_to(idx.astype(jnp.int32), _BLOCK_ROWS, n_src)
+    n_pad = idx_p.shape[0]
+    grid = (n_pad // _BLOCK_ROWS,)
+    out = pl.pallas_call(
+        functools.partial(_gather_kernel, rows=_BLOCK_ROWS, n_src=n_src),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[pl.BlockSpec((n_src, d), lambda b, *_: (0, 0))],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, d),
+                                   lambda b, *_: (b, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, d), src.dtype),
+        # the per-row VMEM loop reads each src row at most once per
+        # output row; cost == one src stream + one out stream
+        cost_estimate=pl.CostEstimate(
+            flops=0, transcendentals=0,
+            bytes_accessed=(n_src + 2 * n_pad) * d * src.dtype.itemsize),
+        interpret=_interpret(),
+    )(idx_p, src)
+    return out[:n_out]
+
+
+def gather_fallback(src, idx):
+    """Pure-jnp dispatch: out[i] = src[idx[i]], zeros past the end
+    (the sentinel). Identical index math to the kernel."""
+    return jnp.take(src, idx, axis=0, mode="fill", fill_value=0)
+
+
+def _gather_impl(use_kernel, src, idx):
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and moe_kernel_supported(src.shape[-1], src.dtype,
+                                               n_src=src.shape[0]))
+    if use_kernel:
+        return _gather_pallas(src, idx)
+    return gather_fallback(src, idx)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def moe_gather(src, idx, use_kernel=None):
+    """Dispatch gather with sentinel zero-fill. src [n, d], idx [m]
+    int32 in [0, n] (n == empty) -> [m, d]. use_kernel: True (Pallas),
+    False (jnp fallback), None (auto: TPU + supported)."""
+    return _gather_impl(use_kernel, src, idx)
+
+
+def _gather_fwd(src, idx, use_kernel):
+    # src rides the residuals for its shape/dtype only — bwd never
+    # reads its values, so DCE drops the dependency
+    return _gather_impl(use_kernel, src, idx), (src, idx)
+
+
+def _gather_bwd(use_kernel, res, g):
+    src, idx = res
+    # gather^T: scatter-add rows back; sentinel rows drop out of range
+    dsrc = jnp.zeros(src.shape, jnp.float32).at[idx].add(
+        g.astype(jnp.float32), mode="drop")
+    return dsrc.astype(src.dtype), None
+
+
+moe_gather.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# combine: k-way weighted row gather, f32 accumulation
+# ---------------------------------------------------------------------------
+
+def _combine_kernel(idx_ref, w_ref, src_ref, out_ref, *, rows, k, n_src):
+    base = pl.program_id(0) * rows
+
+    def body(i, _):
+        acc = jnp.zeros((1, out_ref.shape[-1]), jnp.float32)
+        for s in range(k):          # k is static and small (1/2)
+            t = idx_ref[(base + i) * k + s]
+            w = w_ref[(base + i) * k + s]
+            valid = (t < n_src).astype(jnp.float32)
+            safe = jnp.where(t < n_src, t, 0)
+            row = src_ref[pl.ds(safe, 1), :].astype(jnp.float32)
+            acc = acc + (w * valid) * row
+        out_ref[pl.ds(i, 1), :] = acc.astype(out_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, rows, body, 0)
+
+
+def _combine_pallas(src, idx, w):
+    n_src, d = src.shape
+    n, k = idx.shape
+    pad = (-n) % _BLOCK_ROWS
+    idx_p = _pad_to(idx.astype(jnp.int32), _BLOCK_ROWS, n_src)
+    w_p = _pad_to(w.astype(jnp.float32), _BLOCK_ROWS, 0.0)
+    n_pad = n + pad
+    grid = (n_pad // _BLOCK_ROWS,)
+    out = pl.pallas_call(
+        functools.partial(_combine_kernel, rows=_BLOCK_ROWS, k=k,
+                          n_src=n_src),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[pl.BlockSpec((n_src, d), lambda b, *_: (0, 0))],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, d),
+                                   lambda b, *_: (b, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, d), src.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_pad * k * d, transcendentals=0,
+            bytes_accessed=(n_src + (k + 1) * n_pad) * d
+            * src.dtype.itemsize),
+        interpret=_interpret(),
+    )(idx_p.reshape(-1), w_p.reshape(-1), src)
+    return out[:n]
+
+
+def combine_fallback(src, idx, w):
+    """Pure-jnp combine: out[i] = sum_s w[i,s] * src[idx[i,s]] with the
+    sentinel zero-filled, f32 accumulation like the kernel."""
+    gathered = jnp.take(src, idx, axis=0, mode="fill",
+                        fill_value=0).astype(jnp.float32)  # [n, k, d]
+    out = jnp.sum(w.astype(jnp.float32)[..., None] * gathered, axis=1)
+    return out.astype(src.dtype)
+
+
+def _combine_impl(use_kernel, src, idx, w):
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and moe_kernel_supported(src.shape[-1], src.dtype,
+                                               n_src=src.shape[0]))
+    if use_kernel:
+        return _combine_pallas(src, idx, w)
+    return combine_fallback(src, idx, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def moe_combine(src, idx, w, use_kernel=None):
+    """Weighted combine. src [m, d], idx [n, k] int32 in [0, m]
+    (m == dropped), w [n, k] -> [n, d]. use_kernel as in moe_gather."""
+    return _combine_impl(use_kernel, src, idx, w)
+
+
+def _combine_fwd(src, idx, w, use_kernel):
+    return _combine_impl(use_kernel, src, idx, w), (src, idx, w)
+
+
+def _combine_bwd(use_kernel, res, g):
+    src, idx, w = res
+    g32 = g.astype(jnp.float32)
+    n, k = idx.shape
+    # combine^T wrt src: scatter-add w[i,s] * g[i] at idx[i,s]
+    contrib = (w.astype(jnp.float32)[..., None] * g32[:, None, :])
+    dsrc = jnp.zeros(src.shape, jnp.float32).at[
+        idx.reshape(-1)].add(contrib.reshape(n * k, -1), mode="drop")
+    # combine^T wrt w: dot of g[i] with the gathered row
+    gathered = jnp.take(src, idx, axis=0, mode="fill",
+                        fill_value=0).astype(jnp.float32)
+    dw = jnp.sum(gathered * g32[:, None, :], axis=-1)
+    return dsrc.astype(src.dtype), None, dw.astype(w.dtype)
+
+
+moe_combine.defvjp(_combine_fwd, _combine_bwd)

@@ -1,0 +1,195 @@
+"""MoE FFN layer: routed expert feed-forward with expert parallelism.
+
+Production successor of `distributed/moe.py`'s reference `MoELayer`
+(kept for compatibility; a parity test pins this layer to its numerics
+at ep=1). Differences that make this the production path:
+
+  - dispatch/combine are index-driven Pallas kernels (or their exact
+    jnp fallback) instead of O(n*E*C*d) mask einsums — `kernels.py`;
+  - expert parallelism is EXPLICIT: under a mesh with ep > 1 the layer
+    shard_maps over the ep axis — tokens split over ep, experts local —
+    and moves expert buckets through `lax.all_to_all` (the
+    global_scatter/global_gather analog), so the collective the planner
+    prices (`cost_model.estimate_layout_cost` ep term) appears verbatim
+    in the traced program (tests/test_moe.py cross-checks the two);
+  - load-balancing aux loss + router z-loss are first-class outputs the
+    model folds into the training loss, and the routing health stats
+    ride the telemetry step record (`moe.*` fields).
+
+Weights (tagged for the planner's `gpt_moe_partition_rules`):
+  w_gate [d, E]      replicated
+  w_in   [E, d, f]   ("ep", None, "mp")
+  w_out  [E, f, d]   ("ep", "mp", None)
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, apply
+from ..nn import Layer
+from ..nn.initializer import Normal, XavierUniform
+from .kernels import moe_gather, moe_combine
+from .router import route_top_k, capacity_for
+
+__all__ = ["MoEFFN", "moe_ffn_values"]
+
+
+def _local_moe(tokens, wg, wi, wo, *, num_experts, k, capacity_factor,
+               ep, axis_name, use_kernel):
+    """Per-device MoE body. tokens [n_loc, d] local token block; wi/wo
+    hold the LOCAL expert shard [E/ep, d, f] when ep > 1 (inside
+    shard_map) or all experts when ep == 1."""
+    n_loc, d = tokens.shape
+    E = num_experts
+    e_loc = E // ep
+    C = capacity_for(n_loc, E, k, capacity_factor)
+
+    logits = tokens @ wg.astype(tokens.dtype)
+    comb_w, comb_slot, slot_token, aux, z, stats = route_top_k(
+        logits, k, C)
+
+    # dispatch: token rows into [E*C, d] expert buckets (THE kernel)
+    expert_in = moe_gather(tokens, slot_token, use_kernel)
+
+    if ep > 1:
+        # expert-parallel all-to-all: my [E, C, d] buckets, split by
+        # destination device (e_loc experts each), exchanged so each
+        # device ends with its OWN experts' buckets from every source:
+        # [ep_src * e_loc * C, d] -> regroup per local expert
+        ei = expert_in.reshape(ep * e_loc * C, d)
+        ei = jax.lax.all_to_all(ei, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+        grouped = ei.reshape(ep, e_loc, C, d).transpose(1, 0, 2, 3) \
+            .reshape(e_loc, ep * C, d)
+    else:
+        grouped = expert_in.reshape(e_loc, C, d)
+
+    # grouped expert FFN (stacked einsum — XLA batches the per-expert
+    # matmuls; gelu matches the legacy layer exactly)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", grouped,
+                               wi.astype(tokens.dtype)))
+    eo = jnp.einsum("ecf,efd->ecd", h, wo.astype(tokens.dtype))
+
+    if ep > 1:
+        eo = eo.reshape(e_loc, ep, C, d).transpose(1, 0, 2, 3) \
+            .reshape(ep * e_loc * C, d)
+        eo = jax.lax.all_to_all(eo, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+        eo = eo.reshape(E * C, d)
+        # routing health is a GLOBAL property: average over the ep group
+        stats = jax.lax.pmean(stats, axis_name)
+        aux = jax.lax.pmean(aux, axis_name)
+        z = jax.lax.pmean(z, axis_name)
+    else:
+        eo = eo.reshape(E * C, d)
+
+    # combine: each token's k weighted expert rows (THE other kernel)
+    out = moe_combine(eo, comb_slot, comb_w.astype(tokens.dtype),
+                      use_kernel)
+    return out.astype(tokens.dtype), aux, z, stats
+
+
+def moe_ffn_values(x, wg, wi, wo, *, num_experts, k=2,
+                   capacity_factor=1.25, use_kernel=None,
+                   axis_name="ep", mesh=None):
+    """jax-value level MoE FFN. x [..., d] -> (out, aux, z, stats).
+
+    With a mesh whose `ep` axis is > 1 the body runs inside a
+    shard_map over ep: the flattened token dim is split over ep, the
+    expert dim of wi/wo is split over ep, and the dispatch/combine
+    all-to-alls are explicit `lax.all_to_all`s over the axis. Other
+    mesh axes (dp/mp) stay GSPMD-auto, like ops/ring_attention.py.
+    """
+    from ..distributed import env
+    mesh = mesh or env.current_mesh()
+    ep = 1
+    if mesh is not None and axis_name in mesh.axis_names:
+        ep = int(mesh.shape[axis_name])
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    tokens = x.reshape(-1, d)
+    n = tokens.shape[0]
+    if ep > 1 and (n % ep or num_experts % ep):
+        raise ValueError(
+            f"expert parallelism needs tokens ({n}) and num_experts "
+            f"({num_experts}) divisible by the '{axis_name}' mesh axis "
+            f"size {ep}")
+    inner = functools.partial(
+        _local_moe, num_experts=num_experts, k=k,
+        capacity_factor=capacity_factor, ep=ep, axis_name=axis_name,
+        use_kernel=use_kernel)
+    if ep > 1:
+        shard = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(axis_name, None), P(None, None),
+                      P(axis_name, None, None), P(axis_name, None, None)),
+            out_specs=(P(axis_name, None), P(), P(), P(None)),
+            axis_names={axis_name})
+        out, aux, z, stats = shard(tokens, wg, wi, wo)
+    else:
+        out, aux, z, stats = inner(tokens, wg, wi, wo)
+    return out.reshape(orig_shape), aux, z, stats
+
+
+class MoEFFN(Layer):
+    """Drop-in FFN replacement: x [..., d] -> same shape, stashing the
+    aux/z losses and routing stats of the LAST forward (the model folds
+    the losses into training loss and surfaces the stats to telemetry).
+
+    config: GPTMoEConfig-shaped (hidden_size, ffn_hidden_size,
+    num_experts, expert_top_k, capacity_factor, initializer_range).
+    """
+
+    def __init__(self, config=None, d_model=None, d_ff=None,
+                 num_experts=None, k=None, capacity_factor=None,
+                 use_kernel=None):
+        super().__init__()
+        c = config
+        d = d_model if d_model is not None else c.hidden_size
+        f = d_ff if d_ff is not None else c.ffn_hidden_size
+        E = num_experts if num_experts is not None else c.num_experts
+        self.num_experts = E
+        self.k = k if k is not None else getattr(c, "expert_top_k", 2)
+        self.capacity_factor = capacity_factor if capacity_factor \
+            is not None else getattr(c, "capacity_factor", 1.25)
+        self.use_kernel = use_kernel
+        init = Normal(0.0, c.initializer_range) if c is not None \
+            else XavierUniform()
+        self.w_gate = self.create_parameter([d, E],
+                                            default_initializer=init)
+        self.w_in = self.create_parameter([E, d, f],
+                                          default_initializer=init)
+        self.w_out = self.create_parameter([E, f, d],
+                                           default_initializer=init)
+        # planner-rule parity: gpt_moe_partition_rules must resolve to
+        # exactly these tags (pinned by tests/test_moe.py)
+        self.w_in.mesh_axes = ("ep", None, "mp")
+        self.w_out.mesh_axes = ("ep", "mp", None)
+        self._aux_loss = None
+        self._z_loss = None
+        self._stats = None
+
+    def forward(self, x):
+        fn = functools.partial(
+            moe_ffn_values, num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            use_kernel=self.use_kernel)
+        out, aux, z, stats = apply(lambda xv, g, i, o: fn(xv, g, i, o),
+                                   x, self.w_gate, self.w_in, self.w_out)
+        self._aux_loss = aux
+        self._z_loss = z
+        self._stats = stats
+        return out
+
+    def aux_loss(self):
+        return self._aux_loss
+
+    def z_loss(self):
+        return self._z_loss
+
+    def stats(self):
+        """[entropy, dropped_frac, overflow, aux, z] Tensor of the last
+        forward (router.STATS_FIELDS order), or None."""
+        return self._stats

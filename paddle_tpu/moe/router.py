@@ -1,0 +1,107 @@
+"""Top-k expert routing with GShard capacity bucketing.
+
+The routing math is deliberately IDENTICAL to the legacy reference layer
+(`distributed/moe.py` MoELayer): softmax gate -> `lax.top_k` -> per-slot
+cumulative positions with the cross-slot count offset (slot-s positions
+start after every slot-<s assignment of the same expert, so a token's
+1st and 2nd choice never collide on a capacity slot). What differs is
+the REPRESENTATION: instead of the dense [n, E, C] dispatch/combine
+masks the legacy layer einsums against (O(n*E*C*d) work), the router
+returns index/weight form —
+
+  slot_token [E*C]  int32  token occupying slot (e, c), n = empty
+  comb_slot  [n, k] int32  flat slot each choice landed in, E*C = dropped
+  comb_w     [n, k] f32    gate weight (0 where dropped)
+
+— which the dispatch/combine gathers (``kernels.py``) consume in
+O(E*C*d + n*k*d). Because the positions are bijective over kept
+(token, slot) pairs, the two forms are exactly interchangeable; the
+parity tests pin kernel == fallback == legacy MoELayer.
+
+Also computed here, on the same logits (one softmax, shared):
+  - load-balancing aux loss (GShard eq.(4) / Switch):
+    E * sum_e f_e * p_e over the top-1 assignment;
+  - router z-loss (ST-MoE): mean(logsumexp(logits)^2), keeps the gate
+    logits from drifting into bf16-hostile magnitudes;
+  - routing health stats [entropy, dropped_frac, overflow, aux, z]
+    that ride the step record as moe.* fields (telemetry.sink).
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["route_top_k", "router_stats_names", "capacity_for"]
+
+# order of the stats vector route_top_k returns; the telemetry wiring
+# (moe.stats) and the step-record fields key off this
+STATS_FIELDS = ("entropy", "dropped_frac", "overflow", "aux_loss",
+                "z_loss")
+
+
+def router_stats_names():
+    return STATS_FIELDS
+
+
+def capacity_for(n_tokens, num_experts, k, capacity_factor):
+    """Per-expert capacity — the legacy layer's exact formula, so the
+    index form and the mask form bucket identically."""
+    return max(1, int(capacity_factor * n_tokens * k / num_experts))
+
+
+def route_top_k(logits, k, capacity):
+    """logits [n, E] -> (comb_w [n, k], comb_slot [n, k], slot_token
+    [E*C], aux, z, stats [5]).
+
+    comb_slot entries are flat e*C+c indices (E*C when the choice was
+    dropped at capacity); slot_token entries are token ids (n when the
+    slot stayed empty). Differentiable through comb_w / aux / z only —
+    positions are integer data.
+    """
+    n, E = logits.shape
+    C = int(capacity)
+    n_slots = E * C
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)     # [n, k]
+
+    counts = jnp.zeros((E,), jnp.int32)
+    slot_token = jnp.full((n_slots,), n, jnp.int32)
+    token_ids = jnp.arange(n, dtype=jnp.int32)
+    comb_slot = []
+    comb_w = []
+    kept_total = jnp.zeros((), jnp.float32)
+    for s in range(k):
+        idx = gate_idx[:, s]                          # [n]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+        pos_in_e = jnp.sum(pos, axis=-1) + jnp.take(counts, idx)
+        counts = counts + jnp.sum(onehot, axis=0)
+        keep = pos_in_e < C
+        flat = idx * C + jnp.minimum(pos_in_e, C - 1)
+        # out-of-range scatter indices are DROPPED (mode="drop"), so a
+        # capacity-overflowed choice can never overwrite a kept slot
+        slot_token = slot_token.at[
+            jnp.where(keep, flat, n_slots)].set(token_ids, mode="drop")
+        comb_slot.append(jnp.where(keep, flat, n_slots))
+        comb_w.append(gate_vals[:, s] * keep.astype(jnp.float32))
+        kept_total = kept_total + jnp.sum(keep.astype(jnp.float32))
+
+    comb_slot = jnp.stack(comb_slot, axis=1)          # [n, k]
+    comb_w = jnp.stack(comb_w, axis=1)                # [n, k]
+
+    # aux loss over the top-1 assignment (GShard): E * sum(f_e * p_e)
+    top1 = gate_idx[:, 0]
+    frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    # router z-loss (ST-MoE eq.(5))
+    z = jnp.mean(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+                 ** 2)
+
+    # health stats (non-differentiable by construction — integer-derived)
+    f_safe = jnp.maximum(frac, 1e-9)
+    entropy = -jnp.sum(frac * jnp.log(f_safe))        # <= log(E)
+    dropped_frac = 1.0 - kept_total / float(n * k)
+    overflow = jnp.max(counts).astype(jnp.float32) / float(C)
+    stats = jnp.stack([entropy, dropped_frac, overflow,
+                       jax.lax.stop_gradient(aux),
+                       jax.lax.stop_gradient(z)])
+    return comb_w, comb_slot, slot_token, aux, z, stats

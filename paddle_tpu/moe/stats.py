@@ -1,0 +1,77 @@
+"""MoE routing-health telemetry wiring.
+
+One owner for the hop from the compiled step's device-side stats vector
+(`GPTMoE.collect_moe_stats`, router.STATS_FIELDS order) to the two
+observable surfaces:
+
+  - the telemetry STEP RECORD: `moe_entropy` / `moe_dropped_frac` /
+    `moe_overflow` / `moe_aux_loss` / `moe_num_experts` as first-class
+    fields (telemetry.sink.MOE_KEYS; schema-validated, cross-checked by
+    tools/trace_check.py: dropped_frac in [0,1], entropy <= log(E));
+  - `moe.*` monitor gauges on the PR-3 /metrics endpoint.
+
+Called by TrainStep/ShardedTrainStep after each dispatch; the fetch is
+one (5,) host transfer, piggybacking the loss fetch's device sync.
+"""
+import math
+
+import numpy as np
+
+from .. import monitor
+
+__all__ = ["note_step_stats"]
+
+
+# float32-accumulation jitter the boundary clamp may absorb; anything
+# beyond it is a PRODUCER bug and must reach the record unclamped so
+# the schema/trace_check bounds actually fire on it
+_EPS = 1e-4
+
+
+def _clamp_jitter(v, lo=None, hi=None):
+    if lo is not None and lo - _EPS <= v < lo:
+        return lo
+    if hi is not None and hi < v <= hi + _EPS:
+        return hi
+    return v
+
+
+def note_step_stats(win, stats, num_experts):
+    """Fetch the (5,) stats vector and land it on the step window +
+    monitor gauges. `win` is the telemetry auto_step window (inert
+    windows accept .note too). Returns the dict noted, or None when the
+    vector is unusable or no expert count was given (the trace_check
+    cross-rule REQUIRES moe_num_experts on any record carrying moe.*
+    fields — emitting a record our own validator rejects helps nobody).
+
+    Boundary values are clamped only within the float-accumulation
+    jitter band (_EPS); a value genuinely outside its bound (entropy
+    above log E, dropped_frac above 1) is recorded AS IS so the schema
+    validation and the trace_check cross-rule fire on the producer bug
+    instead of being silently laundered."""
+    if stats is None or not num_experts:
+        return None
+    try:
+        vals = np.asarray(stats, dtype=np.float64)
+    except Exception:
+        return None
+    if vals.shape != (5,) or not np.all(np.isfinite(vals)):
+        return None
+    entropy, dropped, overflow, aux, z = (float(v) for v in vals)
+    dropped = _clamp_jitter(dropped, lo=0.0, hi=1.0)
+    entropy = _clamp_jitter(entropy, lo=0.0, hi=math.log(num_experts))
+    overflow = _clamp_jitter(overflow, lo=0.0)
+    fields = {
+        "moe_entropy": round(entropy, 6),
+        "moe_dropped_frac": round(dropped, 6),
+        "moe_overflow": round(overflow, 6),
+        "moe_aux_loss": round(aux, 6),
+        "moe_num_experts": int(num_experts),
+    }
+    win.note(**fields)
+    monitor.set_gauge("moe.entropy", fields["moe_entropy"])
+    monitor.set_gauge("moe.dropped_frac", fields["moe_dropped_frac"])
+    monitor.set_gauge("moe.overflow", fields["moe_overflow"])
+    monitor.set_gauge("moe.aux_loss", fields["moe_aux_loss"])
+    monitor.set_gauge("moe.z_loss", round(z, 6))
+    return fields

@@ -50,9 +50,8 @@ def _ring_inner(ql, kl, vl, *, sp, causal, scale, axis_name):
     perm = [(r, (r + 1) % sp) for r in range(sp)]
     qpos = i * s_loc + jnp.arange(s_loc)               # global q positions
 
-    def step(carry, t):
-        kc, vc, m, num, den = carry
-        j = (i - t) % sp                               # held kv chunk index
+    def attend(args):
+        kc, vc, m, num, den, j = args
         s = jnp.einsum("bnqh,bnkh->bnqk", q, kc,
                        preferred_element_type=jnp.float32) * scale
         if causal:
@@ -70,8 +69,25 @@ def _ring_inner(ql, kl, vl, *, sp, causal, scale, axis_name):
         den = den * corr + jnp.sum(p, axis=-1)
         num = num * corr[..., None] + jnp.einsum(
             "bnqk,bnkh->bnqh", p, vc, preferred_element_type=jnp.float32)
+        return new_m, num, den
+
+    def step(carry, t):
+        kc, vc, m, num, den = carry
+        j = (i - t) % sp                               # held kv chunk index
+        if causal:
+            # hop skip: a kv chunk entirely in this device's causal
+            # FUTURE (j > i) contributes nothing — every score would be
+            # masked. Skipping the matmuls halves the causal ring's
+            # compute (the blockwise-parallel trick of Ring Attention,
+            # Liu et al. 2023); the ppermute below still runs every hop
+            # so the ring stays in lockstep.
+            m, num, den = jax.lax.cond(
+                j <= i, attend, lambda a: (a[2], a[3], a[4]),
+                (kc, vc, m, num, den, j))
+        else:
+            m, num, den = attend((kc, vc, m, num, den, j))
         kc, vc = jax.lax.ppermute((kc, vc), axis_name, perm)
-        return (kc, vc, new_m, num, den), None
+        return (kc, vc, m, num, den), None
 
     (kc, vc, m, num, den), _ = jax.lax.scan(
         step, (kc, vc, m0, num0, den0), jnp.arange(sp))

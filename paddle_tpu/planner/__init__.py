@@ -34,21 +34,25 @@ from .memory import (  # noqa: F401
     tp_divisibility_issues,
 )
 from .rules import (  # noqa: F401
-    SpecLayout, apply_partition_rules, gpt_partition_rules,
-    match_partition_rules, parameter_spec_from_name,
+    SpecLayout, apply_partition_rules, gpt_moe_partition_rules,
+    gpt_partition_rules, match_partition_rules,
+    parameter_spec_from_name,
 )
 from .planner import (  # noqa: F401
     AbstractParam, Candidate, InfeasiblePlanError, Layout, MeshSpec,
-    Plan, calibration_from_records, evaluate_layout,
-    gpt_abstract_params, plan,
+    Plan, abstract_params_for, calibration_from_records,
+    default_rules_for, evaluate_layout, gpt_abstract_params,
+    gpt_moe_abstract_params, plan,
 )
 
 __all__ = [
     "HBM_BYTES", "MemoryPlan", "gpt_memory_plan", "gpt_params",
     "search_plan", "tp_divisibility_issues",
     "SpecLayout", "apply_partition_rules", "gpt_partition_rules",
-    "match_partition_rules", "parameter_spec_from_name",
+    "gpt_moe_partition_rules", "match_partition_rules",
+    "parameter_spec_from_name",
     "AbstractParam", "Candidate", "InfeasiblePlanError", "Layout",
-    "MeshSpec", "Plan", "calibration_from_records", "evaluate_layout",
-    "gpt_abstract_params", "plan",
+    "MeshSpec", "Plan", "abstract_params_for",
+    "calibration_from_records", "default_rules_for", "evaluate_layout",
+    "gpt_abstract_params", "gpt_moe_abstract_params", "plan",
 ]

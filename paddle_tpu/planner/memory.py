@@ -44,15 +44,22 @@ class MemoryPlan:
 
 
 def gpt_params(cfg):
-    """Exact parameter count of models.gpt.GPTForPretraining(cfg)."""
+    """Exact parameter count of models.gpt.GPTForPretraining(cfg) —
+    or, when the config carries num_experts > 0, of the GPTMoE family
+    (paddle_tpu.moe): the dense fc1/fc2 MLP is replaced per block by a
+    [d, E] router gate and E bias-free expert pairs."""
     d, L, v, s = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
                   cfg.max_seq_len)
     f = cfg.ffn_hidden_size
+    E = int(getattr(cfg, "num_experts", 0) or 0)
+    if E:
+        ffn = d * E + E * (d * f) + E * (f * d)   # gate + w_in + w_out
+    else:
+        ffn = d * f + f + f * d + d               # fc1 (w+b) + fc2 (w+b)
     per_block = (
         3 * d * d + 3 * d          # qkv proj (w+b)
         + d * d + d                # out proj
-        + d * f + f                # fc1
-        + f * d + d                # fc2
+        + ffn
         + 4 * d                    # 2 LayerNorms (g+b)
     )
     return v * d + s * d + L * per_block + 2 * d  # wte + wpe + blocks + ln_f
@@ -94,6 +101,14 @@ def gpt_memory_plan(cfg, dp=1, mp=1, pp=1, sp=1, micro_batch=1,
 
     seq_local = cfg.max_seq_len // sp
     boundary = micro_batch * seq_local * d * compute_dtype_bytes
+    # MoE (num_experts > 0): the routed FFN pushes capacity_factor * k
+    # copies of each token through the expert stack, so the live FFN
+    # intermediate scales by that factor relative to the dense MLP
+    ffn_scale = 1.0
+    E = int(getattr(cfg, "num_experts", 0) or 0)
+    if E:
+        ffn_scale = (float(getattr(cfg, "capacity_factor", 1.25))
+                     * int(getattr(cfg, "expert_top_k", 2)))
     # materialized [mb, heads/mp, s/sp, s] softmax matrix — zero when flash
     # attention tiles it away inside the kernel
     probs = 0
@@ -109,16 +124,17 @@ def gpt_memory_plan(cfg, dp=1, mp=1, pp=1, sp=1, micro_batch=1,
         # when flash attention is off). pp=1 degenerates to standard remat:
         # ~L boundaries + one block's internals.
         act = boundary * (2 * pp + local_layers)
-        act += (micro_batch * seq_local *
-                (cfg.ffn_hidden_size // mp) * compute_dtype_bytes) * 2
+        act += int(micro_batch * seq_local *
+                   (cfg.ffn_hidden_size // mp) * compute_dtype_bytes
+                   * 2 * ffn_scale)
         act += probs
     else:
         # ~10 tensors of [mb, s/sp, d] per layer survive to backward in a
         # transformer block without remat (post-ln, qkv, probs-proj, ffn)
         act = boundary * local_layers * 10
-        act += (micro_batch * seq_local *
-                (cfg.ffn_hidden_size // mp) * compute_dtype_bytes
-                ) * 2 * local_layers
+        act += int(micro_batch * seq_local *
+                   (cfg.ffn_hidden_size // mp) * compute_dtype_bytes
+                   * 2 * local_layers * ffn_scale)
         act += probs * local_layers
     # logits buffer on the last stage: [mb, s/sp, vocab/mp] in f32
     logits = micro_batch * seq_local * (cfg.vocab_size // mp) * 4

@@ -37,11 +37,14 @@ from ..analysis import sharding_lint
 from .. import cost_model
 from .memory import (HBM_BYTES, gpt_memory_plan, gpt_params, _divisors,
                      tp_divisibility_issues)
-from .rules import gpt_partition_rules, match_partition_rules
+from .rules import (gpt_moe_partition_rules, gpt_partition_rules,
+                    match_partition_rules)
 
 __all__ = ["plan", "Plan", "Layout", "Candidate", "MeshSpec",
            "AbstractParam", "InfeasiblePlanError", "gpt_abstract_params",
-           "evaluate_layout", "calibration_from_records"]
+           "gpt_moe_abstract_params", "abstract_params_for",
+           "default_rules_for", "evaluate_layout",
+           "calibration_from_records"]
 
 MESH_AXES = ("dp", "pp", "mp", "sp", "ep")
 
@@ -140,6 +143,45 @@ def gpt_abstract_params(cfg, prefix="gpt.", dtype=np.float32):
     out += [(f"{prefix}ln_f.weight", AbstractParam((d,), dtype)),
             (f"{prefix}ln_f.bias", AbstractParam((d,), dtype))]
     return out
+
+
+def gpt_moe_abstract_params(cfg, prefix="gpt.", dtype=np.float32):
+    """[(name, AbstractParam)] for `paddle_tpu.moe.GPTMoE(cfg)` —
+    DERIVED from the dense skeleton (one source of truth): each block's
+    fc1/fc2 MLP entries are replaced in place by the routed expert
+    stack (router gate + stacked expert weights, no expert biases —
+    matching MoEFFN via GPTBlock's mlp_cls hook). Name/shape/order
+    parity with the live model is pinned by tests/test_moe.py."""
+    d, f = cfg.hidden_size, cfg.ffn_hidden_size
+    E = int(getattr(cfg, "num_experts", 0) or 0)
+    out = []
+    for name, p in gpt_abstract_params(cfg, prefix=prefix, dtype=dtype):
+        if name.endswith("mlp.fc1.weight"):
+            b = name[:-len("fc1.weight")]
+            out += [(b + "w_gate", AbstractParam((d, E), dtype)),
+                    (b + "w_in", AbstractParam((E, d, f), dtype)),
+                    (b + "w_out", AbstractParam((E, f, d), dtype))]
+        elif ".mlp." not in name:
+            out.append((name, p))
+    return out
+
+
+def _is_moe(cfg):
+    return int(getattr(cfg, "num_experts", 0) or 0) > 0
+
+
+def abstract_params_for(cfg, dtype=np.float32):
+    """Model-family dispatch: a config carrying num_experts > 0 is the
+    GPTMoE family, anything else the dense GPT family."""
+    if _is_moe(cfg):
+        return gpt_moe_abstract_params(cfg, dtype=dtype)
+    return gpt_abstract_params(cfg, dtype=dtype)
+
+
+def default_rules_for(cfg):
+    """Default partition-rule set for a config's model family."""
+    return gpt_moe_partition_rules() if _is_moe(cfg) \
+        else gpt_partition_rules()
 
 
 @dataclass(frozen=True, order=True)
@@ -439,8 +481,8 @@ def evaluate_layout(model_cfg, layout, chip="v5p", hbm_budget=None,
     layout = layout if isinstance(layout, Layout) else Layout(**layout)
     budget = hbm_budget if hbm_budget is not None \
         else int(HBM_BYTES[chip] * headroom)
-    rules = rules if rules is not None else gpt_partition_rules()
-    named = gpt_abstract_params(model_cfg, dtype=param_dtype)
+    rules = rules if rules is not None else default_rules_for(model_cfg)
+    named = abstract_params_for(model_cfg, dtype=param_dtype)
     tagged = _resolve_tagged(named, match_partition_rules(rules, named))
     ratio = calibration if isinstance(calibration, (int, float)) \
         else calibration_from_records(calibration)
@@ -686,14 +728,15 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
     n, fixed = _resolve_mesh_shape(mesh_shape, n_chips)
     budget = hbm_budget if hbm_budget is not None \
         else int(HBM_BYTES[chip] * headroom)
-    rules = rules if rules is not None else gpt_partition_rules()
+    rules = rules if rules is not None else default_rules_for(model_cfg)
     ratio = calibration if isinstance(calibration, (int, float)) \
         else calibration_from_records(calibration)
     ratio = float(ratio or 1.0)
-    named = gpt_abstract_params(model_cfg, dtype=param_dtype)
+    named = abstract_params_for(model_cfg, dtype=param_dtype)
     tagged = _resolve_tagged(named, match_partition_rules(rules, named))
     if model_name is None:
-        model_name = (f"gpt[{gpt_params(model_cfg) / 1e6:.0f}M"
+        fam = "gpt_moe" if _is_moe(model_cfg) else "gpt"
+        model_name = (f"{fam}[{gpt_params(model_cfg) / 1e6:.0f}M"
                       f"/L{model_cfg.num_layers}/s{model_cfg.max_seq_len}]")
 
     layouts = _enumerate_layouts(model_cfg, n, fixed, tuple(zero_stages),

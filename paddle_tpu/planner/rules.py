@@ -22,9 +22,10 @@ from dataclasses import dataclass
 __all__ = [
     "COLUMN_PARALLEL_WEIGHT_AXES", "COLUMN_PARALLEL_BIAS_AXES",
     "ROW_PARALLEL_WEIGHT_AXES", "VOCAB_PARALLEL_WEIGHT_AXES",
+    "EXPERT_IN_WEIGHT_AXES", "EXPERT_OUT_WEIGHT_AXES",
     "REPLICATED", "SpecLayout", "gpt_partition_rules",
-    "parameter_spec_from_name", "match_partition_rules",
-    "apply_partition_rules",
+    "gpt_moe_partition_rules", "parameter_spec_from_name",
+    "match_partition_rules", "apply_partition_rules",
 ]
 
 # Megatron placement, single source of truth (mp_layers + models/gpt
@@ -34,6 +35,11 @@ COLUMN_PARALLEL_WEIGHT_AXES = (None, "mp")
 COLUMN_PARALLEL_BIAS_AXES = ("mp",)
 ROW_PARALLEL_WEIGHT_AXES = ("mp", None)
 VOCAB_PARALLEL_WEIGHT_AXES = ("mp", None)
+# expert-parallel MoE placement (paddle_tpu.moe.MoEFFN's _tag values,
+# single owner): stacked expert weights shard the EXPERT dim over ep
+# and keep the Megatron ffn split over mp inside each expert
+EXPERT_IN_WEIGHT_AXES = ("ep", None, "mp")     # w_in  [E, d, f]
+EXPERT_OUT_WEIGHT_AXES = ("ep", "mp", None)    # w_out [E, f, d]
 # explicit replication: () normalizes to an all-None spec; distinct
 # from "no rule matched" (which SH208 flags under a sharded layout)
 REPLICATED = ()
@@ -67,6 +73,23 @@ class SpecLayout:
     def vocab_parallel(self):
         return self._mp(VOCAB_PARALLEL_WEIGHT_AXES)
 
+    def _ep(self, axes):
+        out = []
+        for a in axes:
+            if a == "ep":
+                out.append(self.ep_axis)
+            elif a == "mp":
+                out.append(self.tp_axis)
+            else:
+                out.append(a)
+        return tuple(out)
+
+    def expert_in(self):
+        return self._ep(EXPERT_IN_WEIGHT_AXES)
+
+    def expert_out(self):
+        return self._ep(EXPERT_OUT_WEIGHT_AXES)
+
 
 def gpt_partition_rules(layout=None):
     """The in-repo GPT family's placement as ordered (regex, axes)
@@ -87,6 +110,22 @@ def gpt_partition_rules(layout=None):
         (r"\b(ln1|ln2|ln_f)\.(weight|bias)$", REPLICATED),
         (r".*", REPLICATED),
     ]
+
+
+def gpt_moe_partition_rules(layout=None):
+    """Placement for the GPTMoE family (paddle_tpu.moe): the MoE rules
+    FIRST (more specific — the gpt catch-all would otherwise eat them),
+    then the dense GPT rules for the shared attention/embedding/LN
+    parameters. Byte-identical to MoEFFN's `_tag` values (pinned by a
+    tests/test_moe.py parity test). The router gate is replicated ON
+    PURPOSE: every token routes against all experts, so the [d, E]
+    gate must be resident everywhere."""
+    lo = layout or SpecLayout()
+    return [
+        (r"\bmlp\.w_gate$", REPLICATED),
+        (r"\bmlp\.w_in$", lo.expert_in()),
+        (r"\bmlp\.w_out$", lo.expert_out()),
+    ] + gpt_partition_rules(layout)
 
 
 def parameter_spec_from_name(param_name, layout=None, rules=None):

@@ -37,7 +37,9 @@ STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
                       "cache_hits", "cache_misses", "collectives",
                       "grad_norm", "update_ratio", "nan_count",
                       "inf_count", "input_wait_ms", "input_queue_depth",
-                      "input_bound_frac", "extra")
+                      "input_bound_frac", "moe_entropy",
+                      "moe_dropped_frac", "moe_overflow", "moe_aux_loss",
+                      "moe_num_experts", "extra")
 # input-pipeline fields (io.prefetch loader health taps: how long the
 # step blocked waiting for its batch, ready-queue depth at fetch, and
 # the EMA input-bound fraction — host-bound vs chip-bound as a number)
@@ -45,6 +47,14 @@ INPUT_KEYS = ("input_wait_ms", "input_queue_depth", "input_bound_frac")
 # health-tap fields (telemetry.health numerics taps; None until a fetch
 # step lands them — they appear every k-th record when taps are on)
 HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
+# MoE routing-health fields (paddle_tpu.moe.stats; present on steps of
+# models exposing collect_moe_stats): expert-load entropy (<= log E —
+# cross-checked by tools/trace_check.py against moe_num_experts),
+# dropped-token fraction in [0, 1], capacity-overflow ratio (>= 0,
+# > 1 means some expert saw more assignments than capacity), and the
+# load-balancing aux-loss value
+MOE_KEYS = ("moe_entropy", "moe_dropped_frac", "moe_overflow",
+            "moe_aux_loss", "moe_num_experts")
 
 # required keys of a compile-event record (telemetry.compile_obs); the
 # optional attachments are hbm (memory_analysis breakdown), cost
@@ -83,7 +93,9 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      grad_norm=None, update_ratio=None, nan_count=None,
                      inf_count=None, input_wait_ms=None,
                      input_queue_depth=None, input_bound_frac=None,
-                     **extra):
+                     moe_entropy=None, moe_dropped_frac=None,
+                     moe_overflow=None, moe_aux_loss=None,
+                     moe_num_experts=None, **extra):
     """Normalize one step's measurements into the schema dict."""
     rec = {
         "schema": SCHEMA_VERSION,
@@ -125,6 +137,19 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
         rec["input_queue_depth"] = int(input_queue_depth)
     if input_bound_frac is not None:
         rec["input_bound_frac"] = round(float(input_bound_frac), 4)
+    # MoE routing-health taps (paddle_tpu.moe.stats): bounded fractions
+    # + the expert count that anchors the entropy bound — validated
+    # below and cross-checked by tools/trace_check.py
+    if moe_entropy is not None:
+        rec["moe_entropy"] = round(float(moe_entropy), 6)
+    if moe_dropped_frac is not None:
+        rec["moe_dropped_frac"] = round(float(moe_dropped_frac), 6)
+    if moe_overflow is not None:
+        rec["moe_overflow"] = round(float(moe_overflow), 6)
+    if moe_aux_loss is not None:
+        rec["moe_aux_loss"] = round(float(moe_aux_loss), 6)
+    if moe_num_experts is not None:
+        rec["moe_num_experts"] = int(moe_num_experts)
     if collectives:
         rec["collectives"] = {
             str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
@@ -636,6 +661,23 @@ def validate_step_record(rec):
                 f"'{key}' not a non-negative number: {v!r}")
         elif key == "input_bound_frac" and v > 1.0:
             problems.append(f"'input_bound_frac' above 1.0: {v!r}")
+    for key in MOE_KEYS:
+        v = rec.get(key)
+        if v is None:
+            continue
+        if key == "moe_num_experts":
+            if not isinstance(v, int) or v < 1:
+                problems.append(
+                    f"'moe_num_experts' not a positive int: {v!r}")
+            continue
+        if not isinstance(v, (int, float)) or v != v:
+            problems.append(f"'{key}' not a finite number: {v!r}")
+            continue
+        if key in ("moe_entropy", "moe_dropped_frac", "moe_overflow") \
+                and v < 0:
+            problems.append(f"'{key}' negative: {v!r}")
+        if key == "moe_dropped_frac" and v > 1.0:
+            problems.append(f"'moe_dropped_frac' above 1.0: {v!r}")
     return problems
 
 

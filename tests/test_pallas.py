@@ -111,6 +111,34 @@ def test_triangle_grid_backward_rect_blocks():
             (name, np.abs(np.asarray(a) - np.asarray(b_)).max())
 
 
+def test_triangle_grid_backward_long_context_default_blocks():
+    """Causal grads with EXPLICIT block_q=512, block_k=1024 (r = bk/bq
+    = 2): the EXACT block shape _resolve_blocks selects for the >=128k
+    long-context backward (sq > 8192 clamps bq to 512, bk stays 1024)
+    — the config GPTConfig.gpt3_1_3b_128k's local flash attention and
+    the ringattn_128k bench run on TPU. The PR-1 parity test pins only
+    bq=128/bk=512; this covers the long-context default so the r=2
+    column-major decode and its dq flush can't regress unobserved
+    (ADVICE.md r5 debt)."""
+    rs = np.random.RandomState(11)
+    b, s, n, h = 1, 2048, 2, 64    # 4 q-blocks x 2 k-blocks at r=2
+    q = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    k = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+    v = jnp.asarray(rs.randn(b, s, n, h), jnp.float32) * 0.3
+
+    out = flash_attention_fwd(q, k, v, True, None, 512, 1024)
+    ref = _ref(q, k, v, True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention_fwd(
+        *a, True, None, 512, 1024) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_ref(*a, True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g1, g2):
+        assert np.allclose(np.asarray(a), np.asarray(b_), atol=5e-4), \
+            (name, np.abs(np.asarray(a) - np.asarray(b_)).max())
+
+
 def test_fused_add_layer_norm_matches_composed():
     """Pallas fused residual+LN (interpret on CPU via the composed-path
     equivalence + direct kernel run) matches LN(x+res) fwd and grads."""

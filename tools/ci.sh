@@ -54,6 +54,12 @@ echo "== [3/9] graph doctor + framework lint =="
 # the one developers run locally
 JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt \
     --report /tmp/graphdoctor_ci.json
+# the MoE family (paddle_tpu/moe): the routed gpt_moe step must trace
+# clean through the same battery over a dp x mp x ep mesh, including
+# SH208 rule coverage of the expert partition rules (selfcheck already
+# demonstrated above — skip repeating it)
+JAX_PLATFORMS=cpu python tools/graphdoctor.py --model gpt_moe \
+    --no-selfcheck
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 # auto-sharding planner gate (tools/autoshard.py), same two-sided
 # pattern: the checked-in infeasible specimen (HBM budget too small,
@@ -67,9 +73,11 @@ JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 echo "== [4/9] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
-#   a) the CPU smoke-bench telemetry (GPT + ResNet phases) must come
-#      back clean — a recorded phase error or non-finite metric fails
-#      the build;
+#   a) the CPU smoke-bench telemetry (GPT + ResNet phases, plus the
+#      PR-11 moe_train MoE train phase and ringattn_128k long-context
+#      attention phase — their moe_*/ringattn_* typed records gate
+#      against the seeded baseline rows below) must come back clean —
+#      a recorded phase error or non-finite metric fails the build;
 #   b) the checked-in broken specimen must trip EVERY anomaly family
 #      (NaN step, loss spike, grad explosion, step-time regression) —
 #      proof the watcher can still see what it gates on (the

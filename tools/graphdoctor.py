@@ -82,7 +82,28 @@ def build_resnet():
     return model, loss_fn, optimizer, (x, y)
 
 
-_BUILDERS = {"gpt": build_gpt, "resnet": build_resnet}
+def build_gpt_moe():
+    """Tiny in-repo GPTMoE pretraining step (paddle_tpu.moe): routed
+    expert FFNs + aux/z losses in the traced step. Linted over a
+    dp x mp x ep mesh (run_config) with SH208 coverage of the expert
+    partition rules."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.moe import GPTMoE, gpt_moe_tiny_config
+    from paddle_tpu import optimizer as opt
+
+    paddle.seed(0)
+    model = GPTMoE(gpt_moe_tiny_config())
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    loss_fn = model.loss
+    ids = paddle.to_tensor(np.zeros((2, 32), np.int32))
+    labels = paddle.to_tensor(np.zeros((2, 32), np.int32))
+    return model, loss_fn, optimizer, (ids, labels)
+
+
+_BUILDERS = {"gpt": build_gpt, "resnet": build_resnet,
+             "gpt_moe": build_gpt_moe}
 
 
 def run_config(model_name, zero_stage=1):
@@ -113,14 +134,26 @@ def run_config(model_name, zero_stage=1):
         1 for _ in _count_eqns(closed.jaxpr))
 
     # -- 2. sharding lint + HBM projection over a dp x mp mesh ----------
+    # (dp x mp x ep for the MoE family, so the expert tags are vetted
+    # over a real ep axis)
     n_dev = len(jax.devices())
-    mp = 4 if n_dev >= 8 else max(1, n_dev // 2)
-    dp = max(1, n_dev // mp)
-    mesh = env.build_mesh(dp=dp, mp=mp)
+    if model_name == "gpt_moe" and n_dev >= 8:
+        dp, mp, ep = 2, 2, 2
+    else:
+        mp = 4 if n_dev >= 8 else max(1, n_dev // 2)
+        dp, ep = max(1, n_dev // mp), 1
+    mesh = env.build_mesh(dp=dp, mp=mp, ep=ep)
     try:
         named = list(model.named_parameters())
         findings += sharding_lint.lint_model_sharding(
             named, mesh, zero_stage=zero_stage)
+        if model_name == "gpt_moe":
+            # SH208 rule coverage over the MoE partition-rule set: the
+            # expert params must be placed by a rule (not the silent
+            # fall-through) and no rule may be dead
+            from paddle_tpu.planner.rules import gpt_moe_partition_rules
+            findings += sharding_lint.lint_partition_rules(
+                gpt_moe_partition_rules(), named, mesh)
         hbm, hbm_findings = sharding_lint.project_hbm(
             named, mesh, zero_stage=zero_stage)
         findings += hbm_findings

@@ -58,6 +58,7 @@ def check_metrics_jsonl(path):
     problems += check_bench_records(records, path)
     problems += check_plan_records(records, path)
     problems += check_elastic_records(records, path)
+    problems += check_moe_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -357,6 +358,47 @@ def check_elastic_records(records, path):
                 problems.append(
                     f"{path}:{i + 1}: reshard_restore references step "
                     f"{step} that no ckpt commit in this ledger landed")
+    return problems
+
+
+def check_moe_records(records, path):
+    """Cross-record rules for MoE routing-health fields on step records
+    (paddle_tpu.moe.stats; per-record bounds — dropped_frac in [0, 1],
+    non-negativity — live in sink.validate_step_record):
+
+    - moe_entropy must not exceed log(moe_num_experts): the expert-load
+      entropy of an E-way categorical is bounded by log E, so a record
+      above the bound means the producer's expert count and its entropy
+      came from different distributions (or the ledger was doctored);
+    - a record carrying any moe_* health field must also carry
+      moe_num_experts — an entropy with no expert count can never be
+      bounds-checked, which defeats the point of recording it.
+    """
+    import math
+
+    problems = []
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "step":
+            continue
+        has_moe = any(rec.get(k) is not None
+                      for k in ("moe_entropy", "moe_dropped_frac",
+                                "moe_overflow", "moe_aux_loss"))
+        if not has_moe:
+            continue
+        n_exp = rec.get("moe_num_experts")
+        if not isinstance(n_exp, int) or n_exp < 1:
+            problems.append(
+                f"{path}:{i + 1}: step record carries moe.* health "
+                "fields but no moe_num_experts — the entropy bound "
+                "cannot be checked")
+            continue
+        ent = rec.get("moe_entropy")
+        bound = math.log(n_exp)
+        if isinstance(ent, (int, float)) and ent > bound + 1e-6:
+            problems.append(
+                f"{path}:{i + 1}: moe_entropy {ent} exceeds "
+                f"log(num_experts={n_exp}) = {bound:.6f} — the "
+                "expert-load distribution and the expert count disagree")
     return problems
 
 
