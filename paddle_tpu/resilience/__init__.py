@@ -55,14 +55,14 @@ from .reshard import (  # noqa: F401
     stored_layout)
 from .retry import (  # noqa: F401
     RetryBudget, RetryError, RetryPolicy, classify_failure, is_transient,
-    retrying, with_retry)
+    retrying, tag_transient, with_retry)
 
 __all__ = [
     "CheckpointManager", "RunState", "CheckpointError",
     "CheckpointCorruptError", "build_manifest", "load_manifest",
     "verify_checkpoint", "checkpoint_bytes",
     "RetryPolicy", "RetryBudget", "RetryError", "with_retry", "retrying",
-    "is_transient", "classify_failure",
+    "is_transient", "classify_failure", "tag_transient",
     "RESUMABLE_EXIT_CODE", "PreemptionHandler", "ResilienceManager",
     "as_resilience",
     "reshard_restore", "normalize_layout", "layout_from_mesh",

@@ -33,7 +33,8 @@ import threading
 import time
 
 __all__ = ["RetryPolicy", "RetryBudget", "RetryError", "with_retry",
-           "retrying", "is_transient", "classify_failure"]
+           "retrying", "is_transient", "classify_failure",
+           "tag_transient"]
 
 # errno values worth retrying: transient kernel/FS/network conditions.
 # Deliberately NOT here: ENOSPC/EDQUOT (disk full stays full), EACCES/
@@ -90,6 +91,17 @@ def is_transient(exc):
     if type(exc).__name__ == "TimeoutExpired":
         return True
     return False
+
+
+def tag_transient(exc, transient=True):
+    """Stamp the explicit `.transient` tag on an exception and return
+    it. The tag OVERRIDES type-based classification in `is_transient` /
+    `classify_failure` — it is how the chaos monkey, the collective
+    deadline guard, and the serving drill's injected step faults tell
+    the retry/restart machinery "this one is weather" (or, with
+    transient=False, "fail loudly now")."""
+    exc.transient = bool(transient)
+    return exc
 
 
 def classify_failure(exc):

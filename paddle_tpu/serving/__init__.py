@@ -17,22 +17,38 @@ per request.
   .from_inference_config` routes the `paddle_tpu.inference.Config`
   compat switches (device, memory pool, precision) into real engine
   behavior.
+- `resilience` — the failure story: per-request server-side deadlines
+  (queue-wait/TTFT/total, reaped at step boundaries), per-class
+  priorities over a bounded waiting queue, SLO-aware load shedding
+  (queue depth x measured TPOT -> 429 + Retry-After up front), typed
+  terminal errors, and the warm-restart backoff schedule. Exercised by
+  `tools/serving_drill.py` (overload + disconnects + injected step
+  fault, leak-checked via `BlockPool.assert_quiesced`).
 - `http` — stdlib streaming HTTP front (`POST /generate`, `/metrics`,
-  `/healthz`), riding the PR-3 MetricsServer pattern.
+  `/healthz` readiness + `/livez` liveness), riding the PR-3
+  MetricsServer pattern; detects client disconnects and cancels the
+  abandoned request.
 
 Benchmarked by `bench_serving.py` (offered-load sweep -> typed
 kind=bench `serving.*` records gated by tools/bench_gate.py); smoked in
 CI by `tools/serving_smoke.py` (token parity with run_generate +
 eviction selfcheck).
 """
-from .kv_cache import BlockPool, PagedKVCache  # noqa: F401
+from .kv_cache import BlockLeakError, BlockPool, PagedKVCache  # noqa: F401
+from .resilience import (  # noqa: F401
+    AdmissionController, Deadlines, DeadlineExceededError,
+    EngineDeadError, EngineDrainingError, EngineStoppedError,
+    QueueFullError, RequestCancelledError, ServingError, ShedError)
 from .scheduler import (  # noqa: F401
     Request, RequestHandle, SamplingParams, Scheduler)
 from .engine import EngineConfig, ServingEngine  # noqa: F401
 from .http import ServingHTTPServer  # noqa: F401
 
 __all__ = [
-    "BlockPool", "PagedKVCache", "Request", "RequestHandle",
-    "SamplingParams", "Scheduler", "EngineConfig", "ServingEngine",
-    "ServingHTTPServer",
+    "BlockPool", "BlockLeakError", "PagedKVCache", "Request",
+    "RequestHandle", "SamplingParams", "Scheduler", "EngineConfig",
+    "ServingEngine", "ServingHTTPServer",
+    "AdmissionController", "Deadlines", "ServingError", "ShedError",
+    "QueueFullError", "EngineDrainingError", "EngineStoppedError",
+    "EngineDeadError", "RequestCancelledError", "DeadlineExceededError",
 ]

@@ -41,13 +41,23 @@ from ..core.tensor import Tensor
 from ..generation import _cast_params
 from ..jit import bind_tensors
 from ..ops.pallas_decode import paged_decode_attention
+from ..resilience.retry import classify_failure
 from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache
-from .scheduler import (PREFILL, RequestHandle, Request, SamplingParams,
-                        Scheduler)
+from .resilience import (AdmissionController, DeadlineExceededError,
+                         EngineDeadError, EngineDrainingError,
+                         EngineStoppedError, RequestCancelledError,
+                         ShedError, restart_backoff)
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, PREFILL,
+                        TERMINAL_STATES, RequestHandle, Request,
+                        SamplingParams, Scheduler)
 
 __all__ = ["EngineConfig", "ServingEngine"]
 
 _NEG_INF = -1e30
+
+import itertools as _itertools
+
+_ENGINE_IDS = _itertools.count()
 
 
 class EngineConfig:
@@ -57,7 +67,8 @@ class EngineConfig:
 
     def __init__(self, max_slots=4, block_size=16, num_blocks=None,
                  max_model_len=None, prefill_chunk=32, dtype="bfloat16",
-                 weights="native", kv_memory_mb=None, device=None):
+                 weights="native", kv_memory_mb=None, device=None,
+                 max_queue=None, max_restarts=3, restart_backoff_s=1.0):
         if weights not in ("native", "wo8"):
             raise ValueError(f"weights must be 'native' or 'wo8', "
                              f"got {weights!r}")
@@ -70,6 +81,12 @@ class EngineConfig:
         self.weights = weights
         self.kv_memory_mb = kv_memory_mb
         self.device = device
+        # resilience knobs: bounded waiting queue (None -> 16x slots),
+        # warm-restart cap + backoff base for transient step faults
+        self.max_queue = 16 * self.max_slots if max_queue is None \
+            else int(max_queue)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
 
     @classmethod
     def from_inference_config(cls, config, **overrides):
@@ -117,9 +134,11 @@ class ServingEngine:
     i.e. GPTForPretraining, quantized or not.
     """
 
-    def __init__(self, model, config=None, **overrides):
+    def __init__(self, model, config=None, sink=None, **overrides):
         self.cfg = config or EngineConfig(**overrides)
         cfg = self.cfg
+        self.engine_id = next(_ENGINE_IDS)
+        self._sink = sink
         self.model = model
         mcfg = model.config
         if cfg.weights == "wo8":
@@ -162,12 +181,24 @@ class ServingEngine:
         self._cv = threading.Condition(self._mu)
         self._thread = None
         self._stopping = False
+        self._stopped = False
+        self._draining = False
+        self._dead = False
+        self._restarts = 0              # CONSECUTIVE failed-step restarts
+        self._sleep = time.sleep        # injectable (tests pin backoff)
+        self._join_timeout_s = 30.0     # stop(): loop-join bound
+        self._stop_lock_timeout_s = 5.0  # stop(): wedged-lock bound
+        self.admission = AdmissionController(cfg.max_queue, cfg.max_slots)
+        self._counts = {"admitted": 0, "finished": 0, "failed": 0,
+                        "cancelled": 0, "expired": 0, "shed": 0}
         self._ttft_ms = []
         self._tpot_ms = []
+        self._qwait_ms = []
         self._lat_dirty = False
         self._finished = 0
         self.kv_peak_utilization = 0.0
         monitor.set_gauge("serving.kv_blocks_total", self.pool.capacity)
+        monitor.set_gauge("serving.draining", 0)
         self._update_gauges()
 
     # ------------------------------------------------------------------
@@ -377,11 +408,21 @@ class ServingEngine:
         return observed_dispatch(family, jitted, args)
 
     # ------------------------------------------------------------------
-    # submission
+    # submission / admission control
     # ------------------------------------------------------------------
-    def submit(self, prompt_ids, params=None, **kw):
+    def submit(self, prompt_ids, params=None, deadlines=None,
+               priority="normal", **kw):
         """Queue one generation; returns a RequestHandle whose
-        `.tokens()` stream yields ids as the engine emits them."""
+        `.tokens()` stream yields ids as the engine emits them.
+
+        `deadlines` (resilience.Deadlines) are server-side budgets the
+        scheduler enforces at step boundaries; `priority` orders the
+        bounded waiting queue ('interactive' | 'normal' | 'batch').
+        Raises `ShedError`/`QueueFullError` (429 + Retry-After at the
+        HTTP front) when admission control rejects the request up
+        front, `EngineDrainingError` during a graceful drain, and
+        `EngineStoppedError`/`EngineDeadError` when there is no engine
+        left to serve it."""
         params = params or SamplingParams(**kw)
         if params.seed is not None:
             base = jax.random.PRNGKey(int(params.seed))
@@ -390,26 +431,110 @@ class ServingEngine:
         else:
             from ..core.random import default_generator
             base = default_generator().split()
-        req = Request(prompt_ids, params, np.asarray(base))
+        req = Request(prompt_ids, params, np.asarray(base),
+                      deadlines=deadlines, priority=priority)
         with self._cv:
-            self.sched.submit(req)
+            if self._dead:
+                raise EngineDeadError(
+                    "engine is dead (warm-restart attempts exhausted)")
+            if self._stopping or self._stopped:
+                raise EngineStoppedError("engine is stopped")
+            if self._draining:
+                raise EngineDrainingError(
+                    "engine is draining (admission stopped)",
+                    retry_after_s=5.0)
+            self.sched.validate(req)        # client error, not load
+            try:
+                self.admission.admit_or_raise(req, self.sched.waiting)
+            except ShedError as e:
+                self._counts["shed"] += 1
+                monitor.incr("serving.shed")
+                self._record("shed", rid=req.rid,
+                             queue_depth=e.queue_depth,
+                             predicted_wait_ms=e.predicted_wait_ms,
+                             retry_after_s=e.retry_after_s,
+                             reason=type(e).reason,
+                             priority=req.priority_class)
+                raise
+            self.sched.enqueue(req)     # validated above, by design
+            self._counts["admitted"] += 1
             monitor.incr("serving.requests")
+            monitor.incr("serving.admitted")
+            self._record("admitted", rid=req.rid,
+                         queue_depth=len(self.sched.waiting),
+                         priority=req.priority_class,
+                         queue_deadline_ms=self._queue_deadline_ms(req))
             self._update_gauges()
             self._cv.notify_all()
-        return RequestHandle(req)
+        return RequestHandle(req, engine=self)
+
+    def cancel(self, req):
+        """Cancel `req` (RequestHandle.cancel lands here): finalized
+        immediately — the engine lock serializes against steps, so the
+        slot and KV blocks go back to the pool right now, and the
+        stream terminates with `RequestCancelledError`."""
+        with self._cv:
+            if req.state in TERMINAL_STATES:
+                return False
+            req.cancel_requested = True
+            self._finalize(
+                req, CANCELLED, "cancelled",
+                exc=RequestCancelledError(
+                    f"request {req.rid} cancelled after "
+                    f"{len(req.out_tokens)} token(s)"),
+                counter="serving.cancelled")
+            self._update_gauges()
+            self._cv.notify_all()
+        return True
 
     # ------------------------------------------------------------------
     # the engine loop
     # ------------------------------------------------------------------
     def step(self):
-        """One scheduler iteration: admit, at most one prefill chunk,
-        one decode batch. Returns True when any work was done."""
+        """One scheduler iteration: reap (cancellations + deadlines),
+        admit, at most one prefill chunk, one decode batch. Returns
+        True when any work was done."""
         with self._mu:
-            self.sched.admit()
+            now = time.monotonic()
+            self._reap(now)
+            admitted = self.sched.admit(now=now)
+            for req in admitted:
+                # sample only FIRST admissions (admit stamped them with
+                # this step's clock): a preempted/requeued request keeps
+                # its original admit_time, and re-appending that frozen
+                # wait would double-count it in the p50/p99 gauges
+                if req.admit_time != now:
+                    continue
+                qw = req.queue_wait_ms()
+                if qw is not None:
+                    self._qwait_ms.append(qw)
+                    del self._qwait_ms[:-2048]
+                    self._lat_dirty = True
             did = self._prefill_one()
             did = self._decode_once() or did
             self._update_gauges()
             return did
+
+    def _reap(self, now=None):
+        """Step-boundary enforcement of cancellation + server-side
+        deadlines: every reaped request releases its slot and KV
+        blocks to the pool IMMEDIATELY and its stream terminates with
+        a typed error — never a hang."""
+        for req, why in self.sched.reap(now):
+            if why == "cancelled":
+                self._finalize(
+                    req, CANCELLED, "cancelled",
+                    exc=RequestCancelledError(
+                        f"request {req.rid} cancelled after "
+                        f"{len(req.out_tokens)} token(s)"),
+                    counter="serving.cancelled")
+            else:
+                self._finalize(
+                    req, EXPIRED, "expired",
+                    exc=DeadlineExceededError(
+                        f"request {req.rid} blew its {why} deadline "
+                        f"({req.deadlines!r})", which=why),
+                    counter="serving.deadline_exceeded", reason=why)
 
     def run_until_idle(self, max_steps=None):
         n = 0
@@ -423,7 +548,12 @@ class ServingEngine:
     def start(self):
         if self._thread is not None and self._thread.is_alive():
             return self
+        if self._dead:
+            raise EngineDeadError(
+                "engine is dead (warm-restart attempts exhausted); "
+                "build a fresh ServingEngine")
         self._stopping = False
+        self._stopped = False
         self._thread = threading.Thread(
             target=self._serve_loop, name="paddle-tpu-serving-engine",
             daemon=True)
@@ -431,19 +561,125 @@ class ServingEngine:
         return self
 
     def stop(self):
-        with self._cv:
-            self._stopping = True
-            self._cv.notify_all()
+        """Stop the serve loop, then FAIL every request still queued or
+        in flight with `EngineStoppedError` — a submitter blocked on a
+        handle must get a clean error, never hang forever on a stream
+        no loop will ever feed again."""
+        # the flag is set WITHOUT the engine lock (a wedged step could
+        # hold it indefinitely; the loop re-reads the flag each
+        # iteration, and an idle loop self-wakes from its 0.1s wait) —
+        # the notify is best-effort within the bounded window
+        self._stopping = True
+        if self._mu.acquire(timeout=self._stop_lock_timeout_s):
+            try:
+                self._cv.notify_all()
+            finally:
+                self._mu.release()
         t = self._thread
+        joined = True
         if t is not None:
-            t.join(timeout=30)
+            t.join(timeout=self._join_timeout_s)
             if t.is_alive():
                 # join timed out (e.g. mid-compile): keep the reference
                 # so a later start() cannot race a SECOND loop against
                 # this one — the stale loop exits at its next _stopping
                 # check, and start() stays a no-op until it has
-                return
-            self._thread = None
+                joined = False
+            else:
+                self._thread = None
+        # the engine lock serializes against any stale loop's last
+        # step. When the join timed out that step may be WEDGED holding
+        # the lock, so only wait a bounded extra window for it — a
+        # stop() that can hang forever is worse than leaving the
+        # leftovers for a later stop() once the wedged step returns
+        if not self._mu.acquire(
+                timeout=-1 if joined else self._stop_lock_timeout_s):
+            self._stopped = True
+            return joined
+        try:
+            self._stopped = True
+            leftovers = (list(self.sched.waiting)
+                         + list(self.sched.prefilling)
+                         + [r for r in self.sched.running
+                            if r is not None])
+            for req in leftovers:
+                self._finalize(
+                    req, FAILED, "failed",
+                    error="engine stopped before the request finished",
+                    exc=EngineStoppedError(
+                        f"request {req.rid}: engine stopped before the "
+                        "request finished"),
+                    counter="serving.failed")
+            if leftovers:
+                self._update_gauges()
+        finally:
+            self._mu.release()
+        return joined
+
+    # ------------------------------------------------------------------
+    # graceful drain
+    # ------------------------------------------------------------------
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def dead(self):
+        return self._dead
+
+    def drain(self, timeout=None):
+        """Graceful drain: stop admission (submit raises
+        `EngineDrainingError`; the HTTP front answers 503-draining on
+        /healthz while /livez stays green), finish every request
+        already accepted — queued AND running — then emit the quiesce
+        record. Returns True when fully drained, False on timeout
+        (admission stays stopped either way; `resume_admission()`
+        reopens it, e.g. after a warm restart completes)."""
+        with self._cv:
+            self._draining = True
+            monitor.set_gauge("serving.draining", 1)
+            self._record("drain_begin",
+                         queue_depth=len(self.sched.waiting),
+                         running=self.sched.num_running())
+            self._cv.notify_all()
+        t0 = time.monotonic()
+        loop_alive = self._thread is not None and self._thread.is_alive()
+        if loop_alive:
+            while True:
+                with self._cv:
+                    if not self.sched.has_work() or self._dead:
+                        break
+                    self._cv.wait(timeout=0.05)
+                if timeout is not None and \
+                        time.monotonic() - t0 > timeout:
+                    self._record("drain_end", completed=False,
+                                 drained_ms=(time.monotonic() - t0)
+                                 * 1000.0)
+                    return False
+        else:
+            self.run_until_idle()
+        completed = not self.sched.has_work()
+        self._record("drain_end", completed=bool(completed),
+                     drained_ms=(time.monotonic() - t0) * 1000.0)
+        self.emit_quiesce()
+        return completed
+
+    def resume_admission(self):
+        """Reopen admission after a drain (warm-restart complete)."""
+        with self._cv:
+            self._draining = False
+            monitor.set_gauge("serving.draining", 0)
+            self._cv.notify_all()
+
+    def emit_quiesce(self):
+        """Emit the kind=serving quiesce record: the request-accounting
+        ledger (admitted must equal finished+failed+cancelled+expired —
+        tools/trace_check.py enforces it) plus the pool's allocation
+        count (must be zero — a leak here is a dropped request)."""
+        with self._mu:
+            self._record("quiesce", kv_blocks_used=self.pool.num_used,
+                         queue_depth=len(self.sched.waiting),
+                         counts=dict(self._counts))
 
     def _serve_loop(self):
         while True:
@@ -457,41 +693,99 @@ class ServingEngine:
                 did = self.step()
             except Exception as e:      # noqa: BLE001 — long-lived loop
                 # a dead serve thread strands every open stream forever;
-                # fail the in-flight requests LOUDLY and keep serving
-                self._on_step_error(e)
+                # classify the failure and warm-restart (transient) or
+                # fail the in-flight work loudly (permanent)
+                alive, backoff = self._on_step_error(e)
+                if not alive:
+                    return
+                if backoff:
+                    self._sleep(backoff)
                 continue
+            self._restarts = 0          # a completed step resets the cap
+            with self._cv:
+                self._cv.notify_all()   # wake drain()/result() waiters
             if not did:
                 # work exists but none runnable (prefill waiting on
                 # blocks): don't spin the lock hot
                 time.sleep(0.002)
 
+    def _rebuild_arenas(self):
+        """Fresh pool + fresh K/V arenas: after a failed step the
+        donated buffers are suspect, and every surviving request holds
+        zero blocks by construction (failed or requeued)."""
+        self.pool = BlockPool(self.pool.num_blocks)
+        self.sched.pool = self.pool
+        with jax.default_device(self.cfg.device) \
+                if self.cfg.device is not None \
+                else contextlib.nullcontext():
+            self.cache = PagedKVCache(
+                self.cache.num_layers, self.cache.num_blocks,
+                self.cache.block_size, self.cache.hidden,
+                dtype=self.cache.dtype)
+
     def _on_step_error(self, exc):
         """A compiled step raised mid-flight (device OOM, runtime
         error): the in-flight requests' KV state — and, under donation,
-        the arenas themselves — are suspect. Fail every ACTIVE request
-        with the error (their streams raise instead of hanging), rebuild
-        the arenas/pool clean, and leave the queued (not-yet-started)
-        requests to be served fresh. Manual step() callers see the
-        exception raw — this path is the background loop's."""
+        the arenas themselves — are suspect. Rides
+        `resilience.retry.classify_failure`:
+
+        - PERMANENT (a programming error): recompute-replay would hit
+          the identical bug, so fail every ACTIVE request with the
+          error (their streams raise instead of hanging), rebuild the
+          arenas clean, and keep serving the queued requests;
+        - TRANSIENT / INFRA: warm restart — rebuild the arenas and
+          REQUEUE the in-flight requests for recompute-replay (the
+          eviction invariant guarantees their streams replay
+          token-identically), with bounded attempts + backoff; past
+          `max_restarts` consecutive failures the engine declares
+          itself DEAD and fails everything outstanding.
+
+        Returns (keep_serving, backoff_s). Manual step() callers see
+        the exception raw — this path is the background loop's."""
         import traceback
         monitor.incr("serving.engine_errors")
         msg = f"{type(exc).__name__}: {exc}"
+        kind = classify_failure(exc)
         traceback.print_exc()
         with self._mu:
-            active = list(self.sched.prefilling) + [
-                r for r in self.sched.running if r is not None]
-            for req in active:
-                self.sched.finish(req, error=msg)
-            self.pool = BlockPool(self.pool.num_blocks)
-            self.sched.pool = self.pool
-            with jax.default_device(self.cfg.device) \
-                    if self.cfg.device is not None \
-                    else contextlib.nullcontext():
-                self.cache = PagedKVCache(
-                    self.cache.num_layers, self.cache.num_blocks,
-                    self.cache.block_size, self.cache.hidden,
-                    dtype=self.cache.dtype)
+            active = [r for r in self.sched.admit_order
+                      if r.state not in TERMINAL_STATES]
+            if kind == "permanent":
+                for req in active:
+                    self._finalize(req, FAILED, "failed", error=msg,
+                                   counter="serving.failed")
+                self._rebuild_arenas()
+                self._update_gauges()
+                with self._cv:
+                    self._cv.notify_all()
+                return True, 0.0
+            self._restarts += 1
+            attempt = self._restarts
+            if attempt > self.cfg.max_restarts:
+                self._dead = True
+                monitor.set_gauge("serving.engine_dead", 1)
+                doomed = active + list(self.sched.waiting)
+                for req in doomed:
+                    err = (f"engine dead after {attempt - 1} warm-"
+                           f"restart attempt(s); last failure: {msg}")
+                    self._finalize(req, FAILED, "failed", error=err,
+                                   exc=EngineDeadError(
+                                       f"request {req.rid}: {err}"),
+                                   counter="serving.failed")
+                self._update_gauges()
+                with self._cv:
+                    self._cv.notify_all()
+                return False, 0.0
+            monitor.incr("serving.restarts")
+            # requeue oldest-first so the waiting FRONT preserves the
+            # original admission order for the replay
+            for req in reversed(active):
+                self.sched.requeue(req)
+            self._rebuild_arenas()
+            self._record("restart", attempt=attempt, reason=kind,
+                         error=msg, requeued=len(active))
             self._update_gauges()
+        return True, restart_backoff(attempt, self.cfg.restart_backoff_s)
 
     def __enter__(self):
         return self.start()
@@ -621,21 +915,54 @@ class ServingEngine:
         row[:len(req.blocks)] = req.blocks
         return row
 
+    def _queue_deadline_ms(self, req):
+        d = req.deadlines
+        if d is None or d.queue_wait_s is None:
+            return None
+        return d.queue_wait_s * 1000.0
+
+    def _record(self, event, **fields):
+        """Emit one kind=serving lifecycle record to the attached sink
+        (no-op without one); counters/gauges are updated by the callers
+        regardless, so telemetry is optional but never partial."""
+        if self._sink is None:
+            return
+        from ..telemetry.sink import make_serving_record
+        self._sink.write(make_serving_record(
+            event, engine=self.engine_id, **fields))
+
+    def _finalize(self, req, status, event, error=None, exc=None,
+                  counter=None, **fields):
+        """The single terminal transition: release slot + blocks via
+        the scheduler, account the outcome, emit the typed record.
+        Idempotent (a cancel racing a natural finish is a no-op)."""
+        if req.state in TERMINAL_STATES:
+            return
+        self.sched.finish(req, error=error, status=status, failure=exc)
+        self._counts[event] += 1
+        if counter is not None:
+            monitor.incr(counter)
+        self._record(event, rid=req.rid, n_tokens=len(req.out_tokens),
+                     queue_wait_ms=req.queue_wait_ms(),
+                     queue_deadline_ms=self._queue_deadline_ms(req),
+                     priority=req.priority_class, error=error, **fields)
+
     def _emit(self, req, tok, logp, now=None):
         req.push_token(tok, now=now)
         monitor.incr("serving.tokens_generated")
         if req.done:
-            self.sched.finish(req)
             self._finished += 1
             monitor.incr("serving.finished")
             t = req.ttft_ms()
             if t is not None:
                 self._ttft_ms.append(t)
                 del self._ttft_ms[:-2048]
+            self._finalize(req, FINISHED, "finished")
             t = req.tpot_ms()
             if t is not None:
                 self._tpot_ms.append(t)
                 del self._tpot_ms[:-2048]
+                self.admission.note_tpot_ms(t)  # feeds shed prediction
             self._lat_dirty = True
 
     def _update_gauges(self):
@@ -648,12 +975,17 @@ class ServingEngine:
         self.kv_peak_utilization = max(self.kv_peak_utilization, util)
         if self._lat_dirty:      # percentiles only when a request landed
             self._lat_dirty = False
-            for name, vals in (("ttft", self._ttft_ms),
-                               ("tpot", self._tpot_ms)):
+            for p50_name, p99_name, vals in (
+                    ("serving.ttft_p50_ms", "serving.ttft_p99_ms",
+                     self._ttft_ms),
+                    ("serving.tpot_p50_ms", "serving.tpot_p99_ms",
+                     self._tpot_ms),
+                    ("serving.queue_wait_ms_p50",
+                     "serving.queue_wait_ms_p99", self._qwait_ms)):
                 if vals:
-                    monitor.set_gauge(f"serving.{name}_p50_ms",
+                    monitor.set_gauge(p50_name,
                                       float(np.percentile(vals, 50)))
-                    monitor.set_gauge(f"serving.{name}_p99_ms",
+                    monitor.set_gauge(p99_name,
                                       float(np.percentile(vals, 99)))
 
     def metrics_snapshot(self):

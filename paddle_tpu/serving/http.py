@@ -6,39 +6,69 @@ engine process is scrapeable and servable with nothing but the stdlib.
 
 - **POST /generate** — body `{"prompt": [ids...], "max_new_tokens": N,
   "decode_strategy": "greedy"|"sampling", "top_k", "top_p",
-  "temperature", "eos_token_id", "seed", "stream": bool}`.
+  "temperature", "eos_token_id", "seed", "stream": bool,
+  "priority": "interactive"|"normal"|"batch",
+  "queue_wait_deadline_s", "ttft_deadline_s", "deadline_s"}`.
   `stream=true` answers chunked `application/jsonl`: one
   `{"token": id}` line per generated token AS THE ENGINE EMITS IT
   (continuous batching means concurrent streams interleave at token
-  granularity), then a `{"done": true, "tokens": [...]}` tail.
+  granularity), then a `{"done": true, "tokens": [...]}` tail — or a
+  terminal `{"error": ..., "status": ...}` line when the request
+  failed/expired/was cancelled, so clients always see a clean end of
+  stream, never a hang or a broken chunked body.
   `stream=false` blocks and answers `{"tokens": [...]}` once.
+  Failure-mode status codes: 429 + Retry-After when admission shed the
+  request (queue full or predicted to blow its deadline), 503 +
+  Retry-After while draining, 503 when the engine is stopped/dead,
+  504 when a server-side deadline expired, 499 when the request was
+  cancelled, 500 on an engine failure.
+- **Client-disconnect detection** — a streaming client that goes away
+  mid-generation gets its request CANCELLED: the slot and KV blocks
+  return to the pool instead of decoding to max_tokens for nobody
+  (`serving.client_disconnects` counts it).
 - **GET /metrics** — Prometheus text: the whole monitor registry,
   which includes the engine's `serving.*` gauges/counters (queue
-  depth, KV-block utilization, preemptions, TTFT/TPOT p50/p99).
-- **GET /healthz** — engine liveness + the serving.* snapshot.
+  depth/wait, KV-block utilization, preemptions, shed/cancelled/
+  deadline_exceeded, TTFT/TPOT p50/p99).
+- **GET /healthz** — READINESS: engine status + the serving.*
+  snapshot; answers 503 with status "draining"/"dead" when the engine
+  is draining or dead (take it out of the load balancer).
+- **GET /livez** — LIVENESS: 200 while the process is up, even during
+  a drain (don't kill a pod for finishing its work).
 
     engine = ServingEngine(model, max_slots=8).start()
     srv = ServingHTTPServer(engine, port=8000).start()
 """
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import monitor
 from ..telemetry.metrics_http import prometheus_text
+from .resilience import (PRIORITIES, Deadlines, DeadlineExceededError,
+                         EngineDeadError, EngineDrainingError,
+                         EngineStoppedError, RequestCancelledError,
+                         ShedError)
 from .scheduler import SamplingParams
 
 __all__ = ["ServingHTTPServer"]
+
+_DISCONNECTS = (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError)
 
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle-tpu-serving/1"
     protocol_version = "HTTP/1.1"
 
-    def _send(self, code, body, ctype="application/json"):
+    def _send(self, code, body, ctype="application/json", headers=None):
         data = body.encode() if isinstance(body, str) else body
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -47,14 +77,27 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._send(200, prometheus_text(),
                        ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/livez":
+            # liveness stays green through a drain: the process is
+            # healthy, it is just finishing its work
+            self._send(200, json.dumps({"status": "alive"}))
         elif self.path in ("/", "/healthz"):
-            body = {"status": "ok",
+            status, code = "ok", 200
+            if engine.dead:
+                status, code = "dead", 503
+            elif engine.draining:
+                status, code = "draining", 503
+            body = {"status": status,
                     "serving": engine.metrics_snapshot()}
-            self._send(200, json.dumps(body, indent=2, default=repr))
+            self._send(code, json.dumps(body, indent=2, default=repr))
         else:
             self._send(404, json.dumps(
                 {"error": f"unknown path {self.path!r}",
-                 "endpoints": ["POST /generate", "/metrics", "/healthz"]}))
+                 "endpoints": ["POST /generate", "/metrics", "/healthz",
+                               "/livez"]}))
+
+    def _retry_after(self, seconds):
+        return {"Retry-After": str(max(1, int(math.ceil(seconds))))}
 
     def do_POST(self):
         if self.path != "/generate":
@@ -74,21 +117,67 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=req.get("temperature", 1.0),
                 eos_token_id=req.get("eos_token_id"),
                 seed=req.get("seed"))
+            priority = req.get("priority", "normal")
+            if priority not in PRIORITIES:       # client error: 400,
+                raise ValueError(                # not a 429 load shed
+                    f"unknown priority {priority!r} (expected one of "
+                    f"{sorted(PRIORITIES)})")
+            dl = {k: req.get(j) for k, j in
+                  (("queue_wait_s", "queue_wait_deadline_s"),
+                   ("ttft_s", "ttft_deadline_s"),
+                   ("total_s", "deadline_s"))}
+            deadlines = Deadlines(**dl) if any(
+                v is not None for v in dl.values()) else None
             stream = bool(req.get("stream", False))
         except (KeyError, ValueError, TypeError,
                 json.JSONDecodeError) as e:
             self._send(400, json.dumps({"error": str(e)}))
             return
         try:
-            handle = self.server.engine.submit([int(t) for t in prompt],
-                                               params)
+            handle = self.server.engine.submit(
+                [int(t) for t in prompt], params, deadlines=deadlines,
+                priority=priority)
+        except ShedError as e:        # load shed: come back later
+            self._send(429, json.dumps(
+                {"error": str(e), "status": "shed",
+                 "reason": type(e).reason, "queue_depth": e.queue_depth,
+                 "predicted_wait_ms": e.predicted_wait_ms}),
+                headers=self._retry_after(e.retry_after_s))
+            return
+        except EngineDrainingError as e:
+            self._send(503, json.dumps(
+                {"error": str(e), "status": "draining"}),
+                headers=self._retry_after(e.retry_after_s))
+            return
+        except (EngineStoppedError, EngineDeadError) as e:
+            self._send(503, json.dumps(
+                {"error": str(e), "status": "unavailable"}))
+            return
         except ValueError as e:       # over-length request etc.
             self._send(429, json.dumps({"error": str(e)}))
             return
         if not stream:
             try:
                 toks = handle.result(timeout=self.server.request_timeout)
+            except DeadlineExceededError as e:
+                self._send(504, json.dumps(
+                    {"error": str(e), "status": "deadline_exceeded"}))
+                return
+            except RequestCancelledError as e:
+                self._send(499, json.dumps(
+                    {"error": str(e), "status": "cancelled"}))
+                return
+            except (EngineStoppedError, EngineDeadError) as e:
+                # retryable elsewhere, same as the streaming path
+                self._send(503, json.dumps(
+                    {"error": str(e), "status": "unavailable"}))
+                return
             except Exception as e:
+                # e.g. request_timeout expired: the server is done with
+                # this request, so the engine must be too — without the
+                # cancel it would keep decoding to max_tokens with its
+                # KV blocks pinned (no-op when already terminal)
+                handle.cancel()
                 self._send(500, json.dumps({"error": str(e)}))
                 return
             self._send(200, json.dumps({"tokens": toks,
@@ -106,15 +195,44 @@ class _Handler(BaseHTTPRequestHandler):
                              + b"\r\n")
             self.wfile.flush()
 
+        def abandoned():
+            # the client went away mid-stream: without this, the
+            # request decodes to max_tokens pinning its KV blocks for
+            # nobody — cancel releases the slot + blocks immediately
+            handle.cancel()
+            monitor.incr("serving.client_disconnects")
+            self.close_connection = True
+
+        toks = []
         try:
-            toks = []
             for tok in handle.tokens(timeout=self.server.request_timeout):
                 toks.append(tok)
                 chunk({"token": tok})
-            chunk({"done": True, "tokens": toks, "stats": handle.stats})
-        except Exception as e:
-            chunk({"error": str(e)})
-        self.wfile.write(b"0\r\n\r\n")
+            final = {"done": True, "tokens": toks, "stats": handle.stats}
+        except _DISCONNECTS:
+            abandoned()
+            return
+        except DeadlineExceededError as e:
+            final = {"error": str(e), "status": "deadline_exceeded"}
+        except RequestCancelledError as e:
+            final = {"error": str(e), "status": "cancelled"}
+        except (EngineStoppedError, EngineDeadError) as e:
+            final = {"error": str(e), "status": "unavailable"}
+        except Exception as e:        # engine failure / server timeout
+            # if the request is still live (request_timeout is the
+            # usual case), release its slot + KV blocks now — the
+            # server has stopped consuming this stream for good
+            handle.cancel()
+            final = {"error": str(e), "status": "failed"}
+        # terminate the JSONL stream with the final event + the chunked
+        # epilogue even on failure — a truncated chunked body looks like
+        # an infrastructure fault to the client instead of a clean error
+        try:
+            chunk(final)
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except _DISCONNECTS + (OSError,):
+            abandoned()
 
     def log_message(self, fmt, *args):
         pass

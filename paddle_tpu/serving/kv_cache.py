@@ -29,7 +29,13 @@ The attention over this layout is `ops.pallas_decode.paged_decode_attention`.
 """
 import jax.numpy as jnp
 
-__all__ = ["BlockPool", "PagedKVCache", "NULL_BLOCK"]
+__all__ = ["BlockPool", "BlockLeakError", "PagedKVCache", "NULL_BLOCK"]
+
+
+class BlockLeakError(AssertionError):
+    """`BlockPool.assert_quiesced` found blocks still allocated: some
+    path (cancel, deadline expiry, eviction, engine restart, finish)
+    dropped a request without returning its blocks to the pool."""
 
 # physical block 0 is never allocated: it is the write target for
 # padded batch slots and masked prefill tails (their values are
@@ -100,6 +106,23 @@ class BlockPool:
 
     def owner_of(self, block):
         return self._owner.get(block)
+
+    def assert_quiesced(self):
+        """Every block must be back in the free list — the leak check
+        a quiesced engine (all requests terminal) runs at drain end,
+        at drill quiesce, and at test teardown. Raises `BlockLeakError`
+        naming each leaked block's owner."""
+        if not self.num_used:
+            return
+        by_owner = {}
+        for b, owner in self._owner.items():
+            by_owner.setdefault(owner, []).append(b)
+        detail = "; ".join(
+            f"owner {owner!r} holds blocks {sorted(blocks)}"
+            for owner, blocks in sorted(by_owner.items(), key=str))
+        raise BlockLeakError(
+            f"{self.num_used} KV block(s) still allocated at quiesce: "
+            f"{detail}")
 
 
 class PagedKVCache:

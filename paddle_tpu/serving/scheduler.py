@@ -25,15 +25,23 @@ import time
 import numpy as np
 
 from .kv_cache import BlockPool, PagedKVCache
+from .resilience import PRIORITIES, expired_reason
 
 __all__ = ["SamplingParams", "Request", "RequestHandle", "Scheduler",
-           "WAITING", "PREFILL", "RUNNING", "FINISHED", "FAILED"]
+           "WAITING", "PREFILL", "RUNNING", "FINISHED", "FAILED",
+           "CANCELLED", "EXPIRED"]
 
 WAITING = "waiting"
 PREFILL = "prefill"
 RUNNING = "running"
 FINISHED = "finished"
 FAILED = "failed"
+CANCELLED = "cancelled"
+EXPIRED = "expired"
+
+# a request in any of these states has released its slot + blocks and
+# closed its stream; nothing may finalize it again
+TERMINAL_STATES = (FINISHED, FAILED, CANCELLED, EXPIRED)
 
 _SENTINEL = object()
 
@@ -75,7 +83,8 @@ class Request:
 
     _ids = itertools.count()
 
-    def __init__(self, prompt_ids, params, rng_key, submit_time=None):
+    def __init__(self, prompt_ids, params, rng_key, submit_time=None,
+                 deadlines=None, priority="normal"):
         self.rid = next(Request._ids)
         self.prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not self.prompt:
@@ -89,8 +98,22 @@ class Request:
         self.slot = None                    # decode batch slot, when RUNNING
         self.preemptions = 0
         self.error = None
+        self.failure = None                 # typed exception for the stream
+        self.deadlines = deadlines          # resilience.Deadlines or None
+        if isinstance(priority, str):
+            if priority not in PRIORITIES:
+                raise ValueError(
+                    f"unknown priority {priority!r} (expected one of "
+                    f"{sorted(PRIORITIES)})")
+            self.priority_class = priority
+            self.priority = PRIORITIES[priority]
+        else:
+            self.priority = int(priority)
+            self.priority_class = str(priority)
+        self.cancel_requested = False
         self.submit_time = submit_time if submit_time is not None \
             else time.monotonic()
+        self.admit_time = None              # first admission out of the queue
         self.first_token_time = None
         self.finish_time = None
         self._stream = queue.Queue()
@@ -127,6 +150,13 @@ class Request:
         self._stream.put(_SENTINEL)
 
     # -- latency ------------------------------------------------------------
+    def queue_wait_ms(self):
+        """Time spent in the waiting queue before first admission; None
+        until admitted (a shed or queue-expired request never was)."""
+        if self.admit_time is None:
+            return None
+        return (self.admit_time - self.submit_time) * 1000.0
+
     def ttft_ms(self):
         if self.first_token_time is None:
             return None
@@ -143,14 +173,33 @@ class Request:
 
 class RequestHandle:
     """Client-side view of a submitted request: a blocking token stream
-    plus a gather-all result."""
+    plus a gather-all result, and `cancel()` to give the slot back."""
 
-    def __init__(self, request):
+    def __init__(self, request, engine=None):
         self._req = request
+        self._engine = engine
 
     @property
     def rid(self):
         return self._req.rid
+
+    def cancel(self):
+        """Cancel the request: its slot and KV blocks are released
+        immediately (the engine finalizes between steps) and the stream
+        terminates with `RequestCancelledError`. Returns True when the
+        cancel landed, False when the request was already terminal."""
+        if self._engine is not None:
+            return self._engine.cancel(self._req)
+        # no engine attached (direct construction): mark the flag; a
+        # scheduler reap at the next step boundary picks it up
+        if self._req.state in TERMINAL_STATES:
+            return False
+        self._req.cancel_requested = True
+        return True
+
+    @property
+    def status(self):
+        return self._req.state
 
     def tokens(self, timeout=None):
         """Yield generated token ids as the engine streams them.
@@ -168,6 +217,10 @@ class RequestHandle:
                     f"{timeout}s (got {len(self._req.out_tokens)} so "
                     "far)") from None
             if tok is _SENTINEL:
+                if self._req.failure is not None:
+                    # typed terminal: cancelled / expired / engine
+                    # stopped / engine dead — all RuntimeError subtypes
+                    raise self._req.failure
                 if self._req.error is not None:
                     raise RuntimeError(
                         f"request {self._req.rid} failed: {self._req.error}")
@@ -181,7 +234,7 @@ class RequestHandle:
 
     @property
     def finished(self):
-        return self._req.state in (FINISHED, FAILED)
+        return self._req.state in TERMINAL_STATES
 
     @property
     def output_tokens(self):
@@ -191,6 +244,7 @@ class RequestHandle:
     def stats(self):
         r = self._req
         return {"ttft_ms": r.ttft_ms(), "tpot_ms": r.tpot_ms(),
+                "queue_wait_ms": r.queue_wait_ms(),
                 "preemptions": r.preemptions,
                 "n_tokens": len(r.out_tokens), "state": r.state}
 
@@ -205,7 +259,11 @@ class Scheduler:
     - a PREFILL request holds blocks for positions < n_prefilled plus
       whatever the next chunk needs, but no slot until prefill is done;
     - preemption frees ALL of a victim's blocks and re-queues it at the
-      FRONT of the waiting line (it already paid for its progress once).
+      FRONT of the waiting line (it already paid for its progress once);
+    - the waiting queue is ordered by priority class (FIFO within a
+      class); a TERMINAL request (finished/failed/cancelled/expired)
+      holds no slot and no blocks — every terminal transition goes
+      through `finish`, which releases both.
     """
 
     def __init__(self, pool, block_size, max_slots, max_model_len):
@@ -213,7 +271,7 @@ class Scheduler:
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
         self.max_model_len = int(max_model_len)
-        self.waiting = []                  # FIFO; preempted go to front
+        self.waiting = []                  # by class, FIFO within a class
         self.prefilling = []               # admitted, mid-prefill
         self.running = [None] * self.max_slots
         self.admit_order = []              # running/prefilling, oldest first
@@ -231,7 +289,9 @@ class Scheduler:
                     or self.num_running())
 
     # -- admission ----------------------------------------------------------
-    def submit(self, request):
+    def validate(self, request):
+        """Reject requests that could NEVER be served at these shapes
+        (client error, not load): too many positions, too many blocks."""
         if request.total_len > self.max_model_len:
             raise ValueError(
                 f"request needs {request.total_len} positions "
@@ -242,9 +302,23 @@ class Scheduler:
             raise ValueError(
                 f"request needs {request.max_blocks_needed(self.block_size)}"
                 f" KV blocks > pool capacity {self.pool.capacity}")
-        self.waiting.append(request)
 
-    def admit(self):
+    def submit(self, request):
+        self.validate(request)
+        self.enqueue(request)
+
+    def enqueue(self, request):
+        """Queue an ALREADY-VALIDATED request at the back of its
+        priority class: after every request of the same-or-more-urgent
+        class, before less urgent ones (the engine validates before
+        admission control so a malformed request is a client error,
+        never a shed — then enqueues without re-validating)."""
+        idx = len(self.waiting)
+        while idx > 0 and self.waiting[idx - 1].priority > request.priority:
+            idx -= 1
+        self.waiting.insert(idx, request)
+
+    def admit(self, now=None):
         """Move waiting requests into prefill while a slot could
         eventually take them: admission is bounded by slots (running +
         prefilling) so the prefill pipeline never overfills the batch."""
@@ -255,10 +329,34 @@ class Scheduler:
             req.state = PREFILL
             req.n_prefilled = 0
             req.blocks = []
+            if req.admit_time is None:      # requeues keep the first
+                req.admit_time = now if now is not None \
+                    else time.monotonic()
             self.prefilling.append(req)
             self.admit_order.append(req)
             admitted.append(req)
         return admitted
+
+    # -- step-boundary enforcement ------------------------------------------
+    def reap(self, now=None):
+        """Collect requests the engine must finalize at this step
+        boundary: cancelled ones and deadline-blown ones. Returns
+        [(request, why)] with why in ('cancelled', 'queue_wait',
+        'ttft', 'total'); the caller finalizes (this method only
+        observes, so the engine owns the record/counter emission)."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for req in (list(self.waiting) + list(self.prefilling)
+                    + [r for r in self.running if r is not None]):
+            if req.state in TERMINAL_STATES:
+                continue
+            if req.cancel_requested:
+                out.append((req, "cancelled"))
+                continue
+            why = expired_reason(req, now)
+            if why is not None:
+                out.append((req, why))
+        return out
 
     # -- block growth + preemption ------------------------------------------
     def ensure_blocks(self, req, n_positions, evict=True):
@@ -296,11 +394,11 @@ class Scheduler:
                 return req
         return None
 
-    def preempt(self, req):
-        """Evict-by-recompute: free every block, drop the slot, requeue
-        at the FRONT. Streamed tokens are kept (they are already on the
-        wire); re-prefill recomputes their K/V."""
-        from .. import monitor
+    def _release(self, req):
+        """Give back everything `req` holds: blocks, slot, pipeline
+        membership. The single reclaim point — finish, preemption, and
+        warm-restart requeue all go through it, which is what makes
+        `BlockPool.assert_quiesced` a meaningful invariant."""
         if req.blocks:
             self.pool.free(req.blocks)
             req.blocks = []
@@ -311,12 +409,32 @@ class Scheduler:
             self.prefilling.remove(req)
         if req in self.admit_order:
             self.admit_order.remove(req)
+
+    def requeue(self, req):
+        """Release blocks/slot and put `req` back at the waiting FRONT
+        of its priority class for recompute-replay (streamed tokens are
+        kept — they are already on the wire — and re-prefill recomputes
+        their K/V, so the stream replays identically). No preemption
+        accounting: engine warm restarts ride this after a transient
+        step fault."""
+        if req in self.waiting:
+            return
+        self._release(req)
         req.n_prefilled = 0
         req.state = WAITING
+        idx = 0
+        while idx < len(self.waiting) and \
+                self.waiting[idx].priority < req.priority:
+            idx += 1
+        self.waiting.insert(idx, req)
+
+    def preempt(self, req):
+        """Evict-by-recompute: `requeue` plus the preemption ledger."""
+        from .. import monitor
+        self.requeue(req)
         req.preemptions += 1
         self.preemptions += 1
         monitor.incr("serving.preemptions")
-        self.waiting.insert(0, req)
 
     def place(self, req):
         """Prefill complete -> take a decode slot."""
@@ -327,19 +445,19 @@ class Scheduler:
         self.prefilling.remove(req)
         return slot
 
-    def finish(self, req, error=None):
-        """Reclaim everything; close the stream."""
-        if req.blocks:
-            self.pool.free(req.blocks)
-            req.blocks = []
-        if req.slot is not None:
-            self.running[req.slot] = None
-            req.slot = None
-        if req in self.prefilling:
-            self.prefilling.remove(req)
-        if req in self.admit_order:
-            self.admit_order.remove(req)
+    def finish(self, req, error=None, status=None, failure=None):
+        """Reclaim everything; close the stream. `status` is the
+        terminal state (default FAILED when an error is given, else
+        FINISHED); `failure` is the typed exception the stream raises
+        (cancelled/expired/engine-stopped...)."""
+        if req.state in TERMINAL_STATES:
+            return
+        if req in self.waiting:
+            self.waiting.remove(req)
+        self._release(req)
         req.error = error
-        req.state = FAILED if error is not None else FINISHED
+        req.failure = failure
+        req.state = status if status is not None \
+            else (FAILED if error is not None else FINISHED)
         req.finish_time = time.monotonic()
         req.close_stream()

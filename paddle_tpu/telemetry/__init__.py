@@ -52,14 +52,15 @@ from .recorder import (  # noqa: F401
     span)
 from .sink import (  # noqa: F401
     JsonlSink, export_chrome_tracing, make_bench_record, make_ckpt_record,
-    make_phase_record, make_step_record, read_jsonl, validate_step_record)
+    make_phase_record, make_serving_record, make_step_record, read_jsonl,
+    validate_step_record)
 from .watchdog import HangWatchdog, dump_black_box  # noqa: F401
 
 __all__ = [
     "TelemetryRecorder", "StepTimer", "span", "auto_step",
     "current_recorder", "open_spans", "JsonlSink", "read_jsonl",
     "make_step_record", "make_phase_record", "make_ckpt_record",
-    "make_bench_record",
+    "make_bench_record", "make_serving_record",
     "validate_step_record", "export_chrome_tracing",
     "device_peak_flops", "model_flops_per_token", "train_step_flops",
     "HealthConfig", "HealthMonitor", "HealthError", "Anomaly",

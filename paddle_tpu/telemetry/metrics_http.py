@@ -180,6 +180,26 @@ class MetricsServer:
                 "last_step": snap.get("ckpt.last_step"),
                 "last_save_ms": snap.get("ckpt.save_ms"),
             },
+            # serving resilience (paddle_tpu.serving): is the engine
+            # shedding/expiring/cancelling under load, is it draining,
+            # and has it had to warm-restart after step faults
+            "serving": {
+                "queue_depth": snap.get("serving.queue_depth"),
+                "running": snap.get("serving.running"),
+                "admitted": snap.get("serving.admitted", 0),
+                "shed": snap.get("serving.shed", 0),
+                "cancelled": snap.get("serving.cancelled", 0),
+                "deadline_exceeded": snap.get(
+                    "serving.deadline_exceeded", 0),
+                "client_disconnects": snap.get(
+                    "serving.client_disconnects", 0),
+                "queue_wait_ms_p99": snap.get(
+                    "serving.queue_wait_ms_p99"),
+                "engine_errors": snap.get("serving.engine_errors", 0),
+                "restarts": snap.get("serving.restarts", 0),
+                "draining": snap.get("serving.draining", 0),
+                "engine_dead": snap.get("serving.engine_dead", 0),
+            },
             # elastic mesh resilience (distributed.elastic +
             # resilience.reshard): has the failure detector fired, and
             # did any resume cross a layout change

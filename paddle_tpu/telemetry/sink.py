@@ -86,6 +86,26 @@ ELASTIC_RECORD_KEYS = ("schema", "kind", "rank", "event")
 ELASTIC_EVENTS = ("heartbeat_miss", "declared_dead", "replan",
                   "reshard_restore", "relaunch")
 
+# required keys of a serving-lifecycle record (paddle_tpu.serving
+# ServingEngine); optional: rid, engine, queue_depth, queue_wait_ms,
+# queue_deadline_ms, predicted_wait_ms, retry_after_s, n_tokens,
+# priority, reason, error, attempt, requeued, running, completed,
+# drained_ms, kv_blocks_used, counts
+SERVING_RECORD_KEYS = ("schema", "kind", "rank", "event")
+# the request-lifecycle vocabulary: admitted (passed admission control
+# into the bounded queue), one of four TERMINAL outcomes (finished /
+# failed / cancelled / expired), shed (rejected up front: queue full or
+# predicted to blow its deadline — MUST carry queue_depth, the
+# pressure that justified the rejection), restart (transient step
+# fault -> arenas rebuilt, in-flight requeued for recompute-replay),
+# drain_begin/drain_end (graceful drain protocol), quiesce (engine
+# idle: counts must balance — admitted == finished+failed+cancelled+
+# expired — and kv_blocks_used must be 0; tools/trace_check.py
+# enforces both).
+SERVING_EVENTS = ("admitted", "finished", "failed", "cancelled",
+                  "expired", "shed", "restart", "drain_begin",
+                  "drain_end", "quiesce")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -275,6 +295,59 @@ def make_elastic_record(event, rank=0, host=None, step=None,
     return rec
 
 
+def make_serving_record(event, rank=0, rid=None, engine=None,
+                        queue_depth=None, queue_wait_ms=None,
+                        queue_deadline_ms=None, predicted_wait_ms=None,
+                        retry_after_s=None, n_tokens=None, priority=None,
+                        reason=None, error=None, kv_blocks_used=None,
+                        counts=None, **extra):
+    """One serving-lifecycle event as a first-class record
+    (kind='serving', paddle_tpu.serving.ServingEngine). `event` is one
+    of SERVING_EVENTS; `engine` is the emitting engine instance id (so
+    one ledger can carry several sequential engines and the quiesce
+    accounting stays per-engine); `counts` is the quiesce snapshot of
+    the engine's request accounting."""
+    if event not in SERVING_EVENTS:
+        raise ValueError(f"serving event must be one of {SERVING_EVENTS}, "
+                         f"got {event!r}")
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "serving",
+        "rank": int(rank),
+        "event": str(event),
+    }
+    if rid is not None:
+        rec["rid"] = int(rid)
+    if engine is not None:
+        rec["engine"] = int(engine)
+    if queue_depth is not None:
+        rec["queue_depth"] = int(queue_depth)
+    if queue_wait_ms is not None:
+        rec["queue_wait_ms"] = round(float(queue_wait_ms), 4)
+    if queue_deadline_ms is not None:
+        rec["queue_deadline_ms"] = round(float(queue_deadline_ms), 4)
+    if predicted_wait_ms is not None:
+        rec["predicted_wait_ms"] = round(float(predicted_wait_ms), 4)
+    if retry_after_s is not None:
+        rec["retry_after_s"] = round(float(retry_after_s), 4)
+    if n_tokens is not None:
+        rec["n_tokens"] = int(n_tokens)
+    if priority is not None:
+        rec["priority"] = str(priority)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    if error is not None:
+        rec["error"] = str(error)
+    if kv_blocks_used is not None:
+        rec["kv_blocks_used"] = int(kv_blocks_used)
+    if counts is not None:
+        rec["counts"] = {str(k): int(v) for k, v in counts.items()}
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
 
 # the SERVING bench-metric family (bench_serving.py over
@@ -295,6 +368,14 @@ SERVING_BENCH_METRICS = {
     "serving.requests": "info",
     "serving.preemptions": "info",
     "serving.kv_block_utilization_peak": "info",
+    # the serving-resilience drill's rated-load leg (tools/
+    # serving_drill.py --rated-only): throughput at rated load with SLO
+    # deadlines armed, queue-wait p99 under admission control, and the
+    # shed count — direction 'lower' over a 0.0 baseline means ANY shed
+    # at rated load fails the gate (the SLO sweep must run shed-free)
+    "serving.rated_throughput_tokens_per_sec": "higher",
+    "serving.rated_queue_wait_ms_p99": "lower",
+    "serving.rated_shed": "lower",
 }
 
 # required keys of an auto-sharding plan record (paddle_tpu.planner);
@@ -615,6 +696,39 @@ def validate_step_record(rec):
         v = rec.get("detect_s")
         if v is not None and (not isinstance(v, (int, float)) or v < 0):
             problems.append(f"'detect_s' not a non-negative number: {v!r}")
+        return problems
+    if kind == "serving":
+        for key in SERVING_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"serving record missing '{key}'")
+        ev = rec.get("event")
+        if ev is not None and ev not in SERVING_EVENTS:
+            problems.append(f"unknown serving event {ev!r} "
+                            f"(expected one of {list(SERVING_EVENTS)})")
+        for key in ("queue_depth", "queue_wait_ms", "queue_deadline_ms",
+                    "predicted_wait_ms", "retry_after_s", "n_tokens",
+                    "kv_blocks_used", "drained_ms"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
+        if ev == "quiesce":
+            # quiesce must be auditable on its own: the accounting
+            # snapshot and the pool state are WHAT it asserts
+            if "kv_blocks_used" not in rec:
+                problems.append(
+                    "serving quiesce record carries no kv_blocks_used")
+            counts = rec.get("counts")
+            if not isinstance(counts, dict):
+                problems.append(
+                    "serving quiesce record carries no counts dict")
+            else:
+                for k, v in counts.items():
+                    if not isinstance(v, int) or v < 0:
+                        problems.append(
+                            f"quiesce count {k!r} not a non-negative "
+                            f"int: {v!r}")
         return problems
     if kind == "ckpt":
         for key in CKPT_RECORD_KEYS:

@@ -43,3 +43,28 @@ def _fixed_seed():
     import paddle_tpu as paddle
     paddle.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _serving_kv_leak_check(request, monkeypatch):
+    """Every ServingEngine any test builds must end QUIESCED: the pool
+    leak check at teardown retrofits leak detection to all serving
+    paths (finish, eviction, cancel, expiry, shed, engine error, drain,
+    stop) in every test file, not just the ones about leaks. Lazy
+    import: non-serving tests pay nothing."""
+    if "serving" not in request.module.__name__:
+        yield
+        return
+    from paddle_tpu.serving import ServingEngine
+
+    engines = []
+    orig = ServingEngine.__init__
+
+    def tracking_init(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        engines.append(self)
+
+    monkeypatch.setattr(ServingEngine, "__init__", tracking_init)
+    yield
+    for eng in engines:
+        eng.pool.assert_quiesced()

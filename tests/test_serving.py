@@ -474,9 +474,11 @@ def test_serving_metrics_in_baseline_and_declared_family_agree():
 
 @pytest.mark.slow
 def test_step_error_fails_streams_and_loop_survives():
-    """A raising compiled step must not strand open streams or kill the
-    serve thread: in-flight requests FAIL with the error, the arenas
-    rebuild, and the engine keeps serving."""
+    """A PERMANENT step failure (a programming error — recompute-replay
+    would hit the identical bug) must not strand open streams or kill
+    the serve thread: in-flight requests FAIL with the error, the
+    arenas rebuild, and the engine keeps serving. (Transient faults
+    take the warm-restart path instead — test_serving_resilience.)"""
     from paddle_tpu import monitor
     model = _small_gpt()
     rs = np.random.RandomState(0)
@@ -488,7 +490,7 @@ def test_step_error_fails_streams_and_loop_survives():
     before = monitor.get("serving.engine_errors", 0)
 
     def boom(*a, **k):
-        raise RuntimeError("injected device failure")
+        raise ValueError("injected device failure")
 
     with eng:
         eng._decode_greedy_jit = boom

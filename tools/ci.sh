@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/9] native build =="
+echo "== [1/10] native build =="
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +37,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/9] api-surface audit =="
+echo "== [2/10] api-surface audit =="
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/9] graph doctor + framework lint =="
+echo "== [3/10] graph doctor + framework lint =="
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -70,7 +70,7 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 # kind=plan record that validates under tools/trace_check.py
 JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 
-echo "== [4/9] training health + compile observatory + bench gates =="
+echo "== [4/10] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases, plus the
@@ -112,6 +112,16 @@ JAX_PLATFORMS=cpu python bench_serving.py --cpu \
     2>> /tmp/bench_health_ci.err \
     || { tail -40 /tmp/bench_health_ci.err >&2
          echo "FATAL: serving bench failed"; exit 1; }
+# serving-resilience rated-load leg (tools/serving_drill.py
+# --rated-only): offered load at the engine's rated level with SLO
+# deadlines ARMED must run shed-free; its serving.rated_* typed bench
+# records land in the SAME gated file so bench_gate covers regressions
+# in the resilience path itself (the full chaos drill runs in stage 6)
+JAX_PLATFORMS=cpu python tools/serving_drill.py --rated-only \
+    --telemetry /tmp/bench_health_ci.jsonl \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: serving rated-load leg failed"; exit 1; }
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \
@@ -136,7 +146,7 @@ JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
 JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
 JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
-echo "== [5/9] serving engine smoke =="
+echo "== [5/10] serving engine smoke =="
 # continuous-batching serving gate (paddle_tpu/serving +
 # tools/serving_smoke.py), the two-sided pattern:
 #   a) N concurrent streamed requests through the real engine loop
@@ -151,7 +161,28 @@ echo "== [5/9] serving engine smoke =="
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
 
-echo "== [6/9] resilience chaos drill =="
+echo "== [6/10] serving resilience drill =="
+# serving robustness gate (paddle_tpu/serving/resilience +
+# tools/serving_drill.py), the two-sided pattern:
+#   a) --selfcheck first proves the failures are VISIBLE: the
+#      checked-in leak specimen (a quiesce record still holding KV
+#      blocks) and deadline-miss specimen (a request run to completion
+#      past its recorded queue deadline) must each be caught by
+#      tools/trace_check.py, and BlockPool.assert_quiesced must catch
+#      an in-process leak;
+#   b) then the mini drill inside --selfcheck runs the real thing: an
+#      overload wave (2x slots) + tight-deadline shed probes (429 +
+#      Retry-After) + an expired-TTFT probe + a mid-stream HTTP client
+#      disconnect (must cancel + release blocks) + an injected
+#      .transient step fault (must warm-restart and REPLAY the
+#      in-flight streams token-identically) + a graceful drain under
+#      load (healthz 503-draining, livez 200, accepted work finishes),
+#      ending with zero leaked KV blocks, balanced request accounting
+#      (admitted == finished+failed+cancelled+expired), and a
+#      kind=serving ledger that passes trace_check.
+JAX_PLATFORMS=cpu python tools/serving_drill.py --selfcheck
+
+echo "== [7/10] resilience chaos drill =="
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
 #   a) the checked-in corrupt-checkpoint specimen
 #      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
@@ -166,7 +197,7 @@ echo "== [6/9] resilience chaos drill =="
 #      telemetry ledger validating under tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
 
-echo "== [7/9] elastic mesh drill =="
+echo "== [8/10] elastic mesh drill =="
 # host-loss gate (distributed.elastic + resilience.reshard +
 # tools/elastic_drill.py), the two-sided pattern:
 #   a) the checked-in cross-layout specimen
@@ -183,12 +214,12 @@ echo "== [7/9] elastic mesh drill =="
 #      by tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/elastic_drill.py --selfcheck
 
-echo "== [8/9] test suite =="
+echo "== [9/10] test suite =="
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [9/9] op benchmark gate =="
+echo "== [10/10] op benchmark gate =="
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0

@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
-    problems).
+    n_serving_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -34,9 +34,9 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics file "
-                                         "(0 bytes): no step was ever "
-                                         "recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics "
+                                            "file (0 bytes): no step "
+                                            "was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -47,7 +47,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -59,6 +59,7 @@ def check_metrics_jsonl(path):
     problems += check_plan_records(records, path)
     problems += check_elastic_records(records, path)
     problems += check_moe_records(records, path)
+    problems += check_serving_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -71,8 +72,10 @@ def check_metrics_jsonl(path):
                  if isinstance(r, dict) and r.get("kind") == "plan")
     n_elastic = sum(1 for r in records
                     if isinstance(r, dict) and r.get("kind") == "elastic")
+    n_serving = sum(1 for r in records
+                    if isinstance(r, dict) and r.get("kind") == "serving")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
-            n_elastic, problems)
+            n_elastic, n_serving, problems)
 
 
 def check_compile_records(records, path):
@@ -402,6 +405,93 @@ def check_moe_records(records, path):
     return problems
 
 
+# the serving-lifecycle event families (paddle_tpu.serving; per-record
+# schema lives in sink.validate_step_record)
+_SERVING_TERMINAL = ("finished", "failed", "cancelled", "expired")
+
+
+def check_serving_records(records, path):
+    """Cross-record rules for serving-lifecycle events (kind=serving,
+    paddle_tpu.serving.ServingEngine + tools/serving_drill.py):
+
+    - a SHED record must carry `queue_depth` — admission rejected a
+      request, and a rejection the ledger cannot justify with the
+      queue pressure it saw is unauditable;
+    - a QUIESCE record must report zero `kv_blocks_used` — a quiesced
+      engine (all requests terminal) holding blocks has LEAKED them
+      (some terminal path dropped a request without releasing it);
+    - quiesce `counts` must balance: admitted == finished + failed +
+      cancelled + expired — a request that left the admission ledger
+      without reaching exactly one terminal state is unaccounted work
+      (a stream somewhere is hanging);
+    - the quiesce counts must agree with the ledger's own per-event
+      record tallies for that engine (when the ledger carries them) —
+      a counts snapshot the records contradict is a doctored or
+      half-written ledger;
+    - a DEADLINE MISS is a failure of enforcement, not of the request:
+      any admitted/finished record whose `queue_wait_ms` exceeds its
+      recorded `queue_deadline_ms` means the scheduler ran a request
+      it had promised to expire.
+    """
+    problems = []
+    tallies = {}          # (rank, engine) -> {event: n}
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "serving":
+            continue
+        ev = rec.get("event")
+        key = (rec.get("rank", 0), rec.get("engine"))
+        if ev == "shed" and not isinstance(rec.get("queue_depth"),
+                                           (int, float)):
+            problems.append(
+                f"{path}:{i + 1}: serving shed record carries no "
+                "queue_depth — an admission rejection with no recorded "
+                "queue pressure to justify it")
+        if ev in ("admitted",) + _SERVING_TERMINAL:
+            t = tallies.setdefault(key, {})
+            t[ev] = t.get(ev, 0) + 1
+        if ev in ("admitted", "finished"):
+            qw = rec.get("queue_wait_ms")
+            qd = rec.get("queue_deadline_ms")
+            if isinstance(qw, (int, float)) and \
+                    isinstance(qd, (int, float)) and qw > qd:
+                what = "admitted" if ev == "admitted" \
+                    else "run to completion"
+                problems.append(
+                    f"{path}:{i + 1}: deadline miss — request "
+                    f"{rec.get('rid')} waited {qw}ms against a "
+                    f"{qd}ms queue deadline yet was {what}: "
+                    "queue-deadline enforcement is dead")
+        if ev == "quiesce":
+            kv = rec.get("kv_blocks_used")
+            if isinstance(kv, (int, float)) and kv > 0:
+                problems.append(
+                    f"{path}:{i + 1}: {int(kv)} KV block(s) still "
+                    "allocated at quiesce — the pool leaked (a "
+                    "terminal path dropped a request without "
+                    "releasing its blocks)")
+            counts = rec.get("counts")
+            if isinstance(counts, dict):
+                adm = counts.get("admitted", 0)
+                term = sum(counts.get(k, 0) for k in _SERVING_TERMINAL)
+                if adm != term:
+                    problems.append(
+                        f"{path}:{i + 1}: quiesce counts don't "
+                        f"balance: admitted {adm} != finished+failed+"
+                        f"cancelled+expired {term} — requests "
+                        "unaccounted for at quiesce")
+                t = tallies.get(key, {})
+                if t.get("admitted"):
+                    for evname in ("admitted",) + _SERVING_TERMINAL:
+                        if t.get(evname, 0) != counts.get(evname, 0):
+                            problems.append(
+                                f"{path}:{i + 1}: ledger carries "
+                                f"{t.get(evname, 0)} {evname!r} "
+                                f"record(s) but the quiesce counts "
+                                f"claim {counts.get(evname, 0)} — the "
+                                "records and the snapshot disagree")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -440,11 +530,11 @@ def check_pair(jsonl_path, trace_path=None):
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
-     problems) = check_metrics_jsonl(jsonl_path)
+     n_serving, problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
-             "n_elastic": n_elastic,
+             "n_elastic": n_elastic, "n_serving": n_serving,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -493,6 +583,8 @@ def main(argv):
         msg += f" ({stats['n_plan']} plan records)"
     if stats.get("n_elastic"):
         msg += f" ({stats['n_elastic']} elastic events)"
+    if stats.get("n_serving"):
+        msg += f" ({stats['n_serving']} serving events)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
