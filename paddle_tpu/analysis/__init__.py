@@ -24,7 +24,13 @@ own source, all reporting through one `Finding` model:
 - `astlint`         — AST rules over `paddle_tpu/` itself: tracer
                       leaks, impurity inside traced functions,
                       device_get in library code, `pallas_call` without
-                      an `interpret=` escape hatch.  Rules FW4xx.
+                      an `interpret=` escape hatch or outside the
+                      kernel registry.  Rules FW4xx.
+- `kernel_lint`     — the Kernel Doctor: walks the Pallas kernel
+                      registry (`ops/kernel_registry.py`) and derives
+                      grid races, VMEM footprints, CostEstimate
+                      honesty, fallback parity and grid-spec sanity
+                      per `pallas_call` site.  Rules KN5xx.
 
 Entry points: `tools/graphdoctor.py` (CLI over the in-repo GPT/ResNet
 configs), `TrainStep(..., lint=True)` / `ShardedTrainStep(...,
@@ -43,6 +49,7 @@ FAMILIES = {
     "SH": "sharding",
     "CO": "collective_order",
     "FW": "framework",
+    "KN": "kernel",
 }
 
 

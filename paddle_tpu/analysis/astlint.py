@@ -20,6 +20,12 @@ different and invisible to generic linters:
                           escape hatch: the kernel cannot run (or be
                           debugged) off-TPU, so CPU CI silently loses
                           coverage of it.
+- FW405 unregistered    — a `pallas_call` site whose enclosing function
+                          is not decorated with `@register_kernel`
+                          (ops/kernel_registry.py): the kernel dodges
+                          every Kernel Doctor check (KN501–KN505,
+                          analysis/kernel_lint.py). A registered call
+                          site with `interpret=_interpret()` is clean.
 
 "Traced function" is resolved statically: a function is traced when its
 name is passed to a jax tracing wrapper in the same module
@@ -93,7 +99,7 @@ class _ModuleLinter(ast.NodeVisitor):
         self.src_lines = src.splitlines()
         self.findings = []
         self.traced_names = set()     # function names traced in this module
-        self._fn_stack = []           # (FunctionDef, is_traced)
+        self._fn_stack = []           # (FunctionDef, is_traced, is_registered)
 
     # -- pass 1: which names get traced ---------------------------------
     def collect_traced(self, tree):
@@ -141,11 +147,28 @@ class _ModuleLinter(ast.NodeVisitor):
             suggestion))
 
     def _in_traced(self):
-        return any(traced for _, traced in self._fn_stack)
+        return any(traced for _, traced, _reg in self._fn_stack)
+
+    def _in_registered(self):
+        return any(reg for _, _traced, reg in self._fn_stack)
+
+    @staticmethod
+    def _is_registered_def(node):
+        """True when the function carries the kernel-registry decorator
+        (`@register_kernel(...)` / `@kernel_registry.register_kernel(...)`,
+        ops/kernel_registry.py) — its pallas_call sites are covered by
+        the Kernel Doctor."""
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            chain = _dotted(target)
+            if chain and chain[-1] == "register_kernel":
+                return True
+        return False
 
     def visit_FunctionDef(self, node):
         traced = node.name in self.traced_names or self._in_traced()
-        self._fn_stack.append((node, traced))
+        registered = self._is_registered_def(node) or self._in_registered()
+        self._fn_stack.append((node, traced, registered))
         self.generic_visit(node)
         self._fn_stack.pop()
 
@@ -208,6 +231,16 @@ class _ModuleLinter(ast.NodeVisitor):
                     "the kernel cannot run or be debugged off-TPU",
                     suggestion="pass interpret=_interpret() (backend "
                                "probe) like the other kernel sites")
+            if not self._in_registered():
+                self._add(
+                    "FW405", SEV_ERROR, node,
+                    "`pallas_call` outside the kernel registry: the "
+                    "kernel dodges every Kernel Doctor check "
+                    "(grid races, VMEM projection, cost honesty, "
+                    "fallback parity — analysis/kernel_lint.py)",
+                    suggestion="decorate the enclosing function with "
+                               "@register_kernel(name, example=..., "
+                               "fallback=...) from ops/kernel_registry")
         self.generic_visit(node)
 
 

@@ -27,22 +27,18 @@ and shared by both forward paths so the two can never diverge in grads.
 """
 import functools
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..ops.kernel_registry import fits_vmem, register_kernel
+
 __all__ = ["moe_gather", "moe_combine", "gather_fallback",
            "combine_fallback", "moe_kernel_supported"]
 
 _BLOCK_ROWS = 128
-
-# the kernels keep the whole SOURCE array VMEM-resident (rows are
-# gathered by dynamic index, so no block partition of src is possible
-# without HBM streaming — a follow-up); one grid program must fit src
-# plus its output block under the per-core budget with double-buffer
-# headroom (same discipline as ops/pallas_decode.py)
-_VMEM_BUDGET = 10 * 2 ** 20
 
 
 def _interpret():
@@ -52,16 +48,21 @@ def _interpret():
 def moe_kernel_supported(d, dtype=jnp.float32, n_src=None):
     """Single eligibility gate for the fused path: the row width must
     tile the 128-lane registers, the dtype must be a native vector
-    type, and — because the source array stays VMEM-resident — its
-    bytes (plus an output block) must fit the VMEM budget. Callers
-    (auto mode) fall back to the exact jnp forms otherwise."""
+    type, and — because the kernels keep the whole SOURCE array
+    VMEM-resident (rows are gathered by dynamic index, so no block
+    partition of src is possible without HBM streaming — a follow-up)
+    — the src bytes plus a double-buffered output block must fit the
+    per-core budget. The bound is the Kernel Doctor's KN502 projection
+    (ops/kernel_registry.vmem_footprint: src is a RESIDENT block, the
+    output block MOVES), so the HBM-streaming follow-up changes one
+    place. Callers (auto mode) fall back to the exact jnp forms
+    otherwise."""
     if d % 128 or jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
                                            jnp.dtype(jnp.bfloat16)):
         return False
     if n_src is not None:
-        itemsize = jnp.dtype(dtype).itemsize
-        src_bytes = (n_src + _BLOCK_ROWS) * d * itemsize
-        if src_bytes > _VMEM_BUDGET:
+        if not fits_vmem(moving=[((_BLOCK_ROWS, d), dtype)],
+                         resident=[((n_src, d), dtype)]):
             return False
     return True
 
@@ -92,6 +93,23 @@ def _gather_kernel(idx_ref, src_ref, out_ref, *, rows, n_src):
     jax.lax.fori_loop(0, rows, body, 0)
 
 
+def _gather_example(rng):
+    d = int(rng.choice([128, 256]))
+    n_src = int(rng.integers(16, 64))
+    m = int(rng.integers(10, 150))
+    src = rng.standard_normal((n_src, d)).astype(np.float32)
+    idx = rng.integers(0, n_src + 1, size=m).astype(np.int32)  # incl sentinel
+    return (src, idx), {}
+
+
+@register_kernel(
+    "moe_gather", example=_gather_example,
+    # late-bound: gather_fallback is defined below (same index math
+    # via jnp.take(mode="fill"), pinned exact)
+    fallback=lambda src, idx: gather_fallback(src, idx),
+    tol=(1e-6, 1e-6),
+    notes="dispatch row-gather with sentinel zero-fill; slot map rides "
+          "the scalar-prefetch channel")
 def _gather_pallas(src, idx):
     n_src, d = src.shape
     n_out = idx.shape[0]
@@ -181,6 +199,22 @@ def _combine_kernel(idx_ref, w_ref, src_ref, out_ref, *, rows, k, n_src):
     jax.lax.fori_loop(0, rows, body, 0)
 
 
+def _combine_example(rng):
+    d = int(rng.choice([128, 256]))
+    k = int(rng.choice([1, 2]))
+    m = int(rng.integers(12, 48))
+    n = int(rng.integers(10, 150))
+    src = rng.standard_normal((m, d)).astype(np.float32)
+    idx = rng.integers(0, m + 1, size=(n, k)).astype(np.int32)
+    w = rng.random((n, k)).astype(np.float32)
+    return (src, idx, w), {}
+
+
+@register_kernel(
+    "moe_combine", example=_combine_example,
+    fallback=lambda src, idx, w: combine_fallback(src, idx, w),
+    tol=(1e-5, 1e-5),
+    notes="k-way weighted gather, f32 accumulation in slot order")
 def _combine_pallas(src, idx, w):
     n_src, d = src.shape
     n, k = idx.shape

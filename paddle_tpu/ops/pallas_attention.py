@@ -16,10 +16,13 @@ flows through it unchanged.
 import functools
 import math
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .kernel_registry import register_kernel
 
 DEFAULT_BLOCK_Q = None   # None -> per-shape policy (_resolve_blocks)
 DEFAULT_BLOCK_K = None
@@ -87,6 +90,155 @@ def _tri_bwd_decode(t, nq, r):
     ki = jnp.where(C(ki) > t, ki - 1, ki)
     qj = r * ki + (t - C(ki))
     return ki, qj
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry references + examples (analysis/kernel_lint KN504):
+# naive attention over the flat [BN, S, H] layout is the exact math the
+# flash kernels tile; the doctor runs every registered kernel against
+# it on randomized in-support shapes
+# ---------------------------------------------------------------------------
+
+def _ref_fwd_flat(qr, kr, vr, causal, offset=0):
+    """Reference forward over pre-scaled flat inputs -> (out, lse)
+    shaped exactly like the kernels' outputs."""
+    f32 = jnp.float32
+    s = jax.lax.dot_general(
+        qr.astype(f32), kr.astype(f32),
+        (((2,), (2,)), ((0,), (0,))))                 # [BN, sq, sk]
+    sq, sk = qr.shape[1], kr.shape[1]
+    if causal:
+        mask = (jnp.arange(sq)[:, None] + offset) >= \
+            jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p / l, vr.astype(f32), (((2,), (1,)), ((0,), (0,))))
+    lse = (m + jnp.log(l))[..., 0]                    # [BN, sq]
+    lse = jnp.broadcast_to(lse[:, None, :],
+                           (qr.shape[0], _SUB, qr.shape[1]))
+    return out.astype(qr.dtype), lse
+
+
+def _ref_bwd_flat(qr, kr, vr, gr, lse, delta, causal, offset=0):
+    """Reference backward from the saved lse/delta -> (dq, dk, dv)
+    flat, UN-scaled (mirrors the kernels; callers apply scale)."""
+    f32 = jnp.float32
+    s = jax.lax.dot_general(
+        qr.astype(f32), kr.astype(f32),
+        (((2,), (2,)), ((0,), (0,))))                 # [BN, sq, sk]
+    p = jnp.exp(s - lse[:, 0, :, None])
+    sq, sk = qr.shape[1], kr.shape[1]
+    if causal:
+        mask = (jnp.arange(sq)[:, None] + offset) >= \
+            jnp.arange(sk)[None, :]
+        p = jnp.where(mask[None], p, 0.0)
+    d_row = delta[:, 0, :, None]                      # [BN, sq, 1]
+    dv = jax.lax.dot_general(
+        p, gr.astype(f32), (((1,), (1,)), ((0,), (0,))))   # [BN, sk, H]
+    dp = jax.lax.dot_general(
+        gr.astype(f32), vr.astype(f32),
+        (((2,), (2,)), ((0,), (0,))))                 # [BN, sq, sk]
+    ds = p * (dp - d_row)
+    dk = jax.lax.dot_general(
+        ds, qr.astype(f32), (((1,), (1,)), ((0,), (0,))))  # [BN, sk, H]
+    dq = jax.lax.dot_general(
+        ds, kr.astype(f32), (((2,), (1,)), ((0,), (0,))))  # [BN, sq, H]
+    return (dq.astype(qr.dtype), dk.astype(kr.dtype),
+            dv.astype(vr.dtype))
+
+
+def _flat_example(rng, nq, bq=128, h=128, bn=2):
+    sq = nq * bq
+    mk = lambda: 0.08 * rng.standard_normal(  # noqa: E731
+        (bn, sq, h)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+def _fwd_tri_example(rng):
+    nq = int(rng.integers(2, 5))
+    qr, kr, vr = _flat_example(rng, nq)
+    return (qr, kr, vr, 128, 128, nq), {}
+
+
+def _fwd_tri_fallback(qr, kr, vr, bq, bk, nq):
+    return _ref_fwd_flat(qr, kr, vr, causal=True)
+
+
+def _rect_4d_example(rng):
+    """4-D example that stays OFF the triangle path (causal only with
+    offset != 0), so the rectangular pallas_call site is the one
+    captured."""
+    b, n, h = 1, 2, 128
+    sq = int(rng.choice([128, 256]))
+    causal = bool(rng.integers(2))
+    sk = sq + 128 if causal else sq
+    mk = lambda s: 0.08 * rng.standard_normal(  # noqa: E731
+        (b, s, n, h)).astype(np.float32)
+    return mk(sq), mk(sk), mk(sk), causal, 1.0 / math.sqrt(h)
+
+
+def _fwd_rect_example(rng):
+    q, k, v, causal, scale = _rect_4d_example(rng)
+    return (q, k, v, causal, scale, 128, 128), {}
+
+
+def _fwd_rect_fallback(q, k, v, causal, scale, block_q, block_k):
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    qr = (q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)) * scale
+    kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    return _ref_fwd_flat(qr, kr, vr, causal, sk - sq)
+
+
+def _bwd_tri_example(rng):
+    r = int(rng.integers(1, 3))
+    nk = int(rng.integers(2, 4))
+    bq = 128
+    bk = bq * r
+    nq = nk * r
+    qr, kr, vr = _flat_example(rng, nq, bq=bq)
+    out, lse = _ref_fwd_flat(qr, kr, vr, causal=True)
+    gr = rng.standard_normal(qr.shape).astype(np.float32)
+    delta = jnp.sum(gr * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :],
+                             (qr.shape[0], _SUB, qr.shape[1]))
+    return (qr, kr, vr, gr, lse, delta, bq, bk, nq), {}
+
+
+def _bwd_tri_fallback(qr, kr, vr, gr, lse, delta, bq, bk, nq):
+    return _ref_bwd_flat(qr, kr, vr, gr, lse, delta, causal=True)
+
+
+def _bwd_rect_example(rng):
+    q, k, v, causal, scale = _rect_4d_example(rng)
+    b, sq, n, h = q.shape
+    out, lse = _fwd_rect_fallback(q, k, v, causal, scale, 128, 128)
+    g = 0.08 * rng.standard_normal(q.shape).astype(np.float32)
+    return (q, k, v, out, lse, g, causal, scale, 128, 128), {}
+
+
+def _bwd_rect_fallback(q, k, v, out, lse, g, causal, scale,
+                       block_q, block_k):
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    qr = (q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)) * scale
+    kr = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    gr = g.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    delta = jnp.sum(gr.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * n, _SUB, sq))
+    dq, dk, dv = _ref_bwd_flat(qr, kr, vr, gr, lse, delta, causal,
+                               sk - sq)
+    dq = dq * scale
+
+    def unflatten(x, s):
+        return x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
+    return unflatten(dq, sq), unflatten(dk, sk), unflatten(dv, sk)
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +362,11 @@ def _fwd_kernel_tri(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (_SUB, lse.shape[0]))
 
 
+@register_kernel(
+    "flash_fwd_tri", example=_fwd_tri_example,
+    fallback=_fwd_tri_fallback, tol=(2e-3, 2e-3),
+    notes="triangle-grid causal forward; flat T axis must stay "
+          "sequential (KN501)")
 def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
     bn, sq, h = qr.shape
     T = nq * (nq + 1) // 2
@@ -236,7 +393,11 @@ def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
     # live tiles in row-major order and the kernel's running softmax
     # state (acc/m/l scratch) carries across its steps; this dimension
     # must NEVER be marked parallel (dimension_semantics) — Mosaic's
-    # default sequential execution is load-bearing.
+    # default sequential execution is load-bearing. MACHINE-CHECKED:
+    # Kernel Doctor rule KN501 (analysis/kernel_lint.py) evaluates the
+    # output index_maps over the grid and fails any parallel-marked
+    # axis whose steps revisit an output block (tests/test_io_prefetch
+    # pins it; tools/kerneldoctor.py gates it in CI).
     out, lse = pl.pallas_call(
         kernel,
         grid=(bn, T),
@@ -270,6 +431,10 @@ def _flash_fwd_tri(qr, kr, vr, bq, bk, nq):
     return out, lse
 
 
+@register_kernel(
+    "flash_fwd_rect", example=_fwd_rect_example,
+    fallback=_fwd_rect_fallback, tol=(2e-3, 2e-3),
+    notes="rectangular-grid forward (non-causal / offset cross-attn)")
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]
@@ -578,6 +743,11 @@ def _bwd_merged_kernel_tri(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_sc[pl.ds(qj * bq, bq), :].astype(dq_ref.dtype)
 
 
+@register_kernel(
+    "flash_bwd_merged_tri", example=_bwd_tri_example,
+    fallback=_bwd_tri_fallback, tol=(2e-3, 2e-3),
+    notes="triangle-grid merged backward; the _flush_dq sequential-grid"
+          " invariant is the KN501 checked property")
 def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
     bn, sq, h = qr.shape
     r = bk // bq
@@ -607,7 +777,9 @@ def _flash_bwd_merged_tri(qr, kr, vr, gr, lse, delta, bq, bk, nq):
     # intermediate revisits DMA whatever the buffer holds and are
     # overwritten in order. Marking this grid dimension parallel
     # (dimension_semantics) would silently corrupt dq and dk/dv — never
-    # do it.
+    # do it. MACHINE-CHECKED: KN501 (analysis/kernel_lint.py) derives
+    # exactly this property from the dq index_map's revisits, so a
+    # parallel marking here fails the kerneldoctor CI gate by name.
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(bn, T),
@@ -653,6 +825,10 @@ _MERGED_BWD_DQ_SCRATCH_LIMIT = 6 * 1024 * 1024
 _MERGED_BWD_DQ_SCRATCH_LIMIT_SMALL_BQ = 9 * 1024 * 1024
 
 
+@register_kernel(
+    "flash_bwd_merged_rect", example=_bwd_rect_example,
+    fallback=_bwd_rect_fallback, tol=(2e-3, 2e-3),
+    notes="rectangular merged backward (whole-slice dq accumulator)")
 def _flash_bwd_merged(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]
@@ -716,6 +892,10 @@ def _flash_bwd_merged(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     return unflatten(dq, sq), unflatten(dk, sk), unflatten(dv, sk)
 
 
+@register_kernel(
+    "flash_bwd_split", example=_bwd_rect_example,
+    fallback=_bwd_rect_fallback, tol=(2e-3, 2e-3),
+    notes="split dkv + dq backward (fallback above the dq-scratch cap)")
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     b, sq, n, h = q.shape
     sk = k.shape[1]

@@ -35,6 +35,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .kernel_registry import (VMEM_BUDGET as _VMEM_BUDGET,
+                              register_kernel, vmem_footprint)
+
 _COLS = 128   # head-column padding (N <= 128 heads)
 _SUB = 8      # scratch stat rows padded to the (8, 128) f32 tile minimum
 
@@ -43,15 +46,17 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-# conservative VMEM budget for one grid program (v5e has ~16 MiB/core;
-# leave headroom for double-buffering and the compiler's own temps)
-_VMEM_BUDGET = 10 * 2 ** 20
-
-
 def _per_row_bytes(hidden, itemsize):
-    # K+V tile rows (raw + f32 casts) plus the [BL, COLS] f32
-    # logits/probs/mask intermediates
-    return 2 * hidden * (itemsize + 4) + _COLS * 12
+    """KN502-projection bytes per K/V tile row, via the shared
+    kernel_registry model (the Kernel Doctor's single source): the raw
+    K and V rows are MOVING blocks (double-buffered), and the in-kernel
+    f32 casts plus the three [row, COLS] f32 logits/probs/mask
+    intermediates ride as temp bytes. Slightly stricter than the
+    pre-registry hand formula (which charged the raw rows once and left
+    double-buffering to the budget's headroom)."""
+    return vmem_footprint(
+        moving=[((1, hidden), itemsize)] * 2,
+        temp_bytes=2 * hidden * 4 + _COLS * 12)
 
 
 def decode_attention_supported(max_len, hidden, n_heads, itemsize=2):
@@ -226,6 +231,40 @@ def paged_decode_supported(block_size, hidden, n_heads, itemsize=2):
         <= _VMEM_BUDGET
 
 
+def _paged_example(rng):
+    """Randomized in-support paged config (kernel_lint KN504): distinct
+    physical blocks per row, tails at the null block 0."""
+    N, H = 4, 32
+    nh = N * H * (1 if rng.integers(2) else 2)  # nh 128 or 256
+    N = nh // H
+    bs = 16
+    S = int(rng.choice([2, 3]))
+    mb = int(rng.integers(2, 4))
+    num_blocks = S * mb + 1
+    ctx = rng.integers(0, mb * bs - 1, size=S).astype(np.int32)
+    tables = np.zeros((S, mb), np.int32)
+    for s in range(S):
+        n_alloc = int(ctx[s]) // bs + 1
+        for i in range(n_alloc):
+            tables[s, i] = 1 + s * mb + i
+    q = 0.1 * rng.standard_normal((S, 1, nh)).astype(np.float32)
+    kp = 0.1 * rng.standard_normal((num_blocks, bs, nh)).astype(np.float32)
+    vp = 0.1 * rng.standard_normal((num_blocks, bs, nh)).astype(np.float32)
+    return (q, kp, vp, tables, ctx, N), {"use_kernel": True}
+
+
+def _paged_fallback(q, k_pages, v_pages, block_tables, ctx_lens,
+                    n_heads, use_kernel=None):
+    # the in-function gather+dense path IS the declared exact fallback
+    return paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                  ctx_lens, n_heads, use_kernel=False)
+
+
+@register_kernel(
+    "paged_decode", example=_paged_example, fallback=_paged_fallback,
+    tol=(1e-3, 1e-3),
+    notes="scalar-prefetched block table resolves logical->physical "
+          "blocks (KN505 covers the prefetch channel)")
 def paged_decode_attention(q, k_pages, v_pages, block_tables, ctx_lens,
                            n_heads, use_kernel=None):
     """Decode attention (q_len == 1) over a PAGED KV cache.
@@ -308,6 +347,43 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, ctx_lens,
     return out.astype(q.dtype)
 
 
+def _decode_example(rng):
+    N = int(rng.choice([4, 8]))
+    H = 32
+    nh = N * H
+    B = int(rng.choice([1, 2]))
+    L = int(rng.choice([16, 32]))
+    off = np.int32(rng.integers(0, L))
+    q = 0.1 * rng.standard_normal((B, 1, nh)).astype(np.float32)
+    k = 0.1 * rng.standard_normal((B, L, nh)).astype(np.float32)
+    v = 0.1 * rng.standard_normal((B, L, nh)).astype(np.float32)
+    return (q, k, v, off, N), {}
+
+
+def _decode_fallback(q, k_buf, v_buf, off, n_heads):
+    """Dense masked attention in f32 — the composed einsum math of
+    models/gpt._cached_attention, the kernel's exact reference."""
+    B, _, nh = q.shape
+    N, H = n_heads, nh // n_heads
+    L = k_buf.shape[1]
+    scale = 1.0 / float(np.sqrt(H))
+    q4 = q.reshape(B, 1, N, H).astype(jnp.float32)
+    k4 = k_buf.reshape(B, L, N, H).astype(jnp.float32)
+    v4 = v_buf.reshape(B, L, N, H).astype(jnp.float32)
+    logits = jnp.einsum("bqnh,bknh->bnqk", q4, k4) * scale
+    key_pos = jnp.arange(L, dtype=jnp.int32)
+    logits = logits + jnp.where(key_pos <= off, 0.0,
+                                -1e30)[None, None, None, :]
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v4)
+    return out.reshape(B, 1, nh)
+
+
+@register_kernel(
+    "decode_fused", example=_decode_example, fallback=_decode_fallback,
+    tol=(1e-3, 1e-3),
+    notes="all-head fused decode step over the flat KV cache; online "
+          "softmax across L tiles")
 def decode_attention(q, k_buf, v_buf, off, n_heads):
     """q [B, 1, N*H]; k_buf/v_buf FLAT [B, L, N*H] (L multiple of 8,
     N*H multiple of 128, N <= 128); off scalar int32 — q's position

@@ -15,8 +15,11 @@ head_q branch).
 """
 import functools
 
+import numpy as np
 import jax
 import jax.numpy as jnp
+
+from .kernel_registry import register_kernel
 
 _BLOCK_V = 1024
 _MIN_ROWS = 16   # bf16 sublane minimum
@@ -46,6 +49,32 @@ def _kernel(h_ref, wq_ref, s_ref, out_ref):
     out_ref[...] = acc * s_ref[...][None, :]
 
 
+def _matvec_example(rng):
+    B = int(rng.choice([1, 4, 32]))
+    D = int(rng.choice([256, 512]))
+    V = 2048
+    h = rng.standard_normal((B, D)).astype(np.float32)
+    wq = rng.integers(-127, 128, size=(V, D)).astype(np.int8)
+    scale = (0.01 + rng.random(V)).astype(np.float32) * 0.01
+    return (h, wq, scale), {}
+
+
+def _matvec_fallback(h, wq, scale, block_v=_BLOCK_V):
+    """Same bf16-cast contract+f32-accumulate math without the
+    V-blocking (padding rows never reach the real output)."""
+    hh = h.astype(jnp.bfloat16)
+    w = wq.astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        hh, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * scale.astype(jnp.float32)[None, :]
+
+
+@register_kernel(
+    "int8_matvec", example=_matvec_example, fallback=_matvec_fallback,
+    tol=(1e-4, 1e-4),
+    notes="weight-only-int8 LM head matvec; int8 tiles dequantize "
+          "in-register")
 def int8_matvec(h, wq, scale, block_v=_BLOCK_V):
     """h [B, D] (any float dtype), wq int8 [V, D], scale f32 [V] ->
     [B, V] f32 logits (= h @ (wq * scale[:, None]).T without ever

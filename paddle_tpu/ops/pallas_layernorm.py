@@ -21,8 +21,11 @@ otherwise — handled by the caller check).
 """
 import functools
 
+import numpy as np
 import jax
 import jax.numpy as jnp
+
+from .kernel_registry import register_kernel
 
 _BLOCK_ROWS = 256
 
@@ -50,6 +53,40 @@ def _fwd_kernel(x_ref, res_ref, w_ref, b_ref, out_ref, sum_ref, rstd_ref,
         rstd_ref.dtype)
 
 
+def _ln_example(rng):
+    rows = int(rng.choice([128, 256, 512]))
+    d = int(rng.choice([128, 256]))
+    x = rng.standard_normal((rows, d)).astype(np.float32)
+    res = rng.standard_normal((rows, d)).astype(np.float32)
+    w = rng.standard_normal((d,)).astype(np.float32)
+    b = rng.standard_normal((d,)).astype(np.float32)
+    return (x, res, w, b, 1e-5), {}
+
+
+def _ln_ref(x, residual, weight, bias, eps):
+    xs = x.astype(jnp.float32)
+    rs = residual.astype(jnp.float32)
+    s = xs + rs
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    out = ((s - mean) * rstd * weight.astype(jnp.float32)
+           + bias.astype(jnp.float32))
+    return out.astype(x.dtype), s, rstd
+
+
+def _ln_fwd_fallback(x, residual, weight, bias, eps):
+    return _ln_ref(x, residual, weight, bias, eps)
+
+
+def _ln_primal_fallback(x, residual, weight, bias, eps=1e-5):
+    return _ln_ref(x, residual, weight, bias, eps)[0]
+
+
+@register_kernel(
+    "layernorm_fwd_saved", example=_ln_example, fallback=_ln_fwd_fallback,
+    tol=(1e-4, 1e-5),
+    notes="3-output forward (out + residual sum + rstd) for the vjp")
 def _fwd(x, residual, weight, bias, eps):
     from jax.experimental import pallas as pl
     rows, d = x.shape
@@ -97,6 +134,10 @@ def _fwd_only_kernel(x_ref, res_ref, w_ref, b_ref, out_ref, *, eps):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+@register_kernel(
+    "layernorm_fused", example=_ln_example, fallback=_ln_primal_fallback,
+    tol=(1e-4, 1e-5),
+    notes="output-only primal kernel (pallas outputs cannot be DCE'd)")
 def fused_add_layer_norm(x, residual, weight, bias, eps=1e-5):
     """LayerNorm(x + residual) * weight + bias, one VMEM pass. The
     primal (inference) path runs an output-only kernel — pallas outputs

@@ -378,6 +378,67 @@ SERVING_BENCH_METRICS = {
     "serving.rated_shed": "lower",
 }
 
+# required keys of a Kernel Doctor result record (analysis/kernel_lint
+# via tools/kerneldoctor.py); optional: module, fn, grid, vmem_bytes,
+# vmem_budget, flops_declared, flops_counted, has_fallback
+KERNEL_RECORD_KEYS = ("schema", "kind", "rank", "kernel", "n_findings",
+                      "findings")
+
+# the KN rule vocabulary (analysis/kernel_lint.RULES is the documented
+# source; this tuple is what the record validator enforces)
+KERNEL_LINT_RULES = ("KN501", "KN502", "KN503", "KN504", "KN505")
+
+
+def make_kernel_record(kernel, findings=(), rank=0, module=None,
+                       fn=None, grid=None, vmem_bytes=None,
+                       vmem_budget=None, flops_declared=None,
+                       flops_counted=None, has_fallback=None, **extra):
+    """One kernel's Kernel Doctor verdict as a first-class record
+    (kind='kernel_lint'). `findings` is a list of Finding objects or
+    {rule, message} dicts; a clean kernel records n_findings == 0 with
+    its derived numbers (grid, projected VMEM, declared-vs-counted
+    FLOPs) so the ledger shows what was checked, not just that nothing
+    fired. tools/trace_check.py cross-checks the numbers against the
+    findings (a VMEM projection over budget with no KN502 finding is a
+    doctored or half-written ledger)."""
+    fs = []
+    for f in findings:
+        if isinstance(f, dict):
+            fs.append({"rule": str(f.get("rule", "")),
+                       "message": str(f.get("message", ""))})
+        else:
+            fs.append({"rule": str(getattr(f, "rule_id", "")),
+                       "message": str(getattr(f, "message", ""))})
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "kernel_lint",
+        "rank": int(rank),
+        "kernel": str(kernel),
+        "n_findings": len(fs),
+        "findings": fs,
+    }
+    if module is not None:
+        rec["module"] = str(module)
+    if fn is not None:
+        rec["fn"] = str(fn)
+    if grid is not None:
+        rec["grid"] = [int(g) for g in grid]
+    if vmem_bytes is not None:
+        rec["vmem_bytes"] = int(vmem_bytes)
+    if vmem_budget is not None:
+        rec["vmem_budget"] = int(vmem_budget)
+    if flops_declared is not None:
+        rec["flops_declared"] = int(flops_declared)
+    if flops_counted is not None:
+        rec["flops_counted"] = int(flops_counted)
+    if has_fallback is not None:
+        rec["has_fallback"] = bool(has_fallback)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 # required keys of an auto-sharding plan record (paddle_tpu.planner);
 # optional: chip, n_chips, projected_hbm_bytes, measured_hbm_bytes,
 # hbm_budget_bytes, cost_step_s, calibration, verify
@@ -606,6 +667,45 @@ def validate_step_record(rec):
         if v is None and "error" not in rec:
             problems.append("bench record with null value carries no "
                             "'error' note")
+        return problems
+    if kind == "kernel_lint":
+        for key in KERNEL_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"kernel_lint record missing '{key}'")
+        if not str(rec.get("kernel", "")).strip():
+            problems.append("kernel_lint record names no kernel")
+        n = rec.get("n_findings")
+        fs = rec.get("findings")
+        if n is not None and (not isinstance(n, int) or n < 0):
+            problems.append(f"'n_findings' not a non-negative int: {n!r}")
+        if fs is not None:
+            if not isinstance(fs, list):
+                problems.append("'findings' not a list")
+            else:
+                if isinstance(n, int) and n != len(fs):
+                    problems.append(
+                        f"n_findings {n} but {len(fs)} findings listed "
+                        "— the count and the list disagree")
+                for j, f in enumerate(fs):
+                    if not isinstance(f, dict):
+                        problems.append(f"finding {j} not a dict")
+                        continue
+                    if f.get("rule") not in KERNEL_LINT_RULES:
+                        problems.append(
+                            f"finding {j} rule {f.get('rule')!r} not in "
+                            f"the KN vocabulary "
+                            f"{list(KERNEL_LINT_RULES)}")
+                    if not str(f.get("message", "")).strip():
+                        problems.append(
+                            f"finding {j} carries no message — a "
+                            "finding the ledger cannot explain")
+        for key in ("vmem_bytes", "vmem_budget", "flops_declared",
+                    "flops_counted"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
         return problems
     if kind == "plan":
         for key in PLAN_RECORD_KEYS:

@@ -406,14 +406,23 @@ def build(pl, kernel):
     return pl.pallas_call(kernel, grid=(1,))
 """
 
+# interpret= present (FW404-clean) but no @register_kernel decorator:
+# the kernel dodges every Kernel Doctor check -> FW405
+_UNREGISTERED_PALLAS = """
+def build(pl, kernel, interp):
+    return pl.pallas_call(kernel, grid=(1,), interpret=interp)
+"""
+
 _CLEAN = """
 import time, jax
+from paddle_tpu.ops.kernel_registry import register_kernel
 def host_timer():
     return time.time()          # impurity OUTSIDE traced fns is fine
 def outer():
     def step(x):
         return x + 1
     return jax.jit(step)
+@register_kernel("k", example=None)
 def build(pl, kernel, interp):
     return pl.pallas_call(kernel, grid=(1,), interpret=interp)
 """
@@ -421,9 +430,21 @@ def build(pl, kernel, interp):
 
 @pytest.mark.parametrize("src,rule", [
     (_TRACER_LEAK, "FW401"), (_IMPURE, "FW402"),
-    (_DEVICE_GET, "FW403"), (_BARE_PALLAS, "FW404")])
+    (_DEVICE_GET, "FW403"), (_BARE_PALLAS, "FW404"),
+    (_UNREGISTERED_PALLAS, "FW405")])
 def test_fw_rules_fire(src, rule):
     assert rule in _rules(astlint.lint_source(src, "spec.py"))
+
+
+def test_fw405_registered_site_is_clean():
+    """The registry decorator (any spelling reaching register_kernel)
+    clears FW405; the bare-pallas specimen fires BOTH FW404 and FW405
+    (no escape hatch AND unregistered)."""
+    rules = _rules(astlint.lint_source(_BARE_PALLAS, "spec.py"))
+    assert "FW404" in rules and "FW405" in rules
+    qualified = _CLEAN.replace(
+        "@register_kernel(", "@kernel_registry.register_kernel(")
+    assert astlint.lint_source(qualified, "ok.py") == []
 
 
 def test_fw_clean_module():

@@ -2,9 +2,9 @@
 (paddle_tpu/io/prefetch.py + the rebuilt DataLoader), the
 prefetch-to-device stage, the no-redundant-h2d hot-path contract, the
 legacy constructor surface, and the triangle-grid sequential-flush
-invariant (ADVICE.md round-5 debt).
+invariant (ADVICE.md round-5 debt; since the Kernel Doctor landed it
+is asserted through KN501 rather than a source grep).
 """
-import ast
 import inspect
 import os
 import threading
@@ -497,33 +497,46 @@ def test_reader_decorators_still_compose():
 
 
 # ---------------------------------------------------------------------------
-# ADVICE.md round-5 debt: the _flush_dq sequential-grid invariant
+# ADVICE.md round-5 debt: the _flush_dq sequential-grid invariant —
+# now checked as a PROPERTY (Kernel Doctor rule KN501) instead of the
+# old source-grep: KN501 evaluates the output index_maps over the real
+# grid, so it sees the revisits themselves, not the comment about them
 # ---------------------------------------------------------------------------
 
 def test_triangle_backward_grid_never_marked_parallel():
     """The merged triangle-grid backward walks live tiles column-major
     and flushes each dq window only in its diagonal column (_flush_dq);
     dk/dv scratch accumulates down columns. Both rely on Mosaic's
-    DEFAULT sequential grid order — no pallas_call in the attention
-    kernels may mark a grid dimension 'parallel' via dimension_semantics
-    (doing so silently corrupts dq/dk/dv)."""
+    DEFAULT sequential grid order. KN501 (analysis/kernel_lint) derives
+    that property from the captured BlockSpecs: the tri kernels as
+    shipped must pass, and a deliberately-parallelized copy of the SAME
+    captured grid must fail — the invariant is machine-checked, not
+    grepped."""
+    import numpy as np
+    from paddle_tpu.analysis import kernel_lint
+    from paddle_tpu.ops.kernel_registry import get_kernel
     import paddle_tpu.ops.pallas_attention as pa
 
+    for name in ("flash_bwd_merged_tri", "flash_fwd_tri"):
+        reg = get_kernel(name)
+        args, kwargs = reg.example(np.random.default_rng(0))
+        caps, _ = kernel_lint.capture_kernels(
+            reg.fn, args, kwargs, name=name)
+        (cap,) = caps
+        # as shipped: no dimension_semantics -> sequential -> clean
+        assert cap.dimension_semantics is None
+        assert kernel_lint.check_grid_races(cap) == []
+        # the deliberately-parallelized copy: same kernel, same grid,
+        # flat T axis marked parallel -> the flush invariant breaks
+        bad = kernel_lint.check_grid_races(
+            cap, semantics=("arbitrary", "parallel"))
+        assert bad, f"{name}: parallelized T axis produced no KN501"
+        assert all(f.rule_id == "KN501" for f in bad)
+        assert any(name in f.location for f in bad)
+
+    # the invariant's subject (and its machine-checked note) still
+    # exists where we claim it does
     src = inspect.getsource(pa)
-    tree = ast.parse(src)
-    n_calls = 0
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and getattr(node.func, "attr", "") == "pallas_call"):
-            continue
-        n_calls += 1
-        for kw in node.keywords:
-            if kw.arg in ("dimension_semantics", "compiler_params"):
-                assert "parallel" not in ast.dump(kw.value), (
-                    f"pallas_call at line {node.lineno} marks a grid "
-                    "dimension parallel — the sequential-grid flush "
-                    "invariant of the triangle backward forbids this")
-    assert n_calls >= 2      # fwd + merged bwd at minimum
-    # the invariant's subject still exists where we claim it does
     assert "_flush_dq" in src
     assert "SEQUENTIAL-GRID INVARIANT" in src
+    assert "KN501" in src

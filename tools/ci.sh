@@ -69,6 +69,19 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis.astlint paddle_tpu
 # including re-linting the planner's tags on the live model — with a
 # kind=plan record that validates under tools/trace_check.py
 JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
+# kernel doctor gate (tools/kerneldoctor.py over paddle_tpu/analysis/
+# kernel_lint.py), same two-sided pattern one level below the graph:
+# the checked-in broken specimens must be caught BY NAME — the
+# racy-grid kernel (tools/specimens/kernel_racy.py, parallel-marked
+# accumulation axis -> KN501) and the over-VMEM BlockSpec
+# (tools/specimens/kernel_overvmem.py -> KN502) — every in-tree
+# registered Pallas kernel must lint clean (races, VMEM projection,
+# CostEstimate honesty, fallback parity, grid-spec sanity), the AST
+# sweep must prove no pallas_call site in paddle_tpu/ remains outside
+# the kernel registry (the astlint FW405 rule, also enforced by the
+# standalone astlint run above), and the emitted kind=kernel_lint
+# records must validate under tools/trace_check.py
+JAX_PLATFORMS=cpu python tools/kerneldoctor.py --selfcheck
 
 echo "== [4/10] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Kernel Doctor CLI: static race / VMEM / cost verification of every
+registered Pallas kernel (paddle_tpu/analysis/kernel_lint.py).
+
+The kernel-level sibling of tools/graphdoctor.py: walks the kernel
+registry (ops/kernel_registry.py — every pallas_call site in the tree
+registers itself), captures each site's grid + BlockSpecs from its
+canonical example, and derives per kernel WITHOUT a TPU:
+
+  KN501 grid races (parallel axis writing overlapping output blocks)
+  KN502 VMEM footprint vs the per-core budget (the projection the
+        moe/paged support predicates delegate to)
+  KN503 CostEstimate honesty vs the traced kernel jaxpr
+  KN504 parity against the declared exact fallback (seeded fuzz)
+  KN505 scalar-prefetch / index_map / grid-coverage sanity
+
+    JAX_PLATFORMS=cpu python tools/kerneldoctor.py \
+        [--report doctor.json] [--telemetry run.jsonl] [--seeds N]
+
+--selfcheck (the ci.sh stage-3 gate) is the usual two-sided pattern:
+  a) the checked-in broken specimens must be caught BY NAME —
+     tools/specimens/kernel_racy.py (parallel-marked accumulation
+     axis -> KN501) and tools/specimens/kernel_overvmem.py (8 MiB
+     blocks -> KN502);
+  b) every in-tree registered kernel must lint clean;
+  c) registry coverage: an AST sweep of paddle_tpu/ proves no
+     pallas_call site remains outside the registry (astlint FW405),
+     and every registered entry resolves to a function the sweep saw;
+  d) the emitted kind=kernel_lint records must validate under
+     tools/trace_check.py (including its cross-rules).
+
+Exit codes: 0 clean; 12 findings on in-tree kernels; 9 selfcheck miss
+(a specimen not caught, coverage hole, or invalid records — the doctor
+itself is broken).
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPECIMEN_DIR = os.path.join(REPO, "tools", "specimens")
+
+
+def _info_to_record(info, budget):
+    from paddle_tpu.telemetry import sink
+
+    calls = info.get("calls", [])
+    # grid and cost numbers must describe the SAME pallas_call: anchor
+    # on the first cost-declaring call (multi-call kernels like the
+    # split backward would otherwise pair one call's grid with
+    # another's FLOPs)
+    cost = next((c for c in calls if "flops_declared" in c), None)
+    anchor = cost or (calls[0] if calls else None)
+    return sink.make_kernel_record(
+        kernel=info["kernel"],
+        findings=info.get("finding_objs", ()),
+        module=info.get("module"),
+        fn=info.get("fn"),
+        grid=(anchor["grid"] if anchor else None),
+        vmem_bytes=info.get("vmem_bytes"),
+        vmem_budget=budget,
+        flops_declared=(cost or {}).get("flops_declared"),
+        flops_counted=(cost or {}).get("flops_counted"),
+        has_fallback=info.get("has_fallback"),
+    )
+
+
+def run_lint(seeds=(0,), registry=None):
+    """Lint a registry (default: in-tree). Returns (findings, infos)
+    with each info carrying its own Finding objects for the record."""
+    from paddle_tpu.analysis import kernel_lint
+
+    findings, infos = kernel_lint.lint_registry(
+        registry=registry, seeds=seeds)
+    # re-attach findings per kernel for the typed records
+    by_kernel = {}
+    for f in findings:
+        by_kernel.setdefault(f.location.split("#")[0], []).append(f)
+    for info in infos:
+        info["finding_objs"] = by_kernel.get(info["kernel"], [])
+    return findings, infos
+
+
+def print_table(infos):
+    hdr = (f"{'kernel':24s} {'module':28s} {'grid':>14s} "
+           f"{'vmem':>9s} {'flops d/c':>23s} {'fb':>3s} {'findings':>8s}")
+    print(hdr)
+    print("-" * len(hdr))
+    for info in infos:
+        calls = info.get("calls", [])
+        grid = "x".join(map(str, calls[0]["grid"])) if calls else "-"
+        cost = next((c for c in calls if "flops_declared" in c), None)
+        fl = (f"{cost['flops_declared']}/{cost['flops_counted']}"
+              if cost else "-")
+        mod = info.get("module", "?").replace("paddle_tpu.", "")
+        print(f"{info['kernel']:24s} {mod:28s} {grid:>14s} "
+              f"{info.get('vmem_bytes', 0):>9d} {fl:>23s} "
+              f"{'y' if info.get('has_fallback') else '-':>3s} "
+              f"{info.get('n_findings', 0):>8d}")
+
+
+def _load_specimen(fname):
+    path = os.path.join(SPECIMEN_DIR, fname)
+    spec = importlib.util.spec_from_file_location(
+        fname.replace(".py", ""), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.SPECIMENS
+
+
+def run_selfcheck(seeds):
+    """The two-sided gate. Returns (ok, report dict)."""
+    from paddle_tpu.analysis import kernel_lint
+    from paddle_tpu.ops.kernel_registry import (VMEM_BUDGET,
+                                                registered_kernels)
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_check
+
+    ok = True
+    report = {}
+
+    # a) broken specimens caught by name
+    for fname, rule, kernel_name in (
+            ("kernel_racy.py", "KN501", "specimen_racy_grid"),
+            ("kernel_overvmem.py", "KN502", "specimen_overvmem_block")):
+        reg = _load_specimen(fname)
+        findings, infos = run_lint(seeds=seeds, registry=reg)
+        hit = [f for f in findings if f.rule_id == rule
+               and kernel_name in f.location]
+        report[fname] = {"findings": [f.to_dict() for f in findings],
+                         "expected_rule": rule, "caught": bool(hit)}
+        if not hit:
+            print(f"SELFCHECK FAILED: {fname} did not produce a {rule} "
+                  f"finding naming {kernel_name!r} (got: "
+                  f"{[f.rule_id for f in findings]})", file=sys.stderr)
+            ok = False
+        report[fname]["records_ok"] = _records_validate(
+            infos, VMEM_BUDGET, trace_check)
+        if not report[fname]["records_ok"]:
+            ok = False
+
+    # b) every in-tree kernel clean
+    findings, infos = run_lint(seeds=seeds)
+    report["in_tree"] = {
+        "n_kernels": len(infos),
+        "findings": [f.to_dict() for f in findings]}
+    if findings:
+        print(f"SELFCHECK FAILED: {len(findings)} finding(s) on "
+              "in-tree kernels:", file=sys.stderr)
+        for f in findings:
+            print(f"  {f!r}", file=sys.stderr)
+        ok = False
+
+    # c) registry coverage: no pallas_call outside the registry (the
+    # machine-checked version of the acceptance grep), and every
+    # registered function is one the AST sweep saw containing a site
+    fw405 = kernel_lint.unregistered_pallas_sites(
+        os.path.join(REPO, "paddle_tpu"))
+    report["unregistered_sites"] = [f.to_dict() for f in fw405]
+    if fw405:
+        print(f"SELFCHECK FAILED: {len(fw405)} pallas_call site(s) in "
+              "paddle_tpu/ outside the kernel registry:",
+              file=sys.stderr)
+        for f in fw405:
+            print(f"  {f!r}", file=sys.stderr)
+        ok = False
+    swept = kernel_lint.pallas_site_functions(
+        os.path.join(REPO, "paddle_tpu"))
+    registered_fns = {r.fn_name for r in registered_kernels()}
+    report["n_registered"] = len(registered_kernels())
+    report["n_site_functions"] = len(swept)
+    if not swept:
+        print("SELFCHECK FAILED: the AST sweep found no pallas_call "
+              "sites under paddle_tpu/ — the sweep itself is broken",
+              file=sys.stderr)
+        ok = False
+    stale = sorted(registered_fns - set(swept))
+    if stale:
+        print(f"SELFCHECK FAILED: registered function(s) {stale} "
+              "contain no pallas_call site — stale registrations "
+              "covering nothing", file=sys.stderr)
+        ok = False
+    uncovered = sorted(set(swept) - registered_fns)
+    if uncovered:
+        print(f"SELFCHECK FAILED: function(s) {uncovered} contain "
+              "pallas_call sites but no registration resolves to them",
+              file=sys.stderr)
+        ok = False
+
+    # d) clean-run records validate (schema + cross-rules)
+    report["records_ok"] = _records_validate(
+        infos, VMEM_BUDGET, trace_check)
+    if not report["records_ok"]:
+        ok = False
+    return ok, report
+
+
+def _records_validate(infos, budget, trace_check):
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False) as f:
+        for info in infos:
+            f.write(json.dumps(_info_to_record(info, budget)) + "\n")
+        path = f.name
+    try:
+        *counts, problems = trace_check.check_metrics_jsonl(path)
+        n_kernel = counts[-1]
+        if problems:
+            print("SELFCHECK FAILED: kernel_lint records did not "
+                  "validate:", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+            return False
+        if n_kernel != len(infos):
+            print(f"SELFCHECK FAILED: wrote {len(infos)} kernel "
+                  f"records, trace_check counted {n_kernel}",
+                  file=sys.stderr)
+            return False
+        return True
+    finally:
+        os.unlink(path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--report", default=None,
+                    help="write the JSON report here")
+    ap.add_argument("--telemetry", default=None,
+                    help="append kind=kernel_lint records to this JSONL")
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="fuzz seeds per kernel for KN504 (default 1)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="broken specimens + in-tree clean + registry "
+                         "coverage + record validation")
+    args = ap.parse_args(argv)
+
+    import jax
+    from paddle_tpu import analysis
+    from paddle_tpu.ops.kernel_registry import VMEM_BUDGET
+
+    seeds = tuple(range(args.seeds))
+
+    if args.selfcheck:
+        ok, report = run_selfcheck(seeds)
+        report["tool"] = "kerneldoctor"
+        report["platform"] = jax.default_backend()
+        if args.report:
+            with open(args.report, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+        if ok:
+            print(f"kernel doctor selfcheck OK: both broken specimens "
+                  f"caught by name, {report['in_tree']['n_kernels']} "
+                  "in-tree kernels clean, no pallas_call outside the "
+                  "registry, records validate")
+        return 0 if ok else 9
+
+    findings, infos = run_lint(seeds=seeds)
+    print_table(infos)
+    report = {
+        "tool": "kerneldoctor",
+        "platform": jax.default_backend(),
+        "findings": [f.to_dict() for f in findings],
+        "summary": analysis.summarize(findings),
+        "kernels": [{k: v for k, v in info.items()
+                     if k != "finding_objs"} for info in infos],
+    }
+    if args.telemetry:
+        from paddle_tpu.telemetry.sink import JsonlSink
+        sink = JsonlSink(args.telemetry)
+        for info in infos:
+            sink.write(_info_to_record(info, VMEM_BUDGET))
+        sink.close()
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"report: {args.report}")
+    if findings:
+        print(f"kernel doctor: {len(findings)} finding(s)")
+        print(analysis.format_findings(findings))
+        return 12
+    print(f"kernel doctor: {len(infos)} registered kernels clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Broken-kernel specimen: an OVER-VMEM BlockSpec (kerneldoctor
+--selfcheck).
+
+An elementwise kernel whose [2048, 1024] f32 blocks are 8 MiB each:
+double-buffered in+out that is 32 MiB of VMEM against the ~10 MiB
+per-core budget. The kernel runs fine in interpret mode (and would
+"work" right up until Mosaic rejects or spills it on real hardware at
+scale) — the Kernel Doctor must reject it statically: KN502 projects
+blocks x dtypes x double-buffering through the shared
+kernel_registry.vmem_footprint model and names this kernel.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.kernel_registry import KernelRegistry, register_kernel
+
+SPECIMENS = KernelRegistry()
+
+_BR, _BC = 2048, 1024   # 8 MiB per f32 block — far past the budget
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _example(rng):
+    x = rng.standard_normal((2 * _BR, _BC)).astype(np.float32)
+    return (x,), {}
+
+
+def _fallback(x):
+    return x * 2.0
+
+
+@register_kernel("specimen_overvmem_block", example=_example,
+                 fallback=_fallback, tol=(1e-6, 1e-6),
+                 registry=SPECIMENS,
+                 notes="8 MiB blocks: 32 MiB double-buffered footprint")
+def overvmem_scale(x):
+    r, c = x.shape
+    return pl.pallas_call(
+        _kernel,
+        grid=(r // _BR,),
+        in_specs=[pl.BlockSpec((_BR, _BC), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_BR, _BC), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, c), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(x)

@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
-    n_serving_records, problems).
+    n_serving_records, n_kernel_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -34,9 +34,9 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics "
-                                            "file (0 bytes): no step "
-                                            "was ever recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics "
+                                               "file (0 bytes): no step "
+                                               "was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -47,7 +47,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -60,6 +60,7 @@ def check_metrics_jsonl(path):
     problems += check_elastic_records(records, path)
     problems += check_moe_records(records, path)
     problems += check_serving_records(records, path)
+    problems += check_kernel_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -74,8 +75,11 @@ def check_metrics_jsonl(path):
                     if isinstance(r, dict) and r.get("kind") == "elastic")
     n_serving = sum(1 for r in records
                     if isinstance(r, dict) and r.get("kind") == "serving")
+    n_kernel = sum(1 for r in records
+                   if isinstance(r, dict)
+                   and r.get("kind") == "kernel_lint")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
-            n_elastic, n_serving, problems)
+            n_elastic, n_serving, n_kernel, problems)
 
 
 def check_compile_records(records, path):
@@ -405,6 +409,68 @@ def check_moe_records(records, path):
     return problems
 
 
+# kernel_lint record thresholds — mirror analysis/kernel_lint.py's
+# COST_DRIFT_FRAC/COST_FLOPS_FLOOR (the KN503 rule) the same way
+# PLAN_DRIFT_FRAC mirrors the PR-4 hbm rule: the ledger validator must
+# agree with the tool that wrote the ledger about what "drifted" means
+KERNEL_DRIFT_FRAC = 0.25
+KERNEL_FLOPS_FLOOR = 1_000_000
+
+
+def check_kernel_records(records, path):
+    """Cross-record rules for Kernel Doctor results (kind=kernel_lint,
+    analysis/kernel_lint via tools/kerneldoctor.py; per-record schema —
+    findings list shape, KN rule vocabulary, n_findings agreement —
+    lives in sink.validate_step_record):
+
+    - a record whose own numbers show a VMEM projection over its
+      recorded budget must carry a KN502 finding — a ledger that
+      writes down the overflow but claims the kernel is clean is
+      doctored or the lint that produced it never looked;
+    - a record whose declared-vs-counted FLOPs drift exceeds the KN503
+      threshold must carry a KN503 finding, same reasoning;
+    - the same kernel must not appear both clean and with findings in
+      one ledger (rank-disambiguated): one of the two runs is stale.
+    """
+    problems = []
+    verdicts = {}
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "kernel_lint":
+            continue
+        rules = {f.get("rule") for f in rec.get("findings", [])
+                 if isinstance(f, dict)}
+        vmem = rec.get("vmem_bytes")
+        budget = rec.get("vmem_budget")
+        if isinstance(vmem, (int, float)) and \
+                isinstance(budget, (int, float)) and vmem > budget \
+                and "KN502" not in rules:
+            problems.append(
+                f"{path}:{i + 1}: kernel {rec.get('kernel')!r} records "
+                f"vmem_bytes {vmem} over its budget {budget} with no "
+                "KN502 finding — the projection and the verdict "
+                "disagree")
+        d = rec.get("flops_declared")
+        c = rec.get("flops_counted")
+        if isinstance(d, (int, float)) and isinstance(c, (int, float)):
+            drift = abs(d - c)
+            if drift > max(KERNEL_DRIFT_FRAC * max(d, c),
+                           KERNEL_FLOPS_FLOOR) and "KN503" not in rules:
+                problems.append(
+                    f"{path}:{i + 1}: kernel {rec.get('kernel')!r} "
+                    f"records declared flops {d} vs counted {c} "
+                    f"(drift past {KERNEL_DRIFT_FRAC * 100:.0f}%) with "
+                    "no KN503 finding")
+        key = (rec.get("rank", 0), rec.get("kernel"))
+        clean = rec.get("n_findings") == 0
+        if key in verdicts and verdicts[key][1] != clean:
+            problems.append(
+                f"{path}:{i + 1}: kernel {rec.get('kernel')!r} appears "
+                f"both clean and with findings (line "
+                f"{verdicts[key][0]}) — one verdict is stale")
+        verdicts[key] = (i + 1, clean)
+    return problems
+
+
 # the serving-lifecycle event families (paddle_tpu.serving; per-record
 # schema lives in sink.validate_step_record)
 _SERVING_TERMINAL = ("finished", "failed", "cancelled", "expired")
@@ -530,12 +596,12 @@ def check_pair(jsonl_path, trace_path=None):
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
-     n_serving, problems) = check_metrics_jsonl(jsonl_path)
+     n_serving, n_kernel, problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
              "n_elastic": n_elastic, "n_serving": n_serving,
-             "n_events": 0, "ranks": set()}
+             "n_kernel": n_kernel, "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -585,6 +651,8 @@ def main(argv):
         msg += f" ({stats['n_elastic']} elastic events)"
     if stats.get("n_serving"):
         msg += f" ({stats['n_serving']} serving events)"
+    if stats.get("n_kernel"):
+        msg += f" ({stats['n_kernel']} kernel-lint records)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
