@@ -19,6 +19,12 @@ sustain while every request still meets its latency SLO. Method:
    `serving.throughput_tokens_per_sec` (+ its percentiles);
    `serving.throughput_vs_single` is the continuous-batching win over
    the sequential predictor.
+4. **shared-prefix sweep** — N templated requests (>= 50% shared
+   tokens) through a WARM prefix-cache engine vs a cold-cache control
+   with bit-identical streams required: TTFT p50/p99, warm-vs-cold p50
+   speedup, hit rate, and tokens saved over the offered prompt-token
+   volume (`serving.prefill_tokens_offered` is the denominator that
+   makes `tokens_saved` auditable).
 
 Every tracked scalar is emitted as a typed kind=bench record
 (telemetry.sink.SERVING_BENCH_METRICS) into the telemetry JSONL, so
@@ -77,6 +83,99 @@ def serve_level(engine, prompts, max_new, level):
         "ttft_p99_ms": _percentile(ttft, 99),
         "tpot_p50_ms": _percentile(tpot, 50),
         "tpot_p99_ms": _percentile(tpot, 99),
+    }
+
+
+def shared_prefix_phase(model, on_tpu, seed=0, n_requests=None):
+    """Shared-prefix sweep: N requests over K prompt templates through
+    a WARM prefix-cache engine vs a cold-cache control engine.
+
+    Real serving traffic shares most prompt tokens across requests
+    (system prompts, few-shot templates, multi-turn chat); this phase
+    measures what the prefix cache buys on exactly that shape: >= 50%
+    of each prompt is a shared template, the cache is warmed with one
+    short request per template (both engines pay the same warmup, so
+    the comparison isolates CACHING, not compilation), then the same
+    seeded request wave runs through both. Reports TTFT p50/p99 (warm),
+    the warm-vs-cold p50 speedup, hit rate, tokens saved / offered /
+    recomputed-per-request — and asserts the token streams are
+    IDENTICAL between the two engines (sharing must be invisible in
+    the output or it is corruption, not caching).
+
+    Deterministic per seed: prompts, schedule, and hit accounting all
+    derive from the seeded generator over a single-threaded engine
+    loop, so two runs return identical streams and counters.
+    """
+    from paddle_tpu.serving import (EngineConfig, SamplingParams,
+                                    ServingEngine)
+
+    if on_tpu:
+        tpl_len, tail_len, max_new = 96, 32, 16
+        n_requests = n_requests or 32
+        kw = dict(max_slots=8, block_size=16, prefill_chunk=32,
+                  max_model_len=256)
+    else:
+        tpl_len, tail_len, max_new = 24, 8, 4
+        n_requests = n_requests or 16
+        kw = dict(max_slots=4, block_size=8, prefill_chunk=8,
+                  max_model_len=64)
+    vocab = model.config.vocab_size
+    rs = np.random.RandomState(seed)
+    templates = [rs.randint(0, vocab, (tpl_len,)).tolist()
+                 for _ in range(2)]
+    prompts = [templates[i % 2]
+               + rs.randint(0, vocab, (tail_len,)).tolist()
+               for i in range(n_requests)]
+
+    def run(enable):
+        engine = ServingEngine(model, config=EngineConfig(
+            enable_prefix_cache=enable, **kw))
+        # same warmup both sides: compiles the step functions and (warm
+        # engine only) seeds the index with each template's blocks
+        for tpl in templates:
+            engine.submit(tpl, SamplingParams(max_new_tokens=2))
+        engine.run_until_idle()
+        before = engine.prefix_stats()
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, SamplingParams(max_new_tokens=max_new))
+                   for p in prompts]
+        engine.run_until_idle()
+        dt = max(1e-9, time.perf_counter() - t0)
+        streams = [h.output_tokens for h in handles]
+        ttft = [h.stats["ttft_ms"] for h in handles
+                if h.stats["ttft_ms"] is not None]
+        after = engine.prefix_stats()
+        stats = {k: after[k] - before[k]
+                 for k in ("tokens_saved", "tokens_offered", "hits",
+                           "lookups")}
+        return streams, ttft, stats, dt
+
+    warm_streams, warm_ttft, stats, warm_dt = run(True)
+    cold_streams, cold_ttft, _, cold_dt = run(False)
+    identical = warm_streams == cold_streams
+    offered = stats["tokens_offered"]
+    saved = stats["tokens_saved"]
+    warm_p50 = _percentile(warm_ttft, 50)
+    cold_p50 = _percentile(cold_ttft, 50)
+    return {
+        "serving.prefix_hit_rate":
+            round(saved / offered, 4) if offered else 0.0,
+        "serving.prefill_tokens_saved": saved,
+        "serving.prefill_tokens_offered": offered,
+        "serving.prefix_ttft_p50_ms": _r2(warm_p50),
+        "serving.prefix_ttft_p99_ms": _r2(_percentile(warm_ttft, 99)),
+        "serving.prefix_ttft_speedup":
+            round(cold_p50 / warm_p50, 3)
+            if warm_p50 and cold_p50 else None,
+        "serving.prefix_tokens_recomputed_per_request":
+            round((offered - saved) / len(prompts), 2),
+        "prefix_streams_identical": identical,
+        "prefix_requests": len(prompts),
+        "prefix_hits": stats["hits"],
+        "prefix_cold_ttft_p50_ms": _r2(cold_p50),
+        "prefix_warm_s": round(warm_dt, 3),
+        "prefix_cold_s": round(cold_dt, 3),
+        "_streams": warm_streams,
     }
 
 
@@ -185,6 +284,18 @@ def main(argv=None):
                   file=sys.stderr)
             level *= 2
 
+        # shared-prefix sweep: warm prefix-cache engine vs cold-cache
+        # control over templated prompts (>= 50% shared tokens)
+        prefix = shared_prefix_phase(model, on_tpu)
+        print(f"# shared-prefix: hit_rate {prefix['serving.prefix_hit_rate']} "
+              f"ttft_p50 {_fmt(prefix['serving.prefix_ttft_p50_ms'])}ms "
+              f"(cold {_fmt(prefix['prefix_cold_ttft_p50_ms'])}ms, "
+              f"speedup {prefix['serving.prefix_ttft_speedup']}x), "
+              f"saved {prefix['serving.prefill_tokens_saved']}/"
+              f"{prefix['serving.prefill_tokens_offered']} tokens, "
+              f"streams_identical={prefix['prefix_streams_identical']}",
+              file=sys.stderr)
+
     within = [s for s in levels
               if s["ttft_p99_ms"] is not None
               and s["ttft_p99_ms"] <= slo_ttft
@@ -216,12 +327,16 @@ def main(argv=None):
             round(engine.kv_peak_utilization, 4),
         "levels": levels,
     }
+    summary.update({k: v for k, v in prefix.items()
+                    if not k.startswith("_")})
 
     # typed records: the declared serving family, one record each —
     # tools/bench_gate.py's unit of account from round r06 on
     from paddle_tpu.telemetry.sink import SERVING_BENCH_METRICS
     units = {"tokens_per_sec": "tokens/sec", "_ms": "ms",
-             "vs_single": "x", "requests": "requests",
+             "vs_single": "x", "speedup": "x", "hit_rate": "frac",
+             "recomputed": "tokens", "tokens_saved": "tokens",
+             "tokens_offered": "tokens", "requests": "requests",
              "preemptions": "preemptions", "utilization": "frac"}
 
     def unit_of(name):
@@ -257,6 +372,11 @@ def main(argv=None):
           f"({summary['serving.throughput_vs_single']}x), "
           f"slo_met={summary['slo_met']}", file=sys.stderr)
 
+    if not prefix["prefix_streams_identical"]:
+        print("FAIL: shared-prefix streams diverged from the "
+              "cold-cache control — prefix sharing corrupted a stream",
+              file=sys.stderr)
+        return 4
     if args.check_vs_single is not None and \
             summary["serving.throughput_vs_single"] < args.check_vs_single:
         print(f"FAIL: throughput_vs_single "

@@ -29,7 +29,9 @@ class Config:
       the accelerator and budgets N MB of paged KV cache, and
       `enable_tensorrt_engine(precision_mode=...)` picks the decode
       precision (Int8 -> weight-only-int8 W8A16, Half/Bfloat16 -> bf16
-      compute, Float32 -> the params' dtype);
+      compute, Float32 -> the params' dtype), and
+      `enable_prefix_cache(flag)` toggles prefix-sharing KV block
+      reuse across requests (default on);
     - graph-pipeline toggles (MKLDNN, IR passes, memory optim) still
       have no effect — XLA owns those — and each emits a UserWarning
       saying so instead of being silently swallowed."""
@@ -40,6 +42,7 @@ class Config:
         self._use_tpu = True
         self._memory_pool_mb = 0
         self._serving_precision = None
+        self._prefix_cache = True
 
     @staticmethod
     def _ignored(switch, why):
@@ -82,6 +85,20 @@ class Config:
             "is routed to the serving engine's decode dtype (Int8 -> "
             "weight-only int8 W8A16, Half/Bfloat16 -> bf16, Float32 -> "
             "param dtype); other kwargs are ignored",
+            UserWarning, stacklevel=2)
+
+    def enable_prefix_cache(self, flag=True):
+        """Toggle prefix-sharing KV block reuse in the serving engine
+        (copy-on-write sharing of cached prompt-prefix blocks across
+        requests). Default ON; disabling makes the engine bit-match
+        the cold-cache path."""
+        self._prefix_cache = bool(flag)
+        warnings.warn(
+            "Config.enable_prefix_cache: routed to the serving engine "
+            f"(EngineConfig.from_inference_config -> enable_prefix_cache"
+            f"={bool(flag)}): prefix-sharing KV block reuse across "
+            "requests with copy-on-write semantics; the classic "
+            "Predictor path has no KV cache to share",
             UserWarning, stacklevel=2)
 
     def enable_mkldnn(self):

@@ -25,6 +25,16 @@ by exp(m_prev - m_new) per tile), so arbitrary cache lengths and
 The reference's fused attention loops key tiles the same way
 (`paddle/fluid/operators/fused/fmha_ref.h`).
 
+Besides the dense (`decode_attention`) and paged (`paged_decode_attention`)
+q_len==1 kernels, this module carries `flash_prefill_chunk`: the
+serving engine's chunked-prefill attention over the paged arena —
+flash-style online softmax across table-resolved blocks (the
+[chunk, ctx] score matrix never materializes), causal within the
+chunk, with a gather+dense fallback that reproduces the composed
+einsum math bit-for-bit so CPU serving stays identical to
+run_generate. Its q-side tiling follows ops/pallas_attention.py's
+flash forward; its paging follows the paged decode kernel.
+
 Inference-only (no vjp) — training uses the flash-attention kernel.
 """
 import functools
@@ -344,6 +354,197 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, ctx_lens,
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
       q, k_pages, v_pages, sm, em)
+    return out.astype(q.dtype)
+
+
+def _prefill_kernel(tab_ref, p0_ref, q_ref, k_ref, v_ref, out_ref,
+                    m_sc, l_sc, acc_sc, *, scale, bs, nl, C):
+    """Flash chunked-prefill attention over the paged arena: grid
+    (head, logical block). The chunk's C queries attend to every cached
+    block reachable through the scalar-prefetched block table with
+    ONLINE softmax (running per-row max/denominator in VMEM scratch),
+    causal within the chunk via logical positions — the full
+    [chunk, ctx] score matrix never exists. Blocks wholly past the
+    chunk's last query are skipped: every row of their score tile would
+    be masked, and a fully-masked tile at running max -1e30 would turn
+    exp(s - m) into ones and corrupt the denominator (block 0 is never
+    fully masked — key position 0 is <= every query position)."""
+    li = pl.program_id(1)
+    p0 = p0_ref[0]
+
+    @pl.when(li == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(li * bs <= p0 + C - 1)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)                # [C, H]
+        k = k_ref[0].astype(jnp.float32)                # [bs, H]
+        v = v_ref[0].astype(jnp.float32)                # [bs, H]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [C, bs]
+        kpos = li * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (C, bs), 1)
+        qpos = p0 + jax.lax.broadcasted_iota(jnp.int32, (C, bs), 0)
+        s = jnp.where(kpos <= qpos, s, -1e30)
+        m_prev = m_sc[:, :1]                            # [C, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                 # [C, 1]
+        p = jnp.exp(s - m_new)                          # [C, bs]
+        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [C, H]
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(li == nl - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = acc_sc[:] / l_safe
+
+
+def flash_prefill_supported(block_size, chunk, hidden, n_heads,
+                            itemsize=2):
+    """Gate for the fused flash prefill-chunk kernel: TPU tiling
+    constraints on the per-head tiles plus the KN502 VMEM projection
+    via the shared kernel_registry model (q/k/v/out blocks moving,
+    online-softmax scratch resident, f32 casts + the [C, bs] score
+    tile as temps)."""
+    if hidden % n_heads:
+        return False
+    H = hidden // n_heads
+    if block_size % 8 or chunk % 8 or H % 8:
+        return False
+    return vmem_footprint(
+        moving=[((1, chunk, H), itemsize),
+                ((1, block_size, H), itemsize),
+                ((1, block_size, H), itemsize),
+                ((1, chunk, H), 4)],
+        scratch=[((chunk, _COLS), 4), ((chunk, _COLS), 4),
+                 ((chunk, H), 4)],
+        temp_bytes=(chunk * H + 2 * block_size * H
+                    + 2 * chunk * block_size) * 4) <= _VMEM_BUDGET
+
+
+def _prefill_example(rng):
+    """Randomized in-support prefill-chunk config (kernel_lint KN504):
+    a chunk resuming at a random offset over a small paged arena."""
+    N, H = 4, 32
+    nh = N * H
+    bs = 16
+    C = 16
+    mb = int(rng.integers(2, 4))
+    num_blocks = mb + 2
+    p0 = np.int32(rng.integers(0, mb * bs - C + 1))
+    table_row = np.arange(1, mb + 1, dtype=np.int32)
+    q = 0.1 * rng.standard_normal((1, C, nh)).astype(np.float32)
+    kp = 0.1 * rng.standard_normal((num_blocks, bs, nh)).astype(np.float32)
+    vp = 0.1 * rng.standard_normal((num_blocks, bs, nh)).astype(np.float32)
+    return (q, kp, vp, table_row, p0, N), {"use_kernel": True}
+
+
+def _prefill_fallback(q, k_pages, v_pages, table_row, p0, n_heads,
+                      use_kernel=None):
+    # the in-function gather+dense path IS the declared exact fallback
+    return flash_prefill_chunk(q, k_pages, v_pages, table_row, p0,
+                               n_heads, use_kernel=False)
+
+
+@register_kernel(
+    "flash_prefill_chunk", example=_prefill_example,
+    fallback=_prefill_fallback, tol=(1e-3, 1e-3),
+    notes="paged flash prefill chunk: online softmax across "
+          "table-resolved blocks, causal within the chunk; the "
+          "logical-block axis carries the running softmax state and "
+          "must stay sequential (KN501)")
+def flash_prefill_chunk(q, k_pages, v_pages, table_row, p0, n_heads,
+                        use_kernel=None):
+    """Chunked-prefill attention over a PAGED KV cache.
+
+    q [1, C, N*H] — the chunk's queries at positions p0..p0+C-1;
+    k_pages/v_pages [num_blocks, block_size, N*H] — the shared
+    physical arenas, already holding this chunk's own K/V (callers
+    write before attending); table_row [max_blocks] int32 — ONE
+    request's logical->physical block map (unallocated tail entries
+    point at the reserved null block 0); p0 scalar int32 — the chunk's
+    first position (a TRACED scalar: prefix-cache hits resume prefill
+    at arbitrary offsets without widening the compile-signature
+    family). Returns [1, C, N*H] in q's dtype.
+
+    Two paths, one contract:
+    - fused Pallas kernel (TPU + `flash_prefill_supported`): physical
+      blocks stream through VMEM via the scalar-prefetched table, the
+      softmax accumulates online per head — the [C, ctx] score matrix
+      is never materialized (Sarathi-style compute-dense prefill
+      chunks over a paged arena);
+    - gather+dense fallback everywhere else: gather the pages into a
+      dense [1, L, N, H] view and run the SAME composed masked einsum
+      math as models/gpt._cached_attention's prefill branch, so a CPU
+      serving engine stays bit-identical to `run_generate`.
+    """
+    one, C, nh = q.shape
+    if one != 1:
+        raise ValueError("flash_prefill_chunk takes one request's chunk")
+    N = n_heads
+    H = nh // N
+    num_blocks, bs, _ = k_pages.shape
+    mb = table_row.shape[0]
+    scale = 1.0 / float(np.sqrt(H))
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and flash_prefill_supported(
+                          bs, C, nh, N, k_pages.dtype.itemsize))
+    if not use_kernel:
+        # gather+dense: EXACTLY the composed einsum prefill math of
+        # models/gpt._cached_attention over the gathered pages —
+        # bit-parity with the dense path keeps CPU engine streams
+        # token-identical to run_generate
+        L = mb * bs
+        k4 = k_pages[table_row].reshape(1, L, N, H)
+        v4 = v_pages[table_row].reshape(1, L, N, H)
+        logits = jnp.einsum("bqnh,bknh->bnqk", q.reshape(1, C, N, H),
+                            k4.astype(q.dtype),
+                            preferred_element_type=jnp.float32) * scale
+        key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
+        q_pos = (p0 + jnp.arange(C, dtype=jnp.int32))[None, None, :, None]
+        logits = jnp.where(key_pos <= q_pos, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bnqk,bknh->bqnh", probs, v4.astype(q.dtype))
+        return out.reshape(1, C, nh)
+
+    p0_arr = jnp.asarray(p0, jnp.int32).reshape((1,))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N, mb),
+        in_specs=[
+            pl.BlockSpec((1, C, H), lambda n, i, tab, p0r: (0, 0, n)),
+            pl.BlockSpec((1, bs, H),
+                         lambda n, i, tab, p0r: (tab[i], 0, n)),
+            pl.BlockSpec((1, bs, H),
+                         lambda n, i, tab, p0r: (tab[i], 0, n)),
+        ],
+        out_specs=pl.BlockSpec((1, C, H),
+                               lambda n, i, tab, p0r: (0, 0, n)),
+        scratch_shapes=[
+            pltpu.VMEM((C, _COLS), jnp.float32),
+            pltpu.VMEM((C, _COLS), jnp.float32),
+            pltpu.VMEM((C, H), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, scale=scale, bs=bs, nl=mb,
+                          C=C),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, C, nh), jnp.float32),
+        interpret=_interpret(),
+    )(table_row.astype(jnp.int32), p0_arr, q, k_pages, v_pages)
     return out.astype(q.dtype)
 
 

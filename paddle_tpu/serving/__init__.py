@@ -5,8 +5,10 @@ that serves many concurrent generation streams from ONE compiled
 decode step over a paged KV cache, instead of one run_generate program
 per request.
 
-- `kv_cache` — block-pool allocator + paged K/V arenas
-  ([num_blocks, block_size, hidden] per layer; PagedAttention layout).
+- `kv_cache` — refcounted block-pool allocator + paged K/V arenas
+  ([num_blocks, block_size, hidden] per layer; PagedAttention layout)
+  + `PrefixIndex`, the block-granular radix index that lets requests
+  share cached prompt-prefix blocks copy-on-write (RadixAttention).
 - `scheduler` — token-granular continuous batching: admit/evict at
   every step, chunked prefill interleaved with decode, preemption by
   recompute (Orca/vLLM scheduling).
@@ -34,7 +36,9 @@ kind=bench `serving.*` records gated by tools/bench_gate.py); smoked in
 CI by `tools/serving_smoke.py` (token parity with run_generate +
 eviction selfcheck).
 """
-from .kv_cache import BlockLeakError, BlockPool, PagedKVCache  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    BlockLeakError, BlockPool, PagedKVCache, PrefixIndex,
+    StaleIndexError)
 from .resilience import (  # noqa: F401
     AdmissionController, Deadlines, DeadlineExceededError,
     EngineDeadError, EngineDrainingError, EngineStoppedError,
@@ -45,7 +49,8 @@ from .engine import EngineConfig, ServingEngine  # noqa: F401
 from .http import ServingHTTPServer  # noqa: F401
 
 __all__ = [
-    "BlockPool", "BlockLeakError", "PagedKVCache", "Request",
+    "BlockPool", "BlockLeakError", "PagedKVCache", "PrefixIndex",
+    "StaleIndexError", "Request",
     "RequestHandle", "SamplingParams", "Scheduler", "EngineConfig",
     "ServingEngine", "ServingHTTPServer",
     "AdmissionController", "Deadlines", "ServingError", "ShedError",

@@ -40,9 +40,9 @@ from ..core import autograd
 from ..core.tensor import Tensor
 from ..generation import _cast_params
 from ..jit import bind_tensors
-from ..ops.pallas_decode import paged_decode_attention
+from ..ops.pallas_decode import flash_prefill_chunk, paged_decode_attention
 from ..resilience.retry import classify_failure
-from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache
+from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache, PrefixIndex
 from .resilience import (AdmissionController, DeadlineExceededError,
                          EngineDeadError, EngineDrainingError,
                          EngineStoppedError, RequestCancelledError,
@@ -68,7 +68,8 @@ class EngineConfig:
     def __init__(self, max_slots=4, block_size=16, num_blocks=None,
                  max_model_len=None, prefill_chunk=32, dtype="bfloat16",
                  weights="native", kv_memory_mb=None, device=None,
-                 max_queue=None, max_restarts=3, restart_backoff_s=1.0):
+                 max_queue=None, max_restarts=3, restart_backoff_s=1.0,
+                 enable_prefix_cache=True):
         if weights not in ("native", "wo8"):
             raise ValueError(f"weights must be 'native' or 'wo8', "
                              f"got {weights!r}")
@@ -81,6 +82,10 @@ class EngineConfig:
         self.weights = weights
         self.kv_memory_mb = kv_memory_mb
         self.device = device
+        # prefix-sharing KV cache (copy-on-write block reuse across
+        # requests). Default ON; off must bit-match the pre-sharing
+        # engine — the index is simply never consulted
+        self.enable_prefix_cache = bool(enable_prefix_cache)
         # resilience knobs: bounded waiting queue (None -> 16x slots),
         # warm-restart cap + backoff base for transient step faults
         self.max_queue = 16 * self.max_slots if max_queue is None \
@@ -101,11 +106,16 @@ class EngineConfig:
         - `enable_tensorrt_engine(precision_mode=...)` -> decode
           compute dtype: Int8 -> weight-only-int8 weights with bf16
           activations (the W8A16 serving recipe), Half/Bfloat16 ->
-          bf16, Float32 -> the parameters' own dtype.
+          bf16, Float32 -> the parameters' own dtype;
+        - `enable_prefix_cache(False)` -> disables prefix-sharing KV
+          block reuse (the engine then bit-matches the cold-cache
+          path).
         """
         kw = {}
         if not getattr(config, "_use_tpu", True):
             kw["device"] = jax.devices("cpu")[0]
+        kw["enable_prefix_cache"] = bool(
+            getattr(config, "_prefix_cache", True))
         pool_mb = getattr(config, "_memory_pool_mb", 0)
         if pool_mb:
             kw["kv_memory_mb"] = int(pool_mb)
@@ -169,8 +179,11 @@ class ServingEngine:
             self.cache = PagedKVCache(
                 mcfg.num_layers, num_blocks, self.block_size, self.hidden,
                 dtype=self._compute_dtype)
+        self.prefix_index = PrefixIndex(self.block_size, pool=self.pool) \
+            if cfg.enable_prefix_cache else None
         self.sched = Scheduler(self.pool, self.block_size, cfg.max_slots,
-                               self.max_model_len)
+                               self.max_model_len,
+                               prefix_index=self.prefix_index)
 
         named = list(model.named_parameters()) + [
             (n, b) for n, b in model.named_buffers() if b is not None]
@@ -197,6 +210,12 @@ class ServingEngine:
         self._lat_dirty = False
         self._finished = 0
         self.kv_peak_utilization = 0.0
+        # prefix-cache accounting: offered = positions each admission
+        # would have to prefill cold, saved = positions a cache hit
+        # covered instead (saved <= offered by construction — the
+        # trace_check cross-rule pins it)
+        self._prefix_stats = {"lookups": 0, "hits": 0,
+                              "tokens_saved": 0, "tokens_offered": 0}
         monitor.set_gauge("serving.kv_blocks_total", self.pool.capacity)
         monitor.set_gauge("serving.draining", 0)
         self._update_gauges()
@@ -344,9 +363,6 @@ class ServingEngine:
                     table_row[jnp.clip(positions // bs_blk, 0, mb - 1)],
                     NULL_BLOCK)
                 off = positions % bs_blk
-                N, H = n_heads, nh // n_heads
-                L = mb * bs_blk
-                scale = 1.0 / float(np.sqrt(H))
 
                 def write(kv, vv):
                     kp = k_pages_cur.at[blk, off].set(
@@ -356,22 +372,17 @@ class ServingEngine:
                     return kp, vp
 
                 def attend(qv, kp, vp):
-                    # composed masked attention over the gathered pages
-                    # — models/gpt._cached_attention's prefill math
-                    k4 = kp[table_row].reshape(1, L, N, H)
-                    v4 = vp[table_row].reshape(1, L, N, H)
-                    logits = jnp.einsum(
-                        "bqnh,bknh->bnqk", qv, k4.astype(qv.dtype),
-                        preferred_element_type=jnp.float32) * scale
-                    key_pos = jnp.arange(L, dtype=jnp.int32)[
-                        None, None, None, :]
-                    q_pos = positions[None, None, :, None]
-                    logits = jnp.where(key_pos <= q_pos, logits, _NEG_INF)
-                    probs = jax.nn.softmax(logits, axis=-1) \
-                        .astype(qv.dtype)
-                    out = jnp.einsum("bnqk,bknh->bqnh", probs,
-                                     v4.astype(qv.dtype))
-                    return out.reshape(1, C, nh)
+                    # flash chunked prefill over the paged arena: the
+                    # chunk's queries attend to cached blocks via the
+                    # block table with in-kernel online softmax (TPU),
+                    # never materializing the full [chunk, ctx] score
+                    # matrix; the gather+dense fallback reproduces
+                    # models/gpt._cached_attention's composed einsum
+                    # math exactly, so CPU serving stays bit-identical
+                    # to run_generate
+                    return flash_prefill_chunk(
+                        qv.reshape(1, C, nh), kp, vp, table_row, p0,
+                        n_heads)
 
                 new_k, new_v = [], []
                 for li, block in enumerate(core.blocks):
@@ -389,6 +400,15 @@ class ServingEngine:
                                    top_p[None], greedy[None])
             return tok[0], logp[0], tuple(new_k), tuple(new_v)
 
+        def fork_fn(k_pages, v_pages, src, dst):
+            """Copy-on-write fork: duplicate physical block `src` into
+            `dst` across every layer's arenas (all rows — positions the
+            forking request has not covered yet stay masked by its
+            context length until it overwrites them)."""
+            new_k = tuple(k.at[dst].set(k[src]) for k in k_pages)
+            new_v = tuple(v.at[dst].set(v[src]) for v in v_pages)
+            return new_k, new_v
+
         import functools
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
         self._decode_jit = jax.jit(
@@ -398,6 +418,10 @@ class ServingEngine:
             functools.partial(decode_fn, sampling=False),
             donate_argnums=donate)
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
+        self._fork_jit = jax.jit(
+            fork_fn,
+            donate_argnums=(0, 1) if jax.default_backend() == "tpu"
+            else ())
 
     def _dispatch(self, family, jitted, args):
         """Route through the PR-4 compile observatory when one is
@@ -498,6 +522,15 @@ class ServingEngine:
             now = time.monotonic()
             self._reap(now)
             admitted = self.sched.admit(now=now)
+            if self.prefix_index is not None:
+                ps = self._prefix_stats
+                for req in admitted:
+                    ps["lookups"] += 1
+                    ps["tokens_offered"] += len(req.tokens_all)
+                    if req.prefix_cached_tokens:
+                        ps["hits"] += 1
+                        ps["tokens_saved"] += req.prefix_cached_tokens
+                        monitor.incr("serving.prefix_hits")
             for req in admitted:
                 # sample only FIRST admissions (admit stamped them with
                 # this step's clock): a preempted/requeued request keeps
@@ -659,6 +692,13 @@ class ServingEngine:
         else:
             self.run_until_idle()
         completed = not self.sched.has_work()
+        if completed and self.prefix_index is not None:
+            # a drain precedes a restart or shutdown: the arenas (and
+            # their physical ids) do not survive it, so the index must
+            # not either — quiesce also proves zero retained blocks
+            with self._mu:
+                self.prefix_index.flush()
+                self._update_gauges()
         self._record("drain_end", completed=bool(completed),
                      drained_ms=(time.monotonic() - t0) * 1000.0)
         self.emit_quiesce()
@@ -677,9 +717,21 @@ class ServingEngine:
         tools/trace_check.py enforces it) plus the pool's allocation
         count (must be zero — a leak here is a dropped request)."""
         with self._mu:
+            ps = self._prefix_stats
+            offered = ps["tokens_offered"]
             self._record("quiesce", kv_blocks_used=self.pool.num_used,
                          queue_depth=len(self.sched.waiting),
-                         counts=dict(self._counts))
+                         counts=dict(self._counts),
+                         # prefix-cache audit: zero shared refs at
+                         # quiesce (all requests terminal -> nobody
+                         # references anything), hit-rate in [0, 1],
+                         # saved <= offered — trace_check cross-rules
+                         prefix_blocks_shared=self.pool.num_shared,
+                         prefix_hit_rate=(
+                             ps["tokens_saved"] / offered
+                             if offered else 0.0),
+                         prefill_tokens_saved=ps["tokens_saved"],
+                         prefill_tokens_offered=offered)
 
     def _serve_loop(self):
         while True:
@@ -712,9 +764,17 @@ class ServingEngine:
     def _rebuild_arenas(self):
         """Fresh pool + fresh K/V arenas: after a failed step the
         donated buffers are suspect, and every surviving request holds
-        zero blocks by construction (failed or requeued)."""
+        zero blocks by construction (failed or requeued). The prefix
+        index MUST flush and rebind here — its physical block ids name
+        the old arenas' storage, and a stale entry surviving a rebuild
+        would splice garbage K/V into a later request's attention
+        (tools/serving_smoke.py --selfcheck proves the tripwire)."""
+        if self.prefix_index is not None:
+            self.prefix_index.flush()
         self.pool = BlockPool(self.pool.num_blocks)
         self.sched.pool = self.pool
+        if self.prefix_index is not None:
+            self.prefix_index.bind(self.pool)
         with jax.default_device(self.cfg.device) \
                 if self.cfg.device is not None \
                 else contextlib.nullcontext():
@@ -797,6 +857,48 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # device-step drivers
     # ------------------------------------------------------------------
+    def _cow_fork(self, req, bi, evict=True):
+        """Copy-on-write: make `req.blocks[bi]` safe to write. A block
+        another request (or the prefix index) can read must never be
+        mutated — fork it into a fresh private block (device-side row
+        copy), swap the table entry, and drop this request's reference
+        to the shared original. Block acquisition follows the
+        `ensure_blocks` reclaim ladder — index leaves first (re-tried
+        every round: preemption itself parks victims' index-registered
+        blocks at refcount 0, making them evictable), then preemption
+        only when `evict` allows it (the prefill path passes its own
+        no-evict-while-decoding policy through, so a fork can never
+        thrash the decode batch where chunk growth could not). Returns
+        False when the chunk must wait (or the request yielded its own
+        place and will replay)."""
+        pool = self.sched.pool
+        old = req.blocks[bi]
+        if pool.is_private(old, req.rid):
+            return True
+        while True:
+            got = pool.alloc(1, owner=req.rid)
+            if got is not None:
+                break
+            if self.prefix_index is not None and \
+                    self.prefix_index.evict(1, pool):
+                continue
+            if not evict:
+                return False                # wait for free blocks
+            victim = self.sched._pick_victim(exclude=req)
+            if victim is None:
+                self.sched.preempt(req)     # yield; replay re-matches
+                return False
+            self.sched.preempt(victim)
+        new = got[0]
+        args = (self.cache.k, self.cache.v, np.int32(old), np.int32(new))
+        new_k, new_v = self._dispatch("serving_fork", self._fork_jit,
+                                      args)
+        self.cache.swap(new_k, new_v)
+        pool.free([old], owner=req.rid)
+        req.blocks[bi] = new
+        monitor.incr("serving.prefix_cow_forks")
+        return True
+
     def _prefill_one(self):
         sched = self.sched
         # prefill growth normally WAITS for blocks instead of evicting
@@ -815,6 +917,15 @@ class ServingEngine:
             if not sched.ensure_blocks(req, p0 + c_real,
                                        evict=allow_evict and idx == 0):
                 continue                        # wait for free blocks
+            # a prefix hit may resume INSIDE a shared block (partial
+            # tail): fork before the chunk writes into it. Blocks past
+            # p0's are freshly allocated, so one check suffices; the
+            # fork obeys the same no-evict-while-decoding policy as the
+            # chunk's own block growth above
+            bi = p0 // self.block_size
+            if bi < len(req.blocks) and not self._cow_fork(
+                    req, bi, evict=allow_evict and idx == 0):
+                continue                        # wait / yielded
             C = self.cfg.prefill_chunk
             ids = np.zeros((1, C), np.int32)
             ids[0, :c_real] = seq[p0:p0 + c_real]
@@ -834,6 +945,10 @@ class ServingEngine:
             monitor.incr("serving.prefill_chunks")
             req.n_prefilled = p0 + c_real
             if req.n_prefilled >= len(seq):
+                # full prompt K/V now lives in this request's blocks:
+                # publish the FULL prompt blocks to the prefix index so
+                # later requests with the same prefix skip recomputing
+                sched.note_prefill_done(req)
                 # final chunk: the sampled token is the next stream token
                 # (the engine IS the API boundary: tokens must land on
                 # the host to stream; the second fetch copies a buffer
@@ -852,6 +967,11 @@ class ServingEngine:
             if req.slot is None:
                 continue
             sched.ensure_blocks(req, req.n_prefilled + 1, evict=True)
+            # decode writes position n_prefilled: defensively fork a
+            # still-shared tail (normally prefill already forked it)
+            bi = req.n_prefilled // self.block_size
+            if req.slot is not None and bi < len(req.blocks):
+                self._cow_fork(req, bi)
         active = [(i, r) for i, r in enumerate(sched.running)
                   if r is not None]
         if not active:
@@ -970,6 +1090,18 @@ class ServingEngine:
         monitor.set_gauge("serving.running", self.sched.num_running())
         monitor.set_gauge("serving.prefilling", len(self.sched.prefilling))
         monitor.set_gauge("serving.kv_blocks_used", self.pool.num_used)
+        ps = self._prefix_stats
+        offered = ps["tokens_offered"]
+        monitor.set_gauge("serving.prefix_hit_rate",
+                          ps["tokens_saved"] / offered if offered
+                          else 0.0)
+        monitor.set_gauge("serving.prefix_blocks_shared",
+                          self.pool.num_shared)
+        monitor.set_gauge("serving.prefix_blocks_cached",
+                          self.pool.num_cached)
+        monitor.set_gauge("serving.prefill_tokens_saved",
+                          ps["tokens_saved"])
+        monitor.set_gauge("serving.prefill_tokens_offered", offered)
         util = self.pool.utilization()
         monitor.set_gauge("serving.kv_block_utilization", util)
         self.kv_peak_utilization = max(self.kv_peak_utilization, util)
@@ -987,6 +1119,19 @@ class ServingEngine:
                                       float(np.percentile(vals, 50)))
                     monitor.set_gauge(p99_name,
                                       float(np.percentile(vals, 99)))
+
+    def prefix_stats(self):
+        """Snapshot of the prefix-cache accounting: lookups, hits,
+        tokens saved/offered, hit_rate (saved / offered), and the
+        pool's current shared/cached block counts."""
+        with self._mu:
+            ps = dict(self._prefix_stats)
+            offered = ps["tokens_offered"]
+            ps["hit_rate"] = ps["tokens_saved"] / offered \
+                if offered else 0.0
+            ps["blocks_shared"] = self.pool.num_shared
+            ps["blocks_cached"] = self.pool.num_cached
+            return ps
 
     def metrics_snapshot(self):
         """Point-in-time serving stats (the /metrics serving.* family,

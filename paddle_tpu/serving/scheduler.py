@@ -16,6 +16,18 @@ request frees its blocks and re-queues; its streamed tokens are kept
 and re-prefilled, so per-token RNG indexing keeps the stream
 deterministic across evictions). Device work — the compiled prefill and
 decode steps — lives in engine.py.
+
+Prefix sharing (the RadixAttention move): when a `PrefixIndex` is
+attached, every admission matches the request's tokens against the
+cached prefixes, increfs the hit blocks straight into the request's
+block table, and sets `n_prefilled` to the first uncached token — the
+engine's prefill then simply resumes from there (the chunk offset was
+already a traced scalar, so resuming mid-prompt costs no recompile).
+Block reclaim is layered: allocation failure first evicts LRU
+refcount-0 index leaves (cache, free to drop), and only then falls
+back to evict-by-recompute preemption, which by construction releases
+only the victim's OWN references — a shared block survives its
+sharers' preemption at refcount > 0, a cached one parks at refcount 0.
 """
 import itertools
 import queue
@@ -95,6 +107,7 @@ class Request:
         self.out_tokens = []                # streamed tokens, in order
         self.n_prefilled = 0                # cache positions written
         self.blocks = []                    # physical block ids (in order)
+        self.prefix_cached_tokens = 0       # positions covered by a hit
         self.slot = None                    # decode batch slot, when RUNNING
         self.preemptions = 0
         self.error = None
@@ -266,11 +279,13 @@ class Scheduler:
       through `finish`, which releases both.
     """
 
-    def __init__(self, pool, block_size, max_slots, max_model_len):
+    def __init__(self, pool, block_size, max_slots, max_model_len,
+                 prefix_index=None):
         self.pool = pool
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
         self.max_model_len = int(max_model_len)
+        self.prefix_index = prefix_index   # kv_cache.PrefixIndex or None
         self.waiting = []                  # by class, FIFO within a class
         self.prefilling = []               # admitted, mid-prefill
         self.running = [None] * self.max_slots
@@ -325,10 +340,30 @@ class Scheduler:
         admitted = []
         while self.waiting and \
                 self.num_running() + len(self.prefilling) < self.max_slots:
-            req = self.waiting.pop(0)
+            req = self.waiting[0]
+            blocks, cached = [], 0
+            if self.prefix_index is not None:
+                # match the FULL replay sequence (prompt + any streamed
+                # tokens a preempted request must re-prefill) so a
+                # recompute-replay rides the cache exactly like a fresh
+                # admission; the index caps the hit at len-1 so at
+                # least one position is computed live for the logits.
+                # Matched BEFORE the pop: if the index is stale
+                # (StaleIndexError — an arena rebuild forgot to flush)
+                # the request stays queued, reapable and requeue-able,
+                # instead of vanishing from every queue mid-admission
+                blocks, cached = self.prefix_index.match(
+                    req.tokens_all, self.pool)
+            self.waiting.pop(0)
             req.state = PREFILL
             req.n_prefilled = 0
             req.blocks = []
+            req.prefix_cached_tokens = 0
+            if cached:
+                self.pool.incref(blocks, owner=req.rid)
+                req.blocks = list(blocks)
+                req.n_prefilled = cached
+                req.prefix_cached_tokens = cached
             if req.admit_time is None:      # requeues keep the first
                 req.admit_time = now if now is not None \
                     else time.monotonic()
@@ -374,6 +409,14 @@ class Scheduler:
             if got is not None:
                 req.blocks.extend(got)
                 return True
+            # reclaim prefix-cache before touching anyone's work: LRU
+            # refcount-0 index leaves are pure cache (recomputable from
+            # tokens), while preemption throws away live progress
+            if self.prefix_index is not None and \
+                    self.prefix_index.evict(
+                        need - len(req.blocks) - self.pool.num_free,
+                        self.pool):
+                continue
             if not evict:
                 return False
             victim = self._pick_victim(exclude=req)
@@ -400,7 +443,11 @@ class Scheduler:
         warm-restart requeue all go through it, which is what makes
         `BlockPool.assert_quiesced` a meaningful invariant."""
         if req.blocks:
-            self.pool.free(req.blocks)
+            # drops THIS request's reference only: a prefix-shared
+            # block survives at refcount > 0, a cached one parks at
+            # refcount 0 under the index (preemption touches private
+            # blocks, never the shared cache)
+            self.pool.free(req.blocks, owner=req.rid)
             req.blocks = []
         if req.slot is not None:
             self.running[req.slot] = None
@@ -435,6 +482,18 @@ class Scheduler:
         req.preemptions += 1
         self.preemptions += 1
         monitor.incr("serving.preemptions")
+
+    def note_prefill_done(self, req):
+        """Prefill covered the whole sequence: register the request's
+        FULL prompt blocks with the prefix index (only positions
+        < len(prompt) are prompt K/V, and only full blocks are
+        immutable from here on — decode writes continue past them)."""
+        if self.prefix_index is None:
+            return
+        n_full = len(req.prompt) // self.block_size
+        if n_full:
+            self.prefix_index.insert(
+                req.prompt, req.blocks[:n_full], self.pool)
 
     def place(self, req):
         """Prefill complete -> take a decode slot."""

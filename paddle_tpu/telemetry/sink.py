@@ -376,6 +376,22 @@ SERVING_BENCH_METRICS = {
     "serving.rated_throughput_tokens_per_sec": "higher",
     "serving.rated_queue_wait_ms_p99": "lower",
     "serving.rated_shed": "lower",
+    # the prefix-sharing sweep (bench_serving.py shared-prefix phase):
+    # N requests over K templates through a warm prefix-cache engine
+    # vs a cold-cache control with identical token streams. hit_rate
+    # and tokens_saved are deterministic for the seeded workload
+    # (direction 'higher': a drop means the matcher stopped finding
+    # prefixes it used to); tokens_offered is the denominator that
+    # makes tokens_saved auditable (info); the TTFT rows quote the
+    # WARM engine, and the speedup row is warm-vs-cold at p50 — the
+    # whole point of the cache
+    "serving.prefix_hit_rate": "higher",
+    "serving.prefill_tokens_saved": "higher",
+    "serving.prefill_tokens_offered": "info",
+    "serving.prefix_ttft_p50_ms": "lower",
+    "serving.prefix_ttft_p99_ms": "lower",
+    "serving.prefix_ttft_speedup": "higher",
+    "serving.prefix_tokens_recomputed_per_request": "lower",
 }
 
 # required keys of a Kernel Doctor result record (analysis/kernel_lint
@@ -807,7 +823,9 @@ def validate_step_record(rec):
                             f"(expected one of {list(SERVING_EVENTS)})")
         for key in ("queue_depth", "queue_wait_ms", "queue_deadline_ms",
                     "predicted_wait_ms", "retry_after_s", "n_tokens",
-                    "kv_blocks_used", "drained_ms"):
+                    "kv_blocks_used", "drained_ms",
+                    "prefix_blocks_shared", "prefix_hit_rate",
+                    "prefill_tokens_saved", "prefill_tokens_offered"):
             v = rec.get(key)
             if v is not None and (not isinstance(v, (int, float))
                                   or v != v or v < 0):

@@ -50,8 +50,14 @@ def _serving_kv_leak_check(request, monkeypatch):
     """Every ServingEngine any test builds must end QUIESCED: the pool
     leak check at teardown retrofits leak detection to all serving
     paths (finish, eviction, cancel, expiry, shed, engine error, drain,
-    stop) in every test file, not just the ones about leaks. Lazy
-    import: non-serving tests pay nothing."""
+    stop) in every test file, not just the ones about leaks. Under
+    prefix sharing, `assert_quiesced` counts REFERENCES: a block with
+    refs > 1 at quiesce names every holder, while blocks the
+    PrefixIndex retains at refcount 0 are cache, not a leak — but no
+    block may remain SHARED once every request is terminal, and the
+    index must still be bound to the engine's live pool (a stale
+    binding means an arena rebuild forgot to flush it). Lazy import:
+    non-serving tests pay nothing."""
     if "serving" not in request.module.__name__:
         yield
         return
@@ -68,3 +74,9 @@ def _serving_kv_leak_check(request, monkeypatch):
     yield
     for eng in engines:
         eng.pool.assert_quiesced()
+        assert eng.pool.num_shared == 0, \
+            f"{eng.pool.num_shared} KV block(s) still shared at teardown"
+        if eng.prefix_index is not None:
+            assert eng.prefix_index._pool is eng.pool, \
+                "prefix index bound to a stale pool (arena rebuild " \
+                "without flush+rebind)"

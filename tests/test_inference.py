@@ -118,7 +118,8 @@ def test_config_warns_on_ignored_engine_switches():
                        ("enable_mkldnn", {}),
                        ("switch_ir_optim", {}),
                        ("enable_memory_optim", {}),
-                       ("enable_use_gpu", {})]:
+                       ("enable_use_gpu", {}),
+                       ("enable_prefix_cache", {"flag": False})]:
         with _w.catch_warnings(record=True) as rec:
             _w.simplefilter("always")
             getattr(cfg, call)(**args)

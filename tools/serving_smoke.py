@@ -18,11 +18,27 @@ Default leg (CI stage: the engine's correctness gate):
     tools/trace_check.py;
   - serving.* gauges must be live on the HTTP /metrics scrape.
 
+Shared-prefix leg (the prefix-sharing KV cache round): 6 streams over
+2 prompt templates through a prefix-cache engine must
+  - report `prefix_hit_rate > 0` (later admissions ride the earlier
+    requests' cached template blocks),
+  - stay recompile-free (prefill RESUMES at the first uncached token,
+    and that resume offset is a traced scalar — it must not widen any
+    compile-signature family),
+  - and stream tokens IDENTICAL to a cold-cache engine (sharing must
+    be invisible in the output, or it is corruption).
+
 --selfcheck (the graphdoctor pattern — prove the failure is visible):
   - an OVER-ADMITTED schedule (block pool far smaller than the offered
     load) must trip eviction: serving.preemptions must rise, and every
     evicted-and-recomputed stream must STILL match run_generate
-    token-for-token (preemption is recompute, not corruption).
+    token-for-token (preemption is recompute, not corruption);
+  - a STALE-INDEX specimen: rebuild the arenas the buggy way (pool
+    swapped, prefix index neither flushed nor rebound) — the next
+    admission's prefix match MUST raise `StaleIndexError` instead of
+    silently splicing dead physical ids into a live block table, and
+    the correct rebuild path (`_rebuild_arenas`) must then serve the
+    same prompt cleanly.
 
 Exit codes: 0 ok; 10 findings; 9 selfcheck miss. Distinct from
 trace_check 7 / healthwatch 5 / compile_report 6 / chaos_drill 8 /
@@ -163,6 +179,127 @@ def smoke(n_requests=6, max_new=12):
     return 10 if findings else 0
 
 
+def prefix_smoke(n_requests=6, max_new=8):
+    """Shared-prefix leg: 6 streams over 2 templates. Hit rate must be
+    positive, the run recompile-free, and every stream identical to a
+    cold-cache engine serving the same schedule."""
+    from paddle_tpu import monitor, telemetry
+    from paddle_tpu.serving import SamplingParams, ServingEngine
+
+    findings = []
+    model = _build(seed=2)
+    rs = np.random.RandomState(2)
+    templates = [rs.randint(0, 512, (24,)).tolist() for _ in range(2)]
+    prompts = [templates[i % 2] + rs.randint(0, 512, (4 + i,)).tolist()
+               for i in range(n_requests)]
+
+    # cold-cache control: the same schedule with sharing disabled
+    cold = ServingEngine(model, max_slots=4, block_size=8,
+                         prefill_chunk=8, max_model_len=64,
+                         enable_prefix_cache=False)
+    cold_handles = [cold.submit(p, SamplingParams(max_new_tokens=max_new))
+                    for p in prompts]
+    cold.run_until_idle()
+    cold_streams = [h.output_tokens for h in cold_handles]
+
+    tel_path = os.path.join(tempfile.mkdtemp(prefix="serving_prefix_"),
+                            "serving_prefix.jsonl")
+    sink = telemetry.JsonlSink(tel_path)
+    with telemetry.CompileObservatory(sink=sink, action="record") as obs:
+        engine = ServingEngine(model, max_slots=4, block_size=8,
+                               prefill_chunk=8, max_model_len=64)
+        streams = [[] for _ in prompts]
+        with engine:
+            def client(i, handle):
+                for tok in handle.tokens(timeout=120):
+                    streams[i].append(tok)
+
+            handles = [engine.submit(p, SamplingParams(
+                max_new_tokens=max_new)) for p in prompts]
+            threads = [threading.Thread(target=client, args=(i, h))
+                       for i, h in enumerate(handles)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+        for i, (got, want) in enumerate(zip(streams, cold_streams)):
+            if got != want:
+                findings.append(
+                    f"prefix stream {i} diverged from the cold-cache "
+                    f"engine: got {got} want {want}")
+        ps = engine.prefix_stats()
+        if ps["hit_rate"] <= 0 or ps["hits"] <= 0:
+            findings.append(
+                f"prefix_hit_rate {ps['hit_rate']} on a 2-template "
+                f"6-stream schedule — the index matched nothing "
+                f"({ps})")
+        if monitor.get_gauge("serving.prefix_hit_rate", 0.0) <= 0:
+            findings.append("serving.prefix_hit_rate gauge is not live")
+        if engine.pool.num_shared != 0:
+            findings.append(
+                f"{engine.pool.num_shared} blocks still shared after "
+                "quiesce — a holder was dropped without release")
+        # zero recompiles: prefill-resume offsets ride ONE compiled
+        # family; a second compile of any serving family means the
+        # prefix path widened a signature
+        fams = {}
+        for rec in obs.records:
+            fams[rec["fn"]] = fams.get(rec["fn"], 0) + 1
+        for fam, n in fams.items():
+            if fam.startswith("serving_") and n > 1:
+                findings.append(
+                    f"{fam} compiled {n} times during the shared-prefix "
+                    "leg — prefix resume broke the fixed-shape contract "
+                    f"(cause diffs in {tel_path})")
+    sink.close()
+    n_saved = int(monitor.get_gauge("serving.prefill_tokens_saved", 0))
+    print(f"prefix smoke: {n_requests} streams over 2 templates, "
+          f"hit_rate {ps['hit_rate']:.3f}, {n_saved} tokens saved, "
+          f"{len(findings)} finding(s)")
+    for f in findings:
+        print(f"FAIL: {f}")
+    return findings
+
+
+def stale_index_selfcheck():
+    """Specimen: a stale index entry surviving an arena rebuild must be
+    CAUGHT (StaleIndexError), and the correct rebuild path must then
+    serve the same prompt cleanly."""
+    from paddle_tpu.serving import (BlockPool, SamplingParams,
+                                    ServingEngine, StaleIndexError)
+
+    misses = []
+    model = _build(seed=3)
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 512, (16,)).tolist()
+    engine = ServingEngine(model, max_slots=2, block_size=8,
+                           prefill_chunk=8, max_model_len=64)
+    engine.submit(prompt, SamplingParams(max_new_tokens=2))
+    engine.run_until_idle()
+    assert engine.prefix_index.num_blocks > 0, "index never populated"
+    # the BUGGY rebuild: swap the pool, leave the index bound to the
+    # old one with its dead physical ids intact
+    engine.pool = BlockPool(engine.pool.num_blocks)
+    engine.sched.pool = engine.pool
+    engine.submit(prompt, SamplingParams(max_new_tokens=2))
+    try:
+        engine.run_until_idle(max_steps=50)
+        misses.append("a stale index entry survived an arena rebuild "
+                      "undetected — admission served dead physical ids")
+    except StaleIndexError:
+        print("stale-index specimen caught (StaleIndexError at the "
+              "first post-rebuild admission)")
+    # the CORRECT path: _rebuild_arenas flushes + rebinds; the same
+    # prompt must then serve cleanly (cold, no stale hits)
+    engine._rebuild_arenas()
+    h = engine.submit(prompt, SamplingParams(max_new_tokens=2))
+    engine.run_until_idle()
+    if len(h.output_tokens) != 2:
+        misses.append("post-rebuild serving is broken after the "
+                      "correct flush+rebind path")
+    return misses
+
+
 def selfcheck(n_requests=4, max_new=24):
     """Over-admit against a tiny pool: eviction MUST fire and MUST be
     invisible in the streams."""
@@ -193,6 +330,7 @@ def selfcheck(n_requests=4, max_new=24):
             misses.append(f"stream {i} corrupted by eviction: "
                           f"{h.output_tokens} want {refs[i]}")
     stats = [h.stats["preemptions"] for h in handles]
+    misses += stale_index_selfcheck()
     print(f"serving selfcheck: {fired} preemptions "
           f"(per-request {stats}), {len(misses)} miss(es)")
     for m in misses:
@@ -213,7 +351,9 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     if args.selfcheck:
         return selfcheck()
-    return smoke(args.requests, args.max_new)
+    rc = smoke(args.requests, args.max_new)
+    prefix_findings = prefix_smoke()
+    return 10 if (rc or prefix_findings) else 0
 
 
 if __name__ == "__main__":

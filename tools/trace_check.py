@@ -240,7 +240,7 @@ def check_bench_records(records, path):
                     "carries no unit")
             if isinstance(rec.get("value"), (int, float)):
                 serving_vals[key] = (i, float(rec["value"]))
-    for fam in ("ttft", "tpot"):
+    for fam in ("ttft", "tpot", "prefix_ttft"):
         for (metric, device, rnd), (i, p50) in list(serving_vals.items()):
             if metric != f"serving.{fam}_p50_ms":
                 continue
@@ -497,7 +497,15 @@ def check_serving_records(records, path):
     - a DEADLINE MISS is a failure of enforcement, not of the request:
       any admitted/finished record whose `queue_wait_ms` exceeds its
       recorded `queue_deadline_ms` means the scheduler ran a request
-      it had promised to expire.
+      it had promised to expire;
+    - prefix-cache accounting (the copy-on-write sharing round) must
+      be arithmetically possible: `prefix_hit_rate` in [0, 1] (it is
+      tokens_saved / tokens_offered), `prefill_tokens_saved` never
+      exceeding `prefill_tokens_offered` (the cache cannot save
+      positions nobody asked to prefill), and a QUIESCE record must
+      show ZERO `prefix_blocks_shared` — with every request terminal
+      there is nobody left to share a block with, so a surviving
+      shared reference is a dropped holder.
     """
     problems = []
     tallies = {}          # (rank, engine) -> {event: n}
@@ -527,7 +535,28 @@ def check_serving_records(records, path):
                     f"{rec.get('rid')} waited {qw}ms against a "
                     f"{qd}ms queue deadline yet was {what}: "
                     "queue-deadline enforcement is dead")
+        ph = rec.get("prefix_hit_rate")
+        if isinstance(ph, (int, float)) and not (0.0 <= ph <= 1.0):
+            problems.append(
+                f"{path}:{i + 1}: prefix_hit_rate {ph} outside [0, 1] "
+                "— the hit accounting (tokens_saved / tokens_offered) "
+                "is broken")
+        saved = rec.get("prefill_tokens_saved")
+        offered = rec.get("prefill_tokens_offered")
+        if isinstance(saved, (int, float)) and \
+                isinstance(offered, (int, float)) and saved > offered:
+            problems.append(
+                f"{path}:{i + 1}: prefill_tokens_saved {saved} > "
+                f"prefill_tokens_offered {offered} — the prefix cache "
+                "claims to have saved positions nobody offered")
         if ev == "quiesce":
+            shared = rec.get("prefix_blocks_shared")
+            if isinstance(shared, (int, float)) and shared > 0:
+                problems.append(
+                    f"{path}:{i + 1}: {int(shared)} KV block(s) still "
+                    "SHARED (refs>1) at quiesce — every request is "
+                    "terminal, so a surviving shared reference means a "
+                    "holder was dropped without releasing it")
             kv = rec.get("kv_blocks_used")
             if isinstance(kv, (int, float)) and kv > 0:
                 problems.append(
