@@ -15,7 +15,12 @@ per request.
 - `engine` — `ServingEngine`: fixed-shape compiled prefill/decode
   steps (recompile-free steady state, compile-observatory-checkable),
   per-slot greedy/top-k/top-p sampling, streaming token handles,
-  `serving.*` metrics on the monitor registry. `EngineConfig
+  `serving.*` metrics on the monitor registry — latencies as true
+  streaming histograms with the legacy p50/p99 gauges recomputed from
+  them at scrape time — plus per-request span timelines
+  (`telemetry.reqtrace`: every request a kind=reqtrace record whose
+  spans tile its life, tail exemplars on `GET /traces`, offline
+  attribution via `tools/tail_report.py`). `EngineConfig
   .from_inference_config` routes the `paddle_tpu.inference.Config`
   compat switches (device, memory pool, precision) into real engine
   behavior.

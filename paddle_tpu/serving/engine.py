@@ -25,7 +25,14 @@ independent of what else shares the batch.
 Metrics: `serving.*` gauges/counters on the process monitor registry —
 scrape them from any `telemetry.MetricsServer` or the serving HTTP
 front (serving/http.py): queue depth, KV-block utilization, preemption
-count, per-request TTFT/TPOT p50/p99.
+count. TTFT/TPOT/queue-wait land in streaming log-bucketed HISTOGRAMS
+(`serving.ttft_ms`/`tpot_ms`/`queue_wait_ms`, true Prometheus
+histogram series — quantiles are computable at scrape time over any
+window); the legacy p50/p99 gauges are recomputed from those
+histograms at every step and at scrape time, age-stamped by
+`serving.slo_gauge_age_s`. Per-request span timelines
+(telemetry.reqtrace) ride the attached sink as kind=reqtrace records,
+with the slowest-K exemplars on `GET /traces`.
 """
 import contextlib
 import threading
@@ -42,6 +49,8 @@ from ..generation import _cast_params
 from ..jit import bind_tensors
 from ..ops.pallas_decode import flash_prefill_chunk, paged_decode_attention
 from ..resilience.retry import classify_failure
+from ..telemetry.recorder import span as _telemetry_span
+from ..telemetry.reqtrace import RequestTracer
 from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache, PrefixIndex
 from .resilience import (AdmissionController, DeadlineExceededError,
                          EngineDeadError, EngineDrainingError,
@@ -69,7 +78,8 @@ class EngineConfig:
                  max_model_len=None, prefill_chunk=32, dtype="bfloat16",
                  weights="native", kv_memory_mb=None, device=None,
                  max_queue=None, max_restarts=3, restart_backoff_s=1.0,
-                 enable_prefix_cache=True):
+                 enable_prefix_cache=True, enable_tracing=True,
+                 trace_exemplars=32):
         if weights not in ("native", "wo8"):
             raise ValueError(f"weights must be 'native' or 'wo8', "
                              f"got {weights!r}")
@@ -86,6 +96,12 @@ class EngineConfig:
         # requests). Default ON; off must bit-match the pre-sharing
         # engine — the index is simply never consulted
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # per-request tracing (telemetry.reqtrace): pure host-side span
+        # bookkeeping at event boundaries — no traced values, no new
+        # compile families; `trace_exemplars` bounds the slowest-K ring
+        # the /traces endpoint serves
+        self.enable_tracing = bool(enable_tracing)
+        self.trace_exemplars = int(trace_exemplars)
         # resilience knobs: bounded waiting queue (None -> 16x slots),
         # warm-restart cap + backoff base for transient step faults
         self.max_queue = 16 * self.max_slots if max_queue is None \
@@ -204,11 +220,18 @@ class ServingEngine:
         self.admission = AdmissionController(cfg.max_queue, cfg.max_slots)
         self._counts = {"admitted": 0, "finished": 0, "failed": 0,
                         "cancelled": 0, "expired": 0, "shed": 0}
-        self._ttft_ms = []
-        self._tpot_ms = []
-        self._qwait_ms = []
-        self._lat_dirty = False
+        # latency lives in streaming log-bucketed histograms on the
+        # monitor registry (scraped as true Prometheus histograms);
+        # the legacy p50/p99 gauges are recomputed from them — at every
+        # step AND at scrape time (refresh_latency_gauges), so a
+        # stalled engine can no longer serve percentiles frozen at the
+        # last finished request. `_last_latency_obs` age-stamps them.
+        self._last_latency_obs = None
         self._finished = 0
+        self.tracer = RequestTracer(
+            engine_id=self.engine_id, sink=sink,
+            exemplar_k=cfg.trace_exemplars) \
+            if cfg.enable_tracing else None
         self.kv_peak_utilization = 0.0
         # prefix-cache accounting: offered = positions each admission
         # would have to prefill cold, saved = positions a cache hit
@@ -479,7 +502,15 @@ class ServingEngine:
                              retry_after_s=e.retry_after_s,
                              reason=type(e).reason,
                              priority=req.priority_class)
+                if self.tracer is not None:
+                    # the shed verdict IS this request's trace
+                    self.tracer.record_shed(
+                        req, time.monotonic(),
+                        queue_depth=e.queue_depth,
+                        reason=type(e).reason)
                 raise
+            if self.tracer is not None:
+                req.trace = self.tracer.start(req.rid, req.submit_time)
             self.sched.enqueue(req)     # validated above, by design
             self._counts["admitted"] += 1
             monitor.incr("serving.requests")
@@ -517,8 +548,10 @@ class ServingEngine:
     def step(self):
         """One scheduler iteration: reap (cancellations + deadlines),
         admit, at most one prefill chunk, one decode batch. Returns
-        True when any work was done."""
-        with self._mu:
+        True when any work was done. The whole iteration runs inside a
+        `serving_step` telemetry span, so engine steps render as a lane
+        next to the per-request trace lanes in the Chrome export."""
+        with self._mu, _telemetry_span("serving_step", cat="serving"):
             now = time.monotonic()
             self._reap(now)
             admitted = self.sched.admit(now=now)
@@ -531,18 +564,22 @@ class ServingEngine:
                         ps["hits"] += 1
                         ps["tokens_saved"] += req.prefix_cached_tokens
                         monitor.incr("serving.prefix_hits")
+            depth = len(self.sched.waiting)
             for req in admitted:
+                if req.trace is not None:
+                    req.trace.note_admit(
+                        now, queue_depth=depth,
+                        prefix_cached_tokens=req.prefix_cached_tokens)
                 # sample only FIRST admissions (admit stamped them with
                 # this step's clock): a preempted/requeued request keeps
-                # its original admit_time, and re-appending that frozen
-                # wait would double-count it in the p50/p99 gauges
+                # its original admit_time, and re-observing that frozen
+                # wait would double-count it in the histogram
                 if req.admit_time != now:
                     continue
                 qw = req.queue_wait_ms()
                 if qw is not None:
-                    self._qwait_ms.append(qw)
-                    del self._qwait_ms[:-2048]
-                    self._lat_dirty = True
+                    monitor.observe_hist("serving.queue_wait_ms", qw)
+                    self._last_latency_obs = now
             did = self._prefill_one()
             did = self._decode_once() or did
             self._update_gauges()
@@ -839,7 +876,11 @@ class ServingEngine:
             monitor.incr("serving.restarts")
             # requeue oldest-first so the waiting FRONT preserves the
             # original admission order for the replay
+            now = time.monotonic()
             for req in reversed(active):
+                if req.trace is not None:
+                    req.trace.note_requeue(now, "restart",
+                                           n_prefilled=req.n_prefilled)
                 self.sched.requeue(req)
             self._rebuild_arenas()
             self._record("restart", attempt=attempt, reason=kind,
@@ -897,6 +938,8 @@ class ServingEngine:
         pool.free([old], owner=req.rid)
         req.blocks[bi] = new
         monitor.incr("serving.prefix_cow_forks")
+        if req.trace is not None:
+            req.trace.note_cow_fork(time.monotonic())
         return True
 
     def _prefill_one(self):
@@ -944,6 +987,8 @@ class ServingEngine:
             self.cache.swap(new_k, new_v)
             monitor.incr("serving.prefill_chunks")
             req.n_prefilled = p0 + c_real
+            if req.trace is not None:
+                req.trace.note_prefill_chunk(time.monotonic(), p0, c_real)
             if req.n_prefilled >= len(seq):
                 # full prompt K/V now lives in this request's blocks:
                 # publish the FULL prompt blocks to the prefix index so
@@ -1021,6 +1066,10 @@ class ServingEngine:
         now = time.monotonic()
         for i, req in active:
             req.n_prefilled += 1
+            if req.trace is not None:
+                # O(1) per request per step: extends the coalesced
+                # decode segment (one span per stretch, never per token)
+                req.trace.note_decode(now)
             self._emit(req, int(tok[i]), float(logp[i]), now=now)
         return True
 
@@ -1066,6 +1115,12 @@ class ServingEngine:
                      queue_wait_ms=req.queue_wait_ms(),
                      queue_deadline_ms=self._queue_deadline_ms(req),
                      priority=req.priority_class, error=error, **fields)
+        if self.tracer is not None:
+            # the single terminal transition closes the trace too: the
+            # finalize span ends at the scheduler-stamped finish_time,
+            # so the decomposition invariant (spans sum to e2e) holds
+            # for every outcome, not just clean finishes
+            self.tracer.finish(req, req.finish_time)
 
     def _emit(self, req, tok, logp, now=None):
         req.push_token(tok, now=now)
@@ -1075,15 +1130,14 @@ class ServingEngine:
             monitor.incr("serving.finished")
             t = req.ttft_ms()
             if t is not None:
-                self._ttft_ms.append(t)
-                del self._ttft_ms[:-2048]
+                monitor.observe_hist("serving.ttft_ms", t)
+                self._last_latency_obs = time.monotonic()
             self._finalize(req, FINISHED, "finished")
             t = req.tpot_ms()
             if t is not None:
-                self._tpot_ms.append(t)
-                del self._tpot_ms[:-2048]
+                monitor.observe_hist("serving.tpot_ms", t)
+                self._last_latency_obs = time.monotonic()
                 self.admission.note_tpot_ms(t)  # feeds shed prediction
-            self._lat_dirty = True
 
     def _update_gauges(self):
         monitor.set_gauge("serving.queue_depth", len(self.sched.waiting))
@@ -1105,20 +1159,55 @@ class ServingEngine:
         util = self.pool.utilization()
         monitor.set_gauge("serving.kv_block_utilization", util)
         self.kv_peak_utilization = max(self.kv_peak_utilization, util)
-        if self._lat_dirty:      # percentiles only when a request landed
-            self._lat_dirty = False
-            for p50_name, p99_name, vals in (
-                    ("serving.ttft_p50_ms", "serving.ttft_p99_ms",
-                     self._ttft_ms),
-                    ("serving.tpot_p50_ms", "serving.tpot_p99_ms",
-                     self._tpot_ms),
-                    ("serving.queue_wait_ms_p50",
-                     "serving.queue_wait_ms_p99", self._qwait_ms)):
-                if vals:
-                    monitor.set_gauge(p50_name,
-                                      float(np.percentile(vals, 50)))
-                    monitor.set_gauge(p99_name,
-                                      float(np.percentile(vals, 99)))
+        self.refresh_latency_gauges()
+
+    # the legacy-gauge <- histogram mapping (compat names kept: every
+    # dashboard scraping serving.*_p50/_p99 keeps working; the scrape
+    # can now ALSO compute its own quantiles from the histogram series)
+    _LATENCY_GAUGES = (
+        ("serving.ttft_ms", "serving.ttft_p50_ms",
+         "serving.ttft_p99_ms"),
+        ("serving.tpot_ms", "serving.tpot_p50_ms",
+         "serving.tpot_p99_ms"),
+        ("serving.queue_wait_ms", "serving.queue_wait_ms_p50",
+         "serving.queue_wait_ms_p99"),
+    )
+
+    def refresh_latency_gauges(self):
+        """Recompute the legacy p50/p99 SLO gauges from the streaming
+        histograms NOW (over the histograms' bounded RECENT window, so
+        a regression moves the p99 within ~a window of slow requests
+        rather than after 1% of lifetime traffic), and age-stamp them.
+        Called on every engine step AND from the HTTP front's /metrics
+        + /healthz handlers —
+        previously the percentiles refreshed only when a request
+        happened to finish, so a stalled or wedged engine served
+        exactly-frozen p50/p99 during the incidents they exist to
+        expose. `serving.slo_gauge_age_s` says how stale the underlying
+        samples are; a prober can alarm on the age even when the
+        quantiles look healthy.
+
+        Like every other serving.* stat on the registry (counters,
+        tokens_generated, preemptions...), the histograms are
+        PROCESS-global: several engines in one process merge their
+        samples, by the registry's design (production serves one
+        engine per process; bench/test harnesses that build control
+        engines report percentiles from their own request handles, not
+        these gauges). Reads go through `monitor.hist_quantile` — the
+        registry lock makes them consistent against a concurrent
+        observe()'s half-window rotation (an unlocked read torn across
+        the rotation could publish the histogram's top bound as p99)."""
+        for hist_name, p50_name, p99_name in self._LATENCY_GAUGES:
+            p50 = monitor.hist_quantile(hist_name, 0.50)
+            p99 = monitor.hist_quantile(hist_name, 0.99)
+            if p50 is None or p99 is None:
+                continue
+            monitor.set_gauge(p50_name, float(p50))
+            monitor.set_gauge(p99_name, float(p99))
+        if self._last_latency_obs is not None:
+            monitor.set_gauge(
+                "serving.slo_gauge_age_s",
+                round(time.monotonic() - self._last_latency_obs, 3))
 
     def prefix_stats(self):
         """Snapshot of the prefix-cache accounting: lookups, hits,

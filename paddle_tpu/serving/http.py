@@ -29,7 +29,15 @@ engine process is scrapeable and servable with nothing but the stdlib.
 - **GET /metrics** — Prometheus text: the whole monitor registry,
   which includes the engine's `serving.*` gauges/counters (queue
   depth/wait, KV-block utilization, preemptions, shed/cancelled/
-  deadline_exceeded, TTFT/TPOT p50/p99).
+  deadline_exceeded, TTFT/TPOT p50/p99) plus true log-bucketed
+  HISTOGRAM series for ttft/tpot/queue_wait, with the legacy p50/p99
+  gauges recomputed from them at scrape time and age-stamped
+  (`serving.slo_gauge_age_s`) so a stalled engine cannot serve frozen
+  percentiles.
+- **GET /traces[?n=10]** — recent tail-request timelines from the
+  request tracer's slowest-K exemplar ring (`telemetry.reqtrace`):
+  full kind=reqtrace records, span by span, naming where each slow
+  request's latency went.
 - **GET /healthz** — READINESS: engine status + the serving.*
   snapshot; answers 503 with status "draining"/"dead" when the engine
   is draining or dead (take it out of the load balancer).
@@ -74,14 +82,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         engine = self.server.engine
-        if self.path == "/metrics":
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            # scrape-time refresh: the legacy p50/p99 gauges recompute
+            # from the streaming histograms NOW (age-stamped), so a
+            # stalled engine can't serve percentiles frozen at the
+            # last finished request; the histogram series themselves
+            # ride the same scrape for window-of-choice quantiles
+            engine.refresh_latency_gauges()
             self._send(200, prometheus_text(),
                        ctype="text/plain; version=0.0.4; charset=utf-8")
-        elif self.path == "/livez":
+        elif path == "/livez":
             # liveness stays green through a drain: the process is
             # healthy, it is just finishing its work
             self._send(200, json.dumps({"status": "alive"}))
-        elif self.path in ("/", "/healthz"):
+        elif path in ("/", "/healthz"):
+            engine.refresh_latency_gauges()
             status, code = "ok", 200
             if engine.dead:
                 status, code = "dead", 503
@@ -90,11 +106,27 @@ class _Handler(BaseHTTPRequestHandler):
             body = {"status": status,
                     "serving": engine.metrics_snapshot()}
             self._send(code, json.dumps(body, indent=2, default=repr))
+        elif path == "/traces":
+            # the slowest-K exemplar timelines (telemetry.reqtrace):
+            # each entry is a full kind=reqtrace record — span-by-span
+            # decomposition of where that request's latency went
+            n = None
+            for part in query.split("&"):
+                if part.startswith("n="):
+                    try:
+                        n = int(part[2:])
+                    except ValueError:
+                        pass
+            traces = [] if engine.tracer is None \
+                else engine.tracer.timelines(n)
+            self._send(200, json.dumps(
+                {"tracing": engine.tracer is not None,
+                 "traces": traces}, default=repr))
         else:
             self._send(404, json.dumps(
                 {"error": f"unknown path {self.path!r}",
                  "endpoints": ["POST /generate", "/metrics", "/healthz",
-                               "/livez"]}))
+                               "/livez", "/traces?n=10"]}))
 
     def _retry_after(self, seconds):
         return {"Retry-After": str(max(1, int(math.ceil(seconds))))}

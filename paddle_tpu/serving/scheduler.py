@@ -124,6 +124,7 @@ class Request:
             self.priority = int(priority)
             self.priority_class = str(priority)
         self.cancel_requested = False
+        self.trace = None                   # telemetry.reqtrace.RequestTrace
         self.submit_time = submit_time if submit_time is not None \
             else time.monotonic()
         self.admit_time = None              # first admission out of the queue
@@ -478,6 +479,12 @@ class Scheduler:
     def preempt(self, req):
         """Evict-by-recompute: `requeue` plus the preemption ledger."""
         from .. import monitor
+        if req.trace is not None and req not in self.waiting:
+            # the trace marks WHY the request goes back to the queue
+            # (before requeue resets n_prefilled — the span records how
+            # much written progress the eviction threw away)
+            req.trace.note_requeue(time.monotonic(), "preempt",
+                                   n_prefilled=req.n_prefilled)
         self.requeue(req)
         req.preemptions += 1
         self.preemptions += 1

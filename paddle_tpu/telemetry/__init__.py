@@ -36,6 +36,7 @@ from . import compile_obs  # noqa: F401
 from . import health  # noqa: F401
 from . import metrics_http  # noqa: F401
 from . import mfu  # noqa: F401
+from . import reqtrace  # noqa: F401
 from . import sink  # noqa: F401
 from . import watchdog  # noqa: F401
 from .health import (  # noqa: F401
@@ -50,17 +51,22 @@ from .mfu import (  # noqa: F401
 from .recorder import (  # noqa: F401
     StepTimer, TelemetryRecorder, auto_step, current_recorder, open_spans,
     span)
+from .reqtrace import (  # noqa: F401
+    RequestTrace, RequestTracer, decompose, dominant_cause,
+    trace_chrome_spans)
 from .sink import (  # noqa: F401
     JsonlSink, export_chrome_tracing, make_bench_record, make_ckpt_record,
-    make_phase_record, make_serving_record, make_step_record, read_jsonl,
-    validate_step_record)
+    make_phase_record, make_reqtrace_record, make_serving_record,
+    make_step_record, read_jsonl, validate_step_record)
 from .watchdog import HangWatchdog, dump_black_box  # noqa: F401
 
 __all__ = [
     "TelemetryRecorder", "StepTimer", "span", "auto_step",
     "current_recorder", "open_spans", "JsonlSink", "read_jsonl",
     "make_step_record", "make_phase_record", "make_ckpt_record",
-    "make_bench_record", "make_serving_record",
+    "make_bench_record", "make_serving_record", "make_reqtrace_record",
+    "RequestTrace", "RequestTracer", "decompose", "dominant_cause",
+    "trace_chrome_spans",
     "validate_step_record", "export_chrome_tracing",
     "device_peak_flops", "model_flops_per_token", "train_step_flops",
     "HealthConfig", "HealthMonitor", "HealthError", "Anomaly",
@@ -69,4 +75,5 @@ __all__ = [
     "current_observatory", "diff_signatures", "signature_of",
     "observed_dispatch",
     "mfu", "sink", "health", "watchdog", "metrics_http", "compile_obs",
+    "reqtrace",
 ]

@@ -95,6 +95,15 @@ class HealthConfig:
     ckpt_stall_s      a kind=ckpt commit record whose save_ms exceeds
                       this many seconds fires `checkpoint_stall`
                       (resilience.CheckpointManager records)
+    tail_cause_frac   a kind=reqtrace record whose dominant latency
+                      cause is PATHOLOGICAL (queue_wait / preemption /
+                      restart / cow_fork — telemetry.reqtrace) with at
+                      least this fraction of the request's end-to-end
+                      time counts toward `tail_latency`
+    tail_cause_count  fire `tail_latency` once this many requests are
+                      dominated by the SAME pathological cause (latched
+                      per cause: one page per pathology, not per
+                      request)
     hang_deadline_s   arm a HangWatchdog with this deadline (None: off)
     dump_dir          where black-box dumps go ('.' default)
     dump_on_exception fire the black-box dump when an exception escapes
@@ -106,7 +115,8 @@ class HealthConfig:
                  z_loss=8.0, z_grad=8.0, z_step_time=8.0,
                  rel_step_time=1.5, storm_compiles=5, storm_window_steps=32,
                  hbm_drift_tol=0.15, flops_drift_tol=0.25,
-                 ckpt_stall_s=300.0, hang_deadline_s=None, dump_dir=".",
+                 ckpt_stall_s=300.0, tail_cause_frac=0.6,
+                 tail_cause_count=4, hang_deadline_s=None, dump_dir=".",
                  dump_on_exception=True, ring_size=64):
         if action not in _ACTIONS:
             raise ValueError(f"health action must be one of {_ACTIONS}, "
@@ -126,6 +136,8 @@ class HealthConfig:
         self.hbm_drift_tol = float(hbm_drift_tol)
         self.flops_drift_tol = float(flops_drift_tol)
         self.ckpt_stall_s = float(ckpt_stall_s)
+        self.tail_cause_frac = float(tail_cause_frac)
+        self.tail_cause_count = int(tail_cause_count)
         self.hang_deadline_s = hang_deadline_s
         self.dump_dir = dump_dir
         self.dump_on_exception = bool(dump_on_exception)
@@ -235,6 +247,15 @@ class AnomalyDetector:
     - checkpoint_stall     a ckpt commit whose save_ms exceeds
                            ckpt_stall_s — saves that slow eat the
                            preemption grace window
+    - tail_latency         request-trace records (kind='reqtrace',
+                           telemetry.reqtrace): tail_cause_count
+                           requests dominated (>= tail_cause_frac of
+                           their end-to-end latency) by the same
+                           PATHOLOGICAL cause — queue_wait, preemption,
+                           restart, or cow_fork; decode/prefill
+                           dominating is the work the user asked for.
+                           Latched per cause so one pathology pages
+                           once, not once per request
 
     Clean values enter their windows AFTER judgment, so a spike does not
     vaccinate the window against itself; anomalous values are excluded
@@ -250,6 +271,8 @@ class AnomalyDetector:
         self._recompiles = {}         # fn -> deque of (step, cause)
         self._storm_muzzle = {}       # fn -> muzzled-until step
         self._drift_latched = set()   # (kind, fn) already flagged
+        self._tail_counts = {}        # cause -> dominated-request count
+        self._tail_latched = set()    # causes already paged
         self.anomalies = []
         self._n = 0
 
@@ -289,6 +312,10 @@ class AnomalyDetector:
             return found
         if rec.get("kind") == "ckpt":
             found = self._observe_ckpt(rec)
+            self.anomalies.extend(found)
+            return found
+        if rec.get("kind") == "reqtrace":
+            found = self._observe_reqtrace(rec)
             self.anomalies.extend(found)
             return found
         step = rec.get("step", self._n - 1)
@@ -467,6 +494,35 @@ class AnomalyDetector:
                     "during a save this slow loses the step",
                     expected=limit_ms))
         return found
+
+    def _observe_reqtrace(self, rec):
+        """The tail-latency rule over one request-trace record
+        (kind='reqtrace', telemetry.reqtrace): requests whose latency
+        is DOMINATED by a serving mechanism (queue wait, preemption,
+        warm restart, CoW forking) rather than by the prefill/decode
+        work they asked for are counted per cause; past
+        tail_cause_count the cause pages once (latched). Same records
+        in flight (the engine's sink) and offline (tools/healthwatch.py
+        + tools/tail_report.py), so replays agree with production."""
+        from .reqtrace import PATHOLOGICAL_CAUSES, dominant_cause
+
+        c = self.config
+        cause, ms, frac = dominant_cause(rec)
+        if cause not in PATHOLOGICAL_CAUSES or frac < c.tail_cause_frac:
+            return []
+        n = self._tail_counts.get(cause, 0) + 1
+        self._tail_counts[cause] = n
+        if n < c.tail_cause_count or cause in self._tail_latched:
+            return []
+        self._tail_latched.add(cause)
+        return [Anomaly(
+            "tail_latency", rec.get("rid", self._n - 1), float(ms),
+            f"{n} request(s) dominated by {cause} (latest: request "
+            f"{rec.get('rid')} spent {ms:.1f}ms / {frac * 100:.0f}% of "
+            f"its {rec.get('e2e_ms')}ms end-to-end in {cause}; "
+            f"threshold {c.tail_cause_count} requests at "
+            f">={c.tail_cause_frac * 100:.0f}%)",
+            expected=c.tail_cause_frac, z=round(frac, 3))]
 
     def kinds(self):
         """Distinct anomaly kinds seen so far (healthwatch --expect)."""

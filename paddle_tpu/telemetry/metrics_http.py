@@ -58,10 +58,21 @@ def _prom_value(v):
     return repr(f) if not float(f).is_integer() else str(int(f))
 
 
+def _prom_le(bound):
+    """le-label formatting: integral bounds print bare, others compact."""
+    f = float(bound)
+    return str(int(f)) if f.is_integer() else f"{f:g}"
+
+
 def prometheus_text(last_record=None):
     """Render monitor.snapshot_typed() (+ optionally the last step
     record) as Prometheus exposition text. Counters keep their
-    monotonic `# TYPE` so rate() works on the scrape."""
+    monotonic `# TYPE` so rate() works on the scrape; histograms
+    (monitor.observe_hist, e.g. the serving latency distributions)
+    render as true `histogram` series — cumulative `le` buckets + _sum
+    + _count — so quantiles are computable AT SCRAPE TIME over any
+    window, instead of trusting a producer-side percentile gauge that
+    freezes whenever the producer stalls."""
     typed = monitor.snapshot_typed()
     lines = []
     for kind in ("counter", "gauge"):
@@ -72,6 +83,19 @@ def prometheus_text(last_record=None):
             pname = _prom_name(name)
             lines.append(f"# TYPE {pname} {kind}")
             lines.append(f"{pname} {val}")
+    hists = monitor.snapshot_hists()
+    for name in sorted(hists):
+        h = hists[name]
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for bound, count in zip(h["bounds"], h["counts"]):
+            cum += count
+            lines.append(
+                f'{pname}_bucket{{le="{_prom_le(bound)}"}} {cum}')
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pname}_sum {_prom_value(h['sum'])}")
+        lines.append(f"{pname}_count {h['count']}")
     if last_record:
         for key in sorted(last_record):
             v = last_record[key]

@@ -106,6 +106,32 @@ SERVING_EVENTS = ("admitted", "finished", "failed", "cancelled",
                   "expired", "shed", "restart", "drain_begin",
                   "drain_end", "quiesce")
 
+# required keys of a per-request trace record (telemetry.reqtrace
+# RequestTracer, the serving engine's Dapper-style span timeline);
+# optional: engine, t0_s, ttft_ms, tpot_ms, queue_wait_ms, n_tokens,
+# prompt_len, preemptions
+REQTRACE_RECORD_KEYS = ("schema", "kind", "rank", "rid", "outcome",
+                        "e2e_ms", "spans")
+# the span vocabulary: queued (waiting; `reason` says why — submit /
+# preempt / restart), admit (the admission decision with its prefix-hit
+# info), shed (rejected up front), prefill_chunk (one chunked-prefill
+# dispatch; `replay`+`replay_cause` mark chunks recomputing positions a
+# preemption or warm restart threw away), decode (CONSECUTIVE decode
+# steps coalesced into one segment at engine-step boundaries — one span
+# per decode stretch, never one per token), preempt / restart_replay
+# (the requeue markers), cow_fork (copy-on-write block fork), finalize
+# (terminal transition + stream close). Spans TILE the request's
+# [submit, finish] wall-clock interval — each begins where the previous
+# ended — which is what makes the decomposition invariant (durations
+# sum to e2e_ms) checkable by tools/trace_check.py.
+REQTRACE_SPAN_KINDS = ("queued", "admit", "shed", "prefill_chunk",
+                       "decode", "preempt", "cow_fork", "restart_replay",
+                       "finalize")
+# trace outcomes: the four terminal request states plus `shed` (the
+# request never entered the engine; its trace is the admission verdict)
+REQTRACE_OUTCOMES = ("finished", "failed", "cancelled", "expired",
+                     "shed")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -348,6 +374,62 @@ def make_serving_record(event, rank=0, rid=None, engine=None,
     return rec
 
 
+def make_reqtrace_record(rid, outcome, spans, e2e_ms, rank=0, engine=None,
+                         t0_s=None, ttft_ms=None, tpot_ms=None,
+                         queue_wait_ms=None, n_tokens=None,
+                         prompt_len=None, preemptions=None, **extra):
+    """One request's complete span timeline as a first-class record
+    (kind='reqtrace', telemetry.reqtrace.RequestTracer). `spans` is the
+    ordered tiling of the request's wall-clock life — each span a dict
+    {kind, t0_ms, dur_ms, ...attrs} with t0_ms relative to submit time —
+    and `e2e_ms` the end-to-end latency the span durations must sum to
+    (tools/trace_check.py enforces the decomposition within 1%).
+    `t0_s` is the submit instant on the process monotonic clock, which
+    is what lets offline tools order requests and the Chrome export
+    place per-request lanes next to engine-step spans."""
+    if outcome not in REQTRACE_OUTCOMES:
+        raise ValueError(f"reqtrace outcome must be one of "
+                         f"{REQTRACE_OUTCOMES}, got {outcome!r}")
+    norm = []
+    for sp in spans:
+        s = {"kind": str(sp["kind"]),
+             "t0_ms": round(float(sp["t0_ms"]), 4),
+             "dur_ms": round(float(sp["dur_ms"]), 4)}
+        for k, v in sp.items():
+            if k not in ("kind", "t0_ms", "dur_ms") and v is not None:
+                s[k] = v
+        norm.append(s)
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "reqtrace",
+        "rank": int(rank),
+        "rid": int(rid),
+        "outcome": str(outcome),
+        "e2e_ms": round(float(e2e_ms), 4),
+        "spans": norm,
+    }
+    if engine is not None:
+        rec["engine"] = int(engine)
+    if t0_s is not None:
+        rec["t0_s"] = round(float(t0_s), 6)
+    if ttft_ms is not None:
+        rec["ttft_ms"] = round(float(ttft_ms), 4)
+    if tpot_ms is not None:
+        rec["tpot_ms"] = round(float(tpot_ms), 4)
+    if queue_wait_ms is not None:
+        rec["queue_wait_ms"] = round(float(queue_wait_ms), 4)
+    if n_tokens is not None:
+        rec["n_tokens"] = int(n_tokens)
+    if prompt_len is not None:
+        rec["prompt_len"] = int(prompt_len)
+    if preemptions is not None:
+        rec["preemptions"] = int(preemptions)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
 
 # the SERVING bench-metric family (bench_serving.py over
@@ -392,6 +474,12 @@ SERVING_BENCH_METRICS = {
     "serving.prefix_ttft_p99_ms": "lower",
     "serving.prefix_ttft_speedup": "higher",
     "serving.prefix_tokens_recomputed_per_request": "lower",
+    # the request tracer's cost (bench_serving.py trace_overhead_phase):
+    # rated-level throughput with tracing on vs off as a fraction lost,
+    # direction 'lower' so bench_gate holds the tracer to its <=2%
+    # budget once a device round seeds the row — a tracer that starts
+    # doing per-token host work fails the gate like any regression
+    "serving.trace_overhead_frac": "lower",
 }
 
 # required keys of a Kernel Doctor result record (analysis/kernel_lint
@@ -847,6 +935,44 @@ def validate_step_record(rec):
                         problems.append(
                             f"quiesce count {k!r} not a non-negative "
                             f"int: {v!r}")
+        return problems
+    if kind == "reqtrace":
+        for key in REQTRACE_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"reqtrace record missing '{key}'")
+        outcome = rec.get("outcome")
+        if outcome is not None and outcome not in REQTRACE_OUTCOMES:
+            problems.append(f"unknown reqtrace outcome {outcome!r} "
+                            f"(expected one of {list(REQTRACE_OUTCOMES)})")
+        for key in ("e2e_ms", "t0_s", "ttft_ms", "tpot_ms",
+                    "queue_wait_ms", "n_tokens", "prompt_len",
+                    "preemptions"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
+        spans = rec.get("spans")
+        if spans is not None:
+            if not isinstance(spans, list) or not spans:
+                problems.append("'spans' not a non-empty list — a trace "
+                                "with no timeline explains nothing")
+            else:
+                for j, sp in enumerate(spans):
+                    if not isinstance(sp, dict):
+                        problems.append(f"span {j} not a dict")
+                        continue
+                    if sp.get("kind") not in REQTRACE_SPAN_KINDS:
+                        problems.append(
+                            f"span {j} kind {sp.get('kind')!r} not in "
+                            f"the vocabulary {list(REQTRACE_SPAN_KINDS)}")
+                    for key in ("t0_ms", "dur_ms"):
+                        v = sp.get(key)
+                        if not isinstance(v, (int, float)) or v != v \
+                                or v < 0:
+                            problems.append(
+                                f"span {j} '{key}' not a non-negative "
+                                f"number: {v!r}")
         return problems
     if kind == "ckpt":
         for key in CKPT_RECORD_KEYS:

@@ -171,8 +171,27 @@ echo "== [5/10] serving engine smoke =="
 #      the offered load) must trip eviction and the
 #      serving.preemptions counter while every recomputed stream stays
 #      identical — proof the eviction path both exists and is safe.
+# The default leg also gates the request tracer (telemetry.reqtrace):
+# every finished request must yield a validated kind=reqtrace record
+# whose spans sum to its end-to-end latency, /metrics must expose
+# parseable Prometheus latency histograms tracking the legacy gauges,
+# /traces must serve the exemplar timelines, and the tracing-on vs
+# tracing-off schedule must stay inside the overhead bound.
 JAX_PLATFORMS=cpu python tools/serving_smoke.py
 JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
+# tail-latency attribution gate (tools/tail_report.py), two-sided:
+#   a) the checked-in pathology specimen
+#      (tools/specimens/reqtrace_tail.jsonl) must name queue_wait,
+#      preemption AND restart as dominant causes and trip the
+#      tail_latency rule for each, while the invalid specimen
+#      (tools/specimens/reqtrace_invalid.jsonl) must be CAUGHT by
+#      trace_check both ways (non-summing decomposition +
+#      finished-without-admit);
+#   b) a live mini-drill injects each pathology into a real engine
+#      (overload -> queue_wait, over-admission -> preemption, transient
+#      step fault -> restart) and the dominant cause must come out
+#      right on the actual traces.
+JAX_PLATFORMS=cpu python tools/tail_report.py --selfcheck
 
 echo "== [6/10] serving resilience drill =="
 # serving robustness gate (paddle_tpu/serving/resilience +

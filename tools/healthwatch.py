@@ -20,8 +20,12 @@ spike, grad explosion, step-time regression — compile steps exempt);
 phase records (kind=phase, bench.py output) are checked for recorded
 errors and non-finite metrics; checkpoint records (kind=ckpt,
 paddle_tpu.resilience) run the checkpoint_failed / checkpoint_stall
-rules. Detector knobs (--window, --z-loss, --z-grad, --z-step-time,
---min-points, --ckpt-stall-s) mirror HealthConfig.
+rules; request-trace records (kind=reqtrace, telemetry.reqtrace) run
+the tail_latency rule — requests dominated by a serving pathology
+(queue wait / preemption / warm restart / CoW) count per cause and
+page past the threshold. Detector knobs (--window, --z-loss, --z-grad,
+--z-step-time, --min-points, --ckpt-stall-s, --tail-frac,
+--tail-count) mirror HealthConfig.
 
 Exit codes: 0 clean / all expected families fired; 5 findings in gate
 mode; 9 an expected family did NOT fire (the watcher itself is broken).
@@ -67,6 +71,13 @@ def analyze_file(path, config):
             # replay through the same checkpoint_failed/checkpoint_stall
             # rules the in-flight manager runs
             pass
+        elif kind == "reqtrace":
+            # per-request serving traces (telemetry.reqtrace): replay
+            # through the same tail_latency rule the in-flight detector
+            # runs — requests dominated by queue wait / preemption /
+            # restart / CoW forking count per cause and page past the
+            # threshold, offline exactly as in production
+            pass
         else:
             continue
         det.observe(rec)
@@ -90,12 +101,15 @@ def main(argv=None):
     ap.add_argument("--z-grad", type=float, default=8.0)
     ap.add_argument("--z-step-time", type=float, default=8.0)
     ap.add_argument("--ckpt-stall-s", type=float, default=300.0)
+    ap.add_argument("--tail-frac", type=float, default=0.6)
+    ap.add_argument("--tail-count", type=int, default=4)
     args = ap.parse_args(argv)
 
     config = HealthConfig(
         action="record", window=args.window, min_points=args.min_points,
         z_loss=args.z_loss, z_grad=args.z_grad,
-        z_step_time=args.z_step_time, ckpt_stall_s=args.ckpt_stall_s)
+        z_step_time=args.z_step_time, ckpt_stall_s=args.ckpt_stall_s,
+        tail_cause_frac=args.tail_frac, tail_cause_count=args.tail_count)
 
     all_anoms, all_problems = [], []
     per_file = {}

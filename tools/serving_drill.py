@@ -350,9 +350,15 @@ def rated_leg(model, sink, findings, waves=3, max_new=12,
     from paddle_tpu.serving import (Deadlines, SamplingParams,
                                     ServingEngine)
 
+    # tracing OFF for the bench leg: this harness offers each wave as
+    # one burst, so the late admissions are queue-dominated BY DESIGN —
+    # their reqtrace records in the stage-4 gated file would trip the
+    # healthwatch tail_latency rule on a healthy run (the tracer's own
+    # gates live in serving_smoke / tail_report / bench_serving's
+    # overhead leg, not here)
     engine = ServingEngine(model, max_slots=4, block_size=8,
                            prefill_chunk=8, max_model_len=64,
-                           max_queue=32, sink=sink)
+                           max_queue=32, sink=sink, enable_tracing=False)
     rs = np.random.RandomState(7)
     warm = engine.submit(rs.randint(0, 512, (6,)).tolist(),
                          SamplingParams(max_new_tokens=max_new))

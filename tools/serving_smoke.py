@@ -13,10 +13,19 @@ Default leg (CI stage: the engine's correctness gate):
     (serving/http.py POST /generate stream=true) and must match;
   - the run executes under a CompileObservatory: each serving step
     family must compile EXACTLY once — a recompile anywhere in the run
-    (admission churn, varied prompt lengths, slot rotation) means the
-    fixed-shape contract broke; the compile ledger must also pass
-    tools/trace_check.py;
-  - serving.* gauges must be live on the HTTP /metrics scrape.
+    (admission churn, varied prompt lengths, slot rotation, request
+    TRACING) means the fixed-shape contract broke; the compile ledger
+    must also pass tools/trace_check.py;
+  - serving.* gauges must be live on the HTTP /metrics scrape, the
+    scrape must carry parseable Prometheus HISTOGRAM series for
+    ttft/tpot/queue_wait whose scrape-side p99 tracks the legacy
+    gauges, and /traces must serve the exemplar timelines;
+  - request tracing (telemetry.reqtrace): every finished request must
+    yield a validated kind=reqtrace record whose span durations sum to
+    its end-to-end latency (the decomposition invariant — enforced by
+    the trace_check pass over the same file), and a tracing-on vs
+    tracing-off run of the same lockstep schedule must stay within a
+    wall-clock overhead bound.
 
 Shared-prefix leg (the prefix-sharing KV cache round): 6 streams over
 2 prompt templates through a prefix-cache engine must
@@ -97,7 +106,8 @@ def smoke(n_requests=6, max_new=12):
     sink = telemetry.JsonlSink(tel_path)
     with telemetry.CompileObservatory(sink=sink, action="record") as obs:
         engine = ServingEngine(model, max_slots=4, block_size=8,
-                               prefill_chunk=8, max_model_len=64)
+                               prefill_chunk=8, max_model_len=64,
+                               sink=sink)
         with engine, ServingHTTPServer(engine, port=0) as srv:
             # concurrent client threads consuming live streams
             streams = [[] for _ in prompts]
@@ -143,9 +153,20 @@ def smoke(n_requests=6, max_new=12):
             mtext = urllib.request.urlopen(srv.url + "/metrics",
                                            timeout=30).read().decode()
             for gauge in ("serving_kv_block_utilization",
-                          "serving_queue_depth", "serving_ttft_p50_ms"):
+                          "serving_queue_depth", "serving_ttft_p50_ms",
+                          "serving_slo_gauge_age_s"):
                 if f"paddle_tpu_{gauge}" not in mtext:
                     findings.append(f"gauge {gauge} missing from /metrics")
+            findings += _check_histogram_scrape(mtext)
+
+            # the tail-exemplar timelines endpoint
+            tr = json.loads(urllib.request.urlopen(
+                srv.url + "/traces?n=4", timeout=30).read().decode())
+            if not tr.get("tracing") or not tr.get("traces"):
+                findings.append("/traces served no timelines on a "
+                                "traced run")
+            elif not all(t.get("spans") for t in tr["traces"]):
+                findings.append("/traces timelines carry no spans")
 
         # recompile-free contract: each family compiled EXACTLY once
         fams = {}
@@ -164,19 +185,130 @@ def smoke(n_requests=6, max_new=12):
             findings.append("preemptions fired on an under-committed "
                             "pool — the allocator is leaking blocks")
 
-    # the compile ledger itself must validate
+    # the ledger itself must validate: compile records, serving
+    # lifecycle records, AND the reqtrace decomposition cross-rule
+    # (every trace's spans must sum to its e2e latency within 1%)
     sink.close()
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import trace_check
-    problems, _ = trace_check.check_pair(tel_path)
+    problems, stats = trace_check.check_pair(tel_path)
     findings += [f"telemetry invalid: {p}" for p in problems]
+
+    # every finished request must have yielded a trace (N threaded
+    # streams + the HTTP leg's request)
+    finished_traces = sum(
+        1 for r in telemetry.read_jsonl(tel_path)
+        if r.get("kind") == "reqtrace" and r.get("outcome") == "finished")
+    if finished_traces != n_requests + 1:
+        findings.append(
+            f"{finished_traces} finished reqtrace record(s) for "
+            f"{n_requests + 1} finished requests — a request finished "
+            "untraced")
 
     n_tok = int(monitor.get("serving.tokens_generated", 0))
     print(f"serving smoke: {n_requests} concurrent streams, "
-          f"{n_tok} tokens, {len(findings)} finding(s)")
+          f"{n_tok} tokens, {finished_traces} traces, "
+          f"{len(findings)} finding(s)")
     for f in findings:
         print(f"FAIL: {f}")
     return 10 if findings else 0
+
+
+def _check_histogram_scrape(mtext):
+    """The /metrics text must carry a parseable Prometheus histogram
+    for the serving latencies, and the quantile computed FROM THE
+    SCRAPE must track the legacy p99 gauge (which the engine now
+    recomputes from the same histogram at scrape time)."""
+    findings = []
+    for fam in ("serving_ttft_ms", "serving_tpot_ms",
+                "serving_queue_wait_ms"):
+        prefix = f"paddle_tpu_{fam}_bucket{{le="
+        p99_name = f"paddle_tpu_{fam}".replace(
+            "_ms", "_p99_ms" if fam != "serving_queue_wait_ms"
+            else "_ms_p99")
+        buckets = []
+        gauge = None
+        for line in mtext.splitlines():
+            if line.startswith(prefix):
+                le, _, cum = line[len(prefix):].partition("} ")
+                le = le.strip('"')
+                buckets.append((float("inf") if le == "+Inf"
+                                else float(le), int(cum)))
+            if line.startswith(p99_name + " "):
+                gauge = float(line.split()[-1])
+        if not buckets:
+            findings.append(f"no histogram buckets for {fam} on "
+                            "/metrics")
+            continue
+        total = buckets[-1][1]
+        if total <= 0:
+            findings.append(f"{fam} histogram scraped empty")
+            continue
+        # scrape-side quantile: same interpolation Prometheus's
+        # histogram_quantile applies to the cumulative le series
+        target = max(1.0, 0.99 * total)
+        p99 = None
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in buckets:
+            if cum >= target:
+                hi = le if le != float("inf") else prev_le
+                n_in = cum - prev_cum
+                p99 = prev_le + (hi - prev_le) * (
+                    (target - prev_cum) / max(1, n_in))
+                break
+            prev_le, prev_cum = le, cum
+        if gauge is None:
+            findings.append(f"{fam}: p99 gauge missing from the scrape")
+        elif p99 is None or abs(p99 - gauge) > 0.15 * max(gauge, 1.0):
+            findings.append(
+                f"{fam}: scrape-side p99 {p99} does not track the "
+                f"legacy gauge {gauge} — the histogram and the gauge "
+                "disagree about the same distribution")
+    return findings
+
+
+def trace_overhead_leg(n_requests=10, max_new=12, bound=1.5):
+    """Tracing must be ~free: the SAME lockstep schedule through a
+    tracing-off then a tracing-on engine (both warmed so compile stays
+    out of the clock), bounded by `bound` on wall-clock ratio. The
+    tight (<=2%) bound binds in bench_serving.py's rated leg against a
+    seeded baseline; this is the smoke-level catastrophe check (a
+    per-token host sync would blow straight through it)."""
+    from paddle_tpu.serving import SamplingParams, ServingEngine
+    import time
+
+    findings = []
+    model = _build(seed=4)
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, 512, (6 + (i % 4),)).tolist()
+               for i in range(n_requests)]
+
+    def timed(enable):
+        engine = ServingEngine(model, max_slots=4, block_size=8,
+                               prefill_chunk=8, max_model_len=64,
+                               enable_tracing=enable)
+        engine.submit(prompts[0], SamplingParams(max_new_tokens=2))
+        engine.run_until_idle()          # warm: compile out of the clock
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for p in prompts:
+                engine.submit(p, SamplingParams(max_new_tokens=max_new))
+            engine.run_until_idle()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t_off = timed(False)
+    t_on = timed(True)
+    ratio = t_on / max(t_off, 1e-9)
+    print(f"trace overhead: on {t_on * 1000:.1f}ms vs off "
+          f"{t_off * 1000:.1f}ms ({ratio:.3f}x, bound {bound}x)")
+    if ratio > bound:
+        findings.append(
+            f"tracing overhead {ratio:.3f}x exceeds the {bound}x smoke "
+            "bound — the tracer is doing per-token host work")
+    return findings
 
 
 def prefix_smoke(n_requests=6, max_new=8):
@@ -353,7 +485,10 @@ def main(argv=None):
         return selfcheck()
     rc = smoke(args.requests, args.max_new)
     prefix_findings = prefix_smoke()
-    return 10 if (rc or prefix_findings) else 0
+    overhead_findings = trace_overhead_leg()
+    for f in overhead_findings:
+        print(f"FAIL: {f}")
+    return 10 if (rc or prefix_findings or overhead_findings) else 0
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
-    n_serving_records, n_kernel_records, problems).
+    n_serving_records, n_kernel_records, n_reqtrace_records, problems).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -34,9 +34,10 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty metrics "
-                                               "file (0 bytes): no step "
-                                               "was ever recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
+                                                  "metrics file (0 "
+                                                  "bytes): no step "
+                                                  "was ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -47,7 +48,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -61,6 +62,7 @@ def check_metrics_jsonl(path):
     problems += check_moe_records(records, path)
     problems += check_serving_records(records, path)
     problems += check_kernel_records(records, path)
+    problems += check_reqtrace_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -78,8 +80,11 @@ def check_metrics_jsonl(path):
     n_kernel = sum(1 for r in records
                    if isinstance(r, dict)
                    and r.get("kind") == "kernel_lint")
+    n_reqtrace = sum(1 for r in records
+                     if isinstance(r, dict)
+                     and r.get("kind") == "reqtrace")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
-            n_elastic, n_serving, n_kernel, problems)
+            n_elastic, n_serving, n_kernel, n_reqtrace, problems)
 
 
 def check_compile_records(records, path):
@@ -587,6 +592,87 @@ def check_serving_records(records, path):
     return problems
 
 
+# request-trace decomposition tolerance: span durations must sum to
+# the recorded end-to-end latency within 1% (plus a small absolute
+# floor for the per-span 4-decimal ms rounding) — the spans TILE the
+# request's wall-clock life by construction (telemetry.reqtrace), so
+# any bigger gap means the producer dropped an event, appended out of
+# order, or the record was doctored
+TRACE_SUM_TOL_FRAC = 0.01
+TRACE_SUM_TOL_ABS_MS = 0.5
+
+
+def check_reqtrace_records(records, path):
+    """Cross-record rules for per-request trace timelines
+    (kind=reqtrace, telemetry.reqtrace RequestTracer; per-record schema
+    — span-kind vocabulary, non-negative times, outcome vocabulary —
+    lives in sink.validate_step_record):
+
+    - the LATENCY-DECOMPOSITION invariant: span durations must sum to
+      `e2e_ms` within TRACE_SUM_TOL_FRAC — a timeline that does not
+      account for the latency it claims to explain attributes nothing;
+    - span starts must be monotonic non-decreasing (the spans tile the
+      wall clock; an out-of-order span means two clocks were mixed);
+    - a trace that did ENGINE WORK (prefill_chunk/decode spans) or
+      claims outcome 'finished' must carry an `admit` span — a request
+      cannot be served out of a queue it was never admitted from
+      (finalize-without-admit is a producer bug or a doctored ledger);
+    - every non-shed trace must end in a `finalize` span — a trace
+      with no terminal transition is a request the engine dropped.
+    """
+    problems = []
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "reqtrace":
+            continue
+        spans = rec.get("spans")
+        if not isinstance(spans, list) or not spans:
+            continue              # schema validation already flagged it
+        kinds = {sp.get("kind") for sp in spans
+                 if isinstance(sp, dict)}
+        total = 0.0
+        prev_t0 = None
+        for j, sp in enumerate(spans):
+            if not isinstance(sp, dict):
+                continue
+            d = sp.get("dur_ms")
+            if isinstance(d, (int, float)) and d == d and d >= 0:
+                total += float(d)
+            t0 = sp.get("t0_ms")
+            if isinstance(t0, (int, float)):
+                if prev_t0 is not None and t0 < prev_t0 - 1e-6:
+                    problems.append(
+                        f"{path}:{i + 1}: reqtrace span {j} "
+                        f"({sp.get('kind')}) starts at {t0}ms before "
+                        f"the previous span's {prev_t0}ms — the "
+                        "timeline is out of order")
+                prev_t0 = t0
+        e2e = rec.get("e2e_ms")
+        if isinstance(e2e, (int, float)) and e2e >= 0:
+            tol = max(TRACE_SUM_TOL_FRAC * e2e, TRACE_SUM_TOL_ABS_MS)
+            if abs(total - e2e) > tol:
+                problems.append(
+                    f"{path}:{i + 1}: reqtrace decomposition broken — "
+                    f"request {rec.get('rid')}'s spans sum to "
+                    f"{total:.4f}ms but e2e_ms is {e2e}ms (tolerance "
+                    f"{tol:.4f}ms): the timeline does not account for "
+                    "the latency it claims to explain")
+        outcome = rec.get("outcome")
+        if ("admit" not in kinds
+                and (kinds & {"prefill_chunk", "decode"}
+                     or outcome == "finished")):
+            problems.append(
+                f"{path}:{i + 1}: reqtrace for request {rec.get('rid')} "
+                f"({outcome}) did engine work with no admit span — a "
+                "request cannot be served out of a queue it was never "
+                "admitted from")
+        if outcome != "shed" and "finalize" not in kinds:
+            problems.append(
+                f"{path}:{i + 1}: reqtrace for request {rec.get('rid')} "
+                f"({outcome}) carries no finalize span — a trace with "
+                "no terminal transition is a dropped request")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -625,12 +711,14 @@ def check_pair(jsonl_path, trace_path=None):
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
-     n_serving, n_kernel, problems) = check_metrics_jsonl(jsonl_path)
+     n_serving, n_kernel, n_reqtrace, problems) = \
+        check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
              "n_elastic": n_elastic, "n_serving": n_serving,
-             "n_kernel": n_kernel, "n_events": 0, "ranks": set()}
+             "n_kernel": n_kernel, "n_reqtrace": n_reqtrace,
+             "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
         stats["n_events"], stats["ranks"] = n_ev, ranks
@@ -682,6 +770,8 @@ def main(argv):
         msg += f" ({stats['n_serving']} serving events)"
     if stats.get("n_kernel"):
         msg += f" ({stats['n_kernel']} kernel-lint records)"
+    if stats.get("n_reqtrace"):
+        msg += f" ({stats['n_reqtrace']} request traces)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
