@@ -41,6 +41,31 @@ __all__ = ["moe_gather", "moe_combine", "gather_fallback",
 _BLOCK_ROWS = 128
 
 
+def _resolve_rows(kernel, d, dtype, n_src):
+    """Output-block row count for one dispatch/combine call: the
+    hand-tuned _BLOCK_ROWS default, overridden by a `kernellab --tune`d
+    config from the kernel DB when the opt-in PADDLE_TPU_KERNEL_DB flag
+    is set. A tuned value must re-pass the SAME KN502 feasibility the
+    support gate projects (rows block moving, src resident) — an edited
+    DB can never force an infeasible block — and must keep the (8, 128)
+    f32 sublane tiling."""
+    import os
+    if not os.environ.get("PADDLE_TPU_KERNEL_DB", "").strip():
+        return _BLOCK_ROWS
+    try:
+        from ..telemetry import kernel_obs
+        rows = kernel_obs.tuned_param(
+            kernel, "block_rows", match={"d": int(d)},
+            validate=lambda v: (isinstance(v, int) and v >= 8
+                                and v % 8 == 0
+                                and fits_vmem(
+                                    moving=[((v, d), dtype)],
+                                    resident=[((n_src, d), dtype)])))
+        return rows if rows is not None else _BLOCK_ROWS
+    except Exception:
+        return _BLOCK_ROWS
+
+
 def _interpret():
     return jax.default_backend() != "tpu"
 
@@ -113,16 +138,17 @@ def _gather_example(rng):
 def _gather_pallas(src, idx):
     n_src, d = src.shape
     n_out = idx.shape[0]
-    idx_p = _pad_to(idx.astype(jnp.int32), _BLOCK_ROWS, n_src)
+    rows = _resolve_rows("moe_gather", d, src.dtype, n_src)
+    idx_p = _pad_to(idx.astype(jnp.int32), rows, n_src)
     n_pad = idx_p.shape[0]
-    grid = (n_pad // _BLOCK_ROWS,)
+    grid = (n_pad // rows,)
     out = pl.pallas_call(
-        functools.partial(_gather_kernel, rows=_BLOCK_ROWS, n_src=n_src),
+        functools.partial(_gather_kernel, rows=rows, n_src=n_src),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[pl.BlockSpec((n_src, d), lambda b, *_: (0, 0))],
-            out_specs=pl.BlockSpec((_BLOCK_ROWS, d),
+            out_specs=pl.BlockSpec((rows, d),
                                    lambda b, *_: (b, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, d), src.dtype),
@@ -218,19 +244,20 @@ def _combine_example(rng):
 def _combine_pallas(src, idx, w):
     n_src, d = src.shape
     n, k = idx.shape
-    pad = (-n) % _BLOCK_ROWS
-    idx_p = _pad_to(idx.astype(jnp.int32), _BLOCK_ROWS, n_src)
-    w_p = _pad_to(w.astype(jnp.float32), _BLOCK_ROWS, 0.0)
+    rows = _resolve_rows("moe_combine", d, src.dtype, n_src)
+    pad = (-n) % rows
+    idx_p = _pad_to(idx.astype(jnp.int32), rows, n_src)
+    w_p = _pad_to(w.astype(jnp.float32), rows, 0.0)
     n_pad = n + pad
-    grid = (n_pad // _BLOCK_ROWS,)
+    grid = (n_pad // rows,)
     out = pl.pallas_call(
-        functools.partial(_combine_kernel, rows=_BLOCK_ROWS, k=k,
+        functools.partial(_combine_kernel, rows=rows, k=k,
                           n_src=n_src),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[pl.BlockSpec((n_src, d), lambda b, *_: (0, 0))],
-            out_specs=pl.BlockSpec((_BLOCK_ROWS, d),
+            out_specs=pl.BlockSpec((rows, d),
                                    lambda b, *_: (b, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, d), src.dtype),

@@ -92,6 +92,16 @@ class HealthConfig:
     flops_drift_tol   relative drift between a compile record's
                       cost.flops and its analytic_flops (the peak-FLOPs
                       table MFU claims ride on) that fires `flops_drift`
+    kernel_drift_tol  multiplicative tolerance between a kernelbench
+                      record's measured kernel_ms and its roofline-
+                      predicted predicted_ms (telemetry/kernel_obs):
+                      `kernel_time_drift` fires when the ratio leaves
+                      [1/(1+tol), 1+tol] — symmetric in log space so
+                      BOTH directions are reachable (slower: the
+                      kernel lost its roofline position; faster than
+                      the roofline floor: the KN503 counts the
+                      prediction rides on are inflated). Latched per
+                      kernel.
     ckpt_stall_s      a kind=ckpt commit record whose save_ms exceeds
                       this many seconds fires `checkpoint_stall`
                       (resilience.CheckpointManager records)
@@ -115,6 +125,7 @@ class HealthConfig:
                  z_loss=8.0, z_grad=8.0, z_step_time=8.0,
                  rel_step_time=1.5, storm_compiles=5, storm_window_steps=32,
                  hbm_drift_tol=0.15, flops_drift_tol=0.25,
+                 kernel_drift_tol=3.0,
                  ckpt_stall_s=300.0, tail_cause_frac=0.6,
                  tail_cause_count=4, hang_deadline_s=None, dump_dir=".",
                  dump_on_exception=True, ring_size=64):
@@ -135,6 +146,7 @@ class HealthConfig:
         self.storm_window_steps = int(storm_window_steps)
         self.hbm_drift_tol = float(hbm_drift_tol)
         self.flops_drift_tol = float(flops_drift_tol)
+        self.kernel_drift_tol = float(kernel_drift_tol)
         self.ckpt_stall_s = float(ckpt_stall_s)
         self.tail_cause_frac = float(tail_cause_frac)
         self.tail_cause_count = int(tail_cause_count)
@@ -318,6 +330,10 @@ class AnomalyDetector:
             found = self._observe_reqtrace(rec)
             self.anomalies.extend(found)
             return found
+        if rec.get("kind") == "kernelbench":
+            found = self._observe_kernelbench(rec)
+            self.anomalies.extend(found)
+            return found
         step = rec.get("step", self._n - 1)
         found = []
 
@@ -457,6 +473,52 @@ class AnomalyDetector:
                     f"{float(analytic):.3e} the MFU accounting assumes "
                     f"(tolerance {c.flops_drift_tol * 100:.0f}%)",
                     expected=analytic, z=round(drift, 3)))
+        return found
+
+    def _observe_kernelbench(self, rec):
+        """The kernel_time_drift rule over one kernel-observatory
+        measurement record (telemetry/kernel_obs via tools/kernellab):
+        measured kernel_ms vs the roofline-predicted predicted_ms,
+        latched per kernel like the compile drift rules — a drifting
+        kernel fires once (a sweep measures it at many shapes — one
+        page, not N) and re-arms only after a measurement comes back
+        inside tolerance. Records without predicted_ms (CPU backends,
+        where the peak tables answer None) are exempt: no roofline, no
+        drift to judge. Same records in flight and offline
+        (tools/healthwatch.py, kernellab --selfcheck), so replays
+        agree."""
+        c = self.config
+        found = []
+        kernel = rec.get("kernel", "?")
+        measured = rec.get("kernel_ms")
+        predicted = rec.get("predicted_ms")
+        if not isinstance(measured, (int, float)) or measured <= 0 \
+                or not isinstance(predicted, (int, float)) \
+                or predicted <= 0:
+            return found
+        # Multiplicative band: relative drift is bounded below by -1,
+        # so a subtractive |drift| > tol test with tol >= 1 could NEVER
+        # fire in the too-fast direction. The ratio test is symmetric
+        # in log space and both sides stay reachable at any tolerance.
+        ratio = float(measured) / float(predicted)
+        band = 1.0 + c.kernel_drift_tol
+        if 1.0 / band <= ratio <= band:
+            self._drift_latched.discard(("kernel_time_drift", kernel))
+        elif ("kernel_time_drift", kernel) not in self._drift_latched:
+            self._drift_latched.add(("kernel_time_drift", kernel))
+            if ratio > band:
+                side = (f"{ratio:.1f}x slower than")
+            else:
+                side = (f"{1.0 / ratio:.1f}x faster than")
+            found.append(Anomaly(
+                "kernel_time_drift", rec.get("step", self._n - 1),
+                float(measured),
+                f"{kernel}: measured {float(measured):.3f} ms is "
+                f"{side} the roofline-predicted "
+                f"{float(predicted):.3f} ms (band {1.0 / band:.2f}x"
+                f"–{band:.2f}x) — the KN503 counts or the peak tables "
+                "no longer describe this kernel",
+                expected=predicted, z=round(ratio, 3)))
         return found
 
     def _observe_ckpt(self, rec):

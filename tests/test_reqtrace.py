@@ -396,7 +396,7 @@ class TestEngineIntegration:
     def test_trace_check_clean(self, traced_run):
         *counts, probs = _trace_check_path(traced_run["path"])
         assert probs == []
-        assert counts[-1] == 3              # n_reqtrace
+        assert counts[9] == 3               # n_reqtrace
 
     def test_zero_recompiles_under_tracing(self, traced_run):
         fams = {}

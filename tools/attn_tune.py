@@ -7,6 +7,16 @@ fori_loop (output normalized and fed back as input, so the axon tunnel
 cannot dedupe dispatches), two-point t(3K)-t(K) outer timing.
 
 Usage: python tools/attn_tune.py [--sweep] [--d128]
+
+DEPRECATED in favor of `tools/kernellab.py --tune flash_fwd`: the
+kernel lab runs the same (block_q, block_k) sweep — the grid below is
+absorbed as kernel_obs.ATTN_SWEEP_BQ/BK, imported back here so the two
+can never drift — but adds KN502 vmem feasibility pre-filtering, a
+KN504 parity re-fuzz on the winner, and persistence into
+tools/kernel_db.json where ops/pallas_attention._resolve_blocks can
+consult it behind PADDLE_TPU_KERNEL_DB. This script stays as the
+manual two-point-timing harness for ad-hoc ceiling comparisons against
+jax's bundled flash attention; new tuning work goes through the lab.
 """
 import argparse
 import time
@@ -135,8 +145,12 @@ def main():
         bench_point(16384, 1, 16, 128, label="cur S=16k D=128")
         bench_jax_reference(16384, 1, 16, 128)
     if args.sweep:
-        for bq in (256, 512, 1024, 2048):
-            for bk in (512, 1024, 2048):
+        # the sweep spec lives in kernel_obs (kernellab --tune runs the
+        # same grid); importing it back keeps the two from drifting
+        from paddle_tpu.telemetry.kernel_obs import (ATTN_SWEEP_BK,
+                                                     ATTN_SWEEP_BQ)
+        for bq in ATTN_SWEEP_BQ:
+            for bk in ATTN_SWEEP_BK:
                 bench_point(16384, 1, 12, 64, bq, bk, label="sweep D=64 ")
 
 

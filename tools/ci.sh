@@ -82,6 +82,14 @@ JAX_PLATFORMS=cpu python tools/autoshard.py --selfcheck
 # standalone astlint run above), and the emitted kind=kernel_lint
 # records must validate under tools/trace_check.py
 JAX_PLATFORMS=cpu python tools/kerneldoctor.py --selfcheck
+# kernel lab gate (tools/kernellab.py over telemetry/kernel_obs.py),
+# the doctor's MEASURED sibling, same two-sided pattern: the drift
+# specimen (tools/specimens/kernelbench_drift.jsonl) must trip the
+# kernel_time_drift anomaly BY NAME in both directions through the
+# real AnomalyDetector, a clean measurement run over every registered
+# kernel must validate under trace_check and stay quiet, and the
+# timing DB must refuse non-finite rows and round-trip losslessly
+JAX_PLATFORMS=cpu python tools/kernellab.py --selfcheck
 
 echo "== [4/10] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
@@ -135,6 +143,20 @@ JAX_PLATFORMS=cpu python tools/serving_drill.py --rated-only \
     2>> /tmp/bench_health_ci.err \
     || { tail -40 /tmp/bench_health_ci.err >&2
          echo "FATAL: serving rated-load leg failed"; exit 1; }
+# kernel-lab smoke (tools/kernellab.py --smoke): every registered
+# Pallas kernel measured once — compile-excluded median-of-k, declared
+# fallback timed on the SAME inputs — with the kind=kernelbench
+# records gated through trace_check inside the tool (exit 13 on any
+# finding) and its kernel.<name>.smoke_ms kind=bench rows appended to
+# the SAME gated file, so bench_gate tracks kernel smoke timings
+# record-against-record like every other metric (direction 'info'
+# until a TPU round binds the device) and healthwatch replays the
+# kernel_time_drift rule over the measurements below
+JAX_PLATFORMS=cpu python tools/kernellab.py --smoke \
+    --telemetry /tmp/bench_health_ci.jsonl \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: kernel-lab smoke failed"; exit 1; }
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \

@@ -208,8 +208,12 @@ def _records_validate(infos, budget, trace_check):
             f.write(json.dumps(_info_to_record(info, budget)) + "\n")
         path = f.name
     try:
-        *counts, problems = trace_check.check_metrics_jsonl(path)
-        n_kernel = counts[-1]
+        # check_pair's NAMED stats, not the positional count tuple:
+        # counts[-1] silently re-bound to the newest record kind every
+        # time check_metrics_jsonl grew (the n_reqtrace append broke
+        # this exact line)
+        problems, stats = trace_check.check_pair(path)
+        n_kernel = stats["n_kernel"]
         if problems:
             print("SELFCHECK FAILED: kernel_lint records did not "
                   "validate:", file=sys.stderr)

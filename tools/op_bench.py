@@ -11,6 +11,13 @@ Usage:
     python tools/op_bench.py --out op_bench.json [--iters 30] [--small]
 Emits one JSON object {case_name: {"ms": float, "shape": ..., ...}}.
 Compare two runs with tools/check_op_benchmark_result.py.
+
+NOTE: for the REGISTERED Pallas kernels, prefer
+`tools/kernellab.py` — it measures kernel vs declared fallback on
+identical seeded inputs, attributes time against the KN503-traced
+roofline, and persists best-known timings to tools/kernel_db.json.
+This suite stays for ops without a registry entry (elementwise,
+reductions, XLA-lowered composites) and for A/B runs across commits.
 """
 import argparse
 import json

@@ -10,6 +10,13 @@ Timing discipline is bench.py's (see .claude/skills/verify/SKILL.md):
 every timed iteration CHAINS on the previous result (the axon tunnel
 dedups/overlaps repeated identical dispatches) and syncs via a real
 device->host fetch with the median-probe latency subtracted.
+
+NOTE: the kernel-vs-fallback half of these phases is superseded by
+`tools/kernellab.py` (same-input fallback timing + roofline
+attribution + the persistent kernel_db.json for every registered
+kernel). This script remains the flag-decision harness: it times the
+FULL op path behind each perf flag (dispatch + layout + surrounding
+XLA fusion), which is the number the flag defaults actually ride on.
 """
 import json
 import os

@@ -23,7 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
-    n_serving_records, n_kernel_records, n_reqtrace_records, problems).
+    n_serving_records, n_kernel_records, n_reqtrace_records,
+    n_kernelbench_records, problems). Positional consumers should
+    prefer check_pair's named stats dict — this tuple GROWS when a new
+    record kind lands (kerneldoctor's selfcheck was silently broken by
+    exactly such an append once).
 
     An empty or record-free metrics file is a FAILURE, not a vacuous
     pass: a validator that says OK about a file no step ever wrote
@@ -34,10 +38,11 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
-                                                  "metrics file (0 "
-                                                  "bytes): no step "
-                                                  "was ever recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
+                                                     "metrics file (0 "
+                                                     "bytes): no step "
+                                                     "was ever "
+                                                     "recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -48,7 +53,8 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: "
+                                                 f"{e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -63,6 +69,7 @@ def check_metrics_jsonl(path):
     problems += check_serving_records(records, path)
     problems += check_kernel_records(records, path)
     problems += check_reqtrace_records(records, path)
+    problems += check_kernelbench_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -83,8 +90,12 @@ def check_metrics_jsonl(path):
     n_reqtrace = sum(1 for r in records
                      if isinstance(r, dict)
                      and r.get("kind") == "reqtrace")
+    n_kernelbench = sum(1 for r in records
+                        if isinstance(r, dict)
+                        and r.get("kind") == "kernelbench")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
-            n_elastic, n_serving, n_kernel, n_reqtrace, problems)
+            n_elastic, n_serving, n_kernel, n_reqtrace, n_kernelbench,
+            problems)
 
 
 def check_compile_records(records, path):
@@ -673,6 +684,72 @@ def check_reqtrace_records(records, path):
     return problems
 
 
+# speedup must agree with the two timings it claims to summarize
+KERNELBENCH_SPEEDUP_TOL = 0.05
+
+
+def check_kernelbench_records(records, path):
+    """Cross-rules over kernel-observatory measurement records
+    (kind='kernelbench', telemetry/kernel_obs via tools/kernellab.py).
+    The schema basics (non-negative ms, roofline fractions in [0, 1])
+    live in sink.validate_step_record; here the claims that span
+    fields or records:
+
+    - a speedup claim requires BOTH timings (kernel_ms and
+      fallback_ms) and must equal fallback_ms / kernel_ms within 5% —
+      a ratio the ledger cannot reproduce is a doctored row;
+    - a db_update event must reference, by db_key, a measured row
+      (event measure/tune) present in the SAME file — the DB may only
+      roll forward from measurements the ledger shows.
+    """
+    problems = []
+    measured_keys = set()
+    for r in records:
+        if isinstance(r, dict) and r.get("kind") == "kernelbench" \
+                and r.get("event") in (None, "measure", "tune") \
+                and r.get("db_key"):
+            measured_keys.add(r["db_key"])
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "kernelbench":
+            continue
+        sp = rec.get("speedup")
+        km = rec.get("kernel_ms")
+        fm = rec.get("fallback_ms")
+        if sp is not None:
+            if not isinstance(km, (int, float)) \
+                    or not isinstance(fm, (int, float)):
+                problems.append(
+                    f"{path}:{i + 1}: kernelbench {rec.get('kernel')} "
+                    f"claims speedup {sp} without both timings "
+                    "(kernel_ms and fallback_ms) — a ratio with no "
+                    "numerator or denominator on the ledger")
+            elif km > 0 and isinstance(sp, (int, float)) and sp == sp:
+                want = fm / km
+                if abs(sp - want) > KERNELBENCH_SPEEDUP_TOL \
+                        * max(abs(want), 1e-9):
+                    problems.append(
+                        f"{path}:{i + 1}: kernelbench "
+                        f"{rec.get('kernel')} speedup {sp:.4f} does "
+                        f"not match fallback_ms/kernel_ms = "
+                        f"{want:.4f} — the ratio and its inputs "
+                        "disagree")
+        if rec.get("event") == "db_update":
+            key = rec.get("db_key")
+            if not key:
+                problems.append(
+                    f"{path}:{i + 1}: kernelbench db_update for "
+                    f"{rec.get('kernel')} carries no db_key — an "
+                    "update that references nothing")
+            elif key not in measured_keys:
+                problems.append(
+                    f"{path}:{i + 1}: kernelbench db_update "
+                    f"references db_key {key!r} but no measured "
+                    "(measure/tune) record in this file carries it — "
+                    "the DB may only roll forward from measurements "
+                    "the ledger shows")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -711,13 +788,14 @@ def check_pair(jsonl_path, trace_path=None):
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
-     n_serving, n_kernel, n_reqtrace, problems) = \
+     n_serving, n_kernel, n_reqtrace, n_kernelbench, problems) = \
         check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
              "n_elastic": n_elastic, "n_serving": n_serving,
              "n_kernel": n_kernel, "n_reqtrace": n_reqtrace,
+             "n_kernelbench": n_kernelbench,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -772,6 +850,8 @@ def main(argv):
         msg += f" ({stats['n_kernel']} kernel-lint records)"
     if stats.get("n_reqtrace"):
         msg += f" ({stats['n_reqtrace']} request traces)"
+    if stats.get("n_kernelbench"):
+        msg += f" ({stats['n_kernelbench']} kernel measurements)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
