@@ -31,6 +31,12 @@ own source, all reporting through one `Finding` model:
                       grid races, VMEM footprints, CostEstimate
                       honesty, fallback parity and grid-spec sanity
                       per `pallas_call` site.  Rules KN5xx.
+- `threadlint`      — the Concurrency Doctor: lock-discipline rules
+                      over the host-side threaded runtime (guarded-by
+                      annotations, lock-order cycles, blocking calls
+                      under locks, condition misuse), paired with the
+                      `lockwatch` runtime lock-order witness.
+                      Rules TH6xx.
 
 Entry points: `tools/graphdoctor.py` (CLI over the in-repo GPT/ResNet
 configs), `TrainStep(..., lint=True)` / `ShardedTrainStep(...,
@@ -50,6 +56,7 @@ FAMILIES = {
     "CO": "collective_order",
     "FW": "framework",
     "KN": "kernel",
+    "TH": "thread",
 }
 
 

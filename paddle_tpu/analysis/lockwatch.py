@@ -1,0 +1,393 @@
+"""Runtime lock-order witness: opt-in instrumented lock/condition
+proxies for the host-side threaded runtime.
+
+The static half (`analysis/threadlint.py`) proves lock discipline over
+the SOURCE — declared guarded-by relations, a nested-acquisition graph,
+no cycles. This module watches the locks actually taken at RUNTIME and
+records what the static pass can only approximate:
+
+- every acquisition-order edge observed across threads (lock A held
+  while lock B is acquired), with counts;
+- per-lock hold durations, current holder thread, and waiter counts —
+  the `locks` section of the watchdog black-box dump
+  (`telemetry/watchdog.py`), so a stall names which thread holds which
+  lock;
+- typed `kind=thread_lint` telemetry records (source="lockwatch") that
+  `tools/trace_check.py` cross-rules against the static graph: the
+  observed edge set must be a SUBGRAPH of the static one, and any
+  observed cycle fails outright.
+
+Zero-cost when off: `make_lock`/`make_rlock`/`make_condition` return
+the RAW `threading` primitives unless `arm()` has been called — no
+proxy, no bookkeeping, not even a registry entry. Arming affects only
+locks constructed AFTER the call (`tools/serving_smoke.py` and
+`tools/serving_drill.py` arm before building their engines).
+
+Naming convention: pass the static graph's node name,
+``f"{ClassName}.{attr}"`` (e.g. ``"ServingEngine._mu"``), so observed
+edges line up with `threadlint.static_lock_graph()` nodes.
+
+    from paddle_tpu.analysis import lockwatch
+    lockwatch.arm()
+    ...
+    self._mu = lockwatch.make_rlock("ServingEngine._mu")
+    self._cv = lockwatch.make_condition("ServingEngine._cv", self._mu)
+    ...
+    lockwatch.edges()            # [(holder, acquired, count), ...]
+    lockwatch.observed_cycles()  # [] or the offending node cycles
+    lockwatch.snapshot()         # per-lock holder/hold/waiter table
+"""
+import threading
+import time
+
+_WATCH_MU = threading.Lock()
+_ARMED = False        # guarded by: none (read lock-free by armed(); flipped only by arm/disarm)
+_NODES = {}           # guarded by: _WATCH_MU
+_EDGES = {}           # guarded by: _WATCH_MU
+
+_TLS = threading.local()
+
+
+def _held_stack():
+    """Per-thread list of node names currently held, in acquisition
+    order."""
+    st = getattr(_TLS, "held", None)
+    if st is None:
+        st = _TLS.held = []
+    return st
+
+
+def _depths():
+    """Per-thread {node name: re-entrant depth} for RLock accounting."""
+    d = getattr(_TLS, "depth", None)
+    if d is None:
+        d = _TLS.depth = {}
+    return d
+
+
+def arm():
+    """Future make_* constructions return traced proxies."""
+    global _ARMED
+    _ARMED = True
+
+
+def disarm():
+    global _ARMED
+    _ARMED = False
+
+
+def armed():
+    return _ARMED
+
+
+def reset():
+    """Drop all registered nodes and observed edges (tests)."""
+    with _WATCH_MU:
+        _NODES.clear()
+        _EDGES.clear()
+
+
+def _register(name):
+    with _WATCH_MU:
+        return _node(name)
+
+
+def _node(name):    # requires: _WATCH_MU
+    """Node row for `name`, created on demand — a traced proxy can
+    OUTLIVE reset() (e.g. a sink closed by its atexit hook after the
+    harness reset the witness), so the bookkeeping paths must never
+    assume registration survived. Callers hold _WATCH_MU."""
+    node = _NODES.get(name)
+    if node is None:
+        node = _NODES[name] = {
+            "name": name, "holder": None, "held_since": None,
+            "acquires": 0, "waiters": 0, "max_hold_ms": 0.0,
+        }
+    return node
+
+
+def _on_acquired(name, held_before):
+    now = time.monotonic()
+    with _WATCH_MU:
+        node = _node(name)
+        node["holder"] = threading.current_thread().name
+        node["held_since"] = now
+        node["acquires"] += 1
+        for h in held_before:
+            if h != name:
+                key = (h, name)
+                _EDGES[key] = _EDGES.get(key, 0) + 1
+
+
+def _on_released(name):
+    now = time.monotonic()
+    with _WATCH_MU:
+        node = _node(name)
+        if node["held_since"] is not None:
+            hold_ms = (now - node["held_since"]) * 1000.0
+            if hold_ms > node["max_hold_ms"]:
+                node["max_hold_ms"] = hold_ms
+        node["holder"] = None
+        node["held_since"] = None
+
+
+def _waiters_delta(name, delta):
+    with _WATCH_MU:
+        _node(name)["waiters"] += delta
+
+
+class _TracedLock:
+    """Proxy over a raw threading.Lock/RLock recording order edges,
+    hold durations, and waiters. Duck-types the lock API the runtime
+    uses (acquire/release/context manager)."""
+
+    def __init__(self, name, raw):
+        self._name = name            # guarded by: none (immutable after construction)
+        self._raw = raw              # guarded by: none (immutable after construction)
+        _register(name)
+
+    def acquire(self, blocking=True, timeout=-1):
+        name = self._name
+        depths = _depths()
+        if depths.get(name, 0) > 0:
+            # re-entrant (RLock): no edge, no hold restart
+            got = self._raw.acquire(blocking, timeout)
+            if got:
+                depths[name] += 1
+            return got
+        got = self._raw.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            _waiters_delta(name, +1)
+            try:
+                got = self._raw.acquire(True, timeout)
+            finally:
+                _waiters_delta(name, -1)
+        if got:
+            held = _held_stack()
+            _on_acquired(name, tuple(held))
+            depths[name] = 1
+            held.append(name)
+        return got
+
+    def release(self):
+        name = self._name
+        depths = _depths()
+        d = depths.get(name, 0)
+        if d <= 1:
+            depths.pop(name, None)
+            held = _held_stack()
+            if name in held:
+                held.remove(name)
+            _on_released(name)
+        else:
+            depths[name] = d - 1
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._raw.locked() if hasattr(self._raw, "locked") \
+            else _depths().get(self._name, 0) > 0
+
+    def __repr__(self):
+        return f"<_TracedLock {self._name} raw={self._raw!r}>"
+
+
+class _TracedCondition:
+    """Condition sharing a _TracedLock's node: holding the condition IS
+    holding its lock (the threadlint alias rule, mirrored at runtime).
+    Wraps threading.Condition over the RAW lock so wait() keeps the
+    stdlib release/re-acquire semantics, with held-stack bookkeeping
+    saved around the wait."""
+
+    def __init__(self, tlock):
+        self._tlock = tlock          # guarded by: none (immutable after construction)
+        self._cond = threading.Condition(tlock._raw)   # guarded by: none (immutable after construction)
+
+    def acquire(self, *a, **kw):
+        return self._tlock.acquire(*a, **kw)
+
+    def release(self):
+        self._tlock.release()
+
+    def __enter__(self):
+        self._tlock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._tlock.release()
+        return False
+
+    def wait(self, timeout=None):
+        name = self._tlock._name
+        depths = _depths()
+        saved = depths.pop(name, 0)
+        held = _held_stack()
+        if name in held:
+            held.remove(name)
+        _on_released(name)
+        try:
+            # pass-through proxy: the predicate loop is the CALLER's
+            return self._cond.wait(timeout)  # threadlint: disable=TH604
+        finally:
+            # the stdlib Condition re-acquired the raw lock in full
+            _on_acquired(name, tuple(held))
+            depths[name] = saved if saved else 1
+            held.append(name)
+
+    def wait_for(self, predicate, timeout=None):
+        result = predicate()
+        if result:
+            return result
+        endtime = None
+        waittime = timeout
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<_TracedCondition over {self._tlock._name}>"
+
+
+def make_lock(name):
+    """A threading.Lock, traced under `name` when armed."""
+    if not _ARMED:
+        return threading.Lock()
+    return _TracedLock(name, threading.Lock())
+
+
+def make_rlock(name):
+    """A threading.RLock, traced under `name` when armed."""
+    if not _ARMED:
+        return threading.RLock()
+    return _TracedLock(name, threading.RLock())
+
+
+def make_condition(name, lock=None):
+    """A threading.Condition over `lock` (a make_lock/make_rlock result
+    or None). When armed and `lock` is traced, the condition shares the
+    lock's node — `name` is kept for symmetry with the static graph's
+    alias rule."""
+    if isinstance(lock, _TracedLock):
+        return _TracedCondition(lock)
+    if not _ARMED:
+        return threading.Condition(lock)
+    if lock is None:
+        return _TracedCondition(_TracedLock(name, threading.RLock()))
+    # a raw lock constructed before arming: no tracing possible
+    return threading.Condition(lock)
+
+
+def snapshot():
+    """Per-lock table: the watchdog black-box `locks` section. Each row
+    names the current holder thread (None when free), how long it has
+    been held, how many threads are blocked waiting, and lifetime
+    acquire/max-hold stats."""
+    now = time.monotonic()
+    with _WATCH_MU:
+        rows = []
+        for node in _NODES.values():
+            held_for = (now - node["held_since"]) \
+                if node["held_since"] is not None else None
+            rows.append({
+                "name": node["name"],
+                "holder": node["holder"],
+                "held_for_s": round(held_for, 6) if held_for is not None else None,
+                "waiters": node["waiters"],
+                "acquires": node["acquires"],
+                "max_hold_ms": round(node["max_hold_ms"], 3),
+            })
+        return sorted(rows, key=lambda r: r["name"])
+
+
+def edges():
+    """Observed acquisition-order edges: [(held, acquired, count)]."""
+    with _WATCH_MU:
+        return sorted((a, b, n) for (a, b), n in _EDGES.items())
+
+
+def observed_cycles():
+    """Cycles in the observed edge graph — each a list of node names
+    [n0, n1, ..., n0]. Empty means the observed order is acyclic."""
+    adj = {}
+    for a, b, _n in edges():
+        adj.setdefault(a, []).append(b)
+    return find_cycles(adj)
+
+
+def find_cycles(adj):
+    """Cycle enumeration over an adjacency dict {node: [node, ...]} —
+    shared with threadlint's static TH602 pass. Returns each distinct
+    cycle once as [n0, ..., n0]."""
+    cycles = []
+    seen_sets = set()
+    visited = set()
+
+    def dfs(node, stack, on_stack):
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in adj.get(node, ()):
+            if nxt in on_stack:
+                i = stack.index(nxt)
+                cyc = stack[i:] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(cyc)
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack)
+        stack.pop()
+        on_stack.discard(node)
+
+    for start in sorted(adj):
+        if start not in visited:
+            dfs(start, [], set())
+    return cycles
+
+
+def observed_record():
+    """One kind=thread_lint record (source="lockwatch") for the current
+    observed state — edges + the per-lock snapshot. Cycles become
+    findings so the record is self-incriminating even before
+    trace_check's cross-rules run."""
+    from paddle_tpu.telemetry import sink
+    findings = [
+        {"rule": "TH602",
+         "message": "observed lock-order cycle: " + " -> ".join(cyc)}
+        for cyc in observed_cycles()
+    ]
+    return sink.make_thread_lint_record(
+        source="lockwatch", findings=findings,
+        edges=[[a, b, n] for a, b, n in edges()],
+        locks=snapshot())
+
+
+__all__ = [
+    "arm", "disarm", "armed", "reset",
+    "make_lock", "make_rlock", "make_condition",
+    "snapshot", "edges", "observed_cycles", "observed_record",
+    "find_cycles",
+]

@@ -1,0 +1,869 @@
+"""Concurrency lint: static lock-discipline rules over the host-side
+threaded runtime (TH6xx) — the threading sibling of astlint's FW4xx.
+
+The doctor family verifies traced programs (jaxpr_lint), layouts
+(sharding_lint), and Pallas kernels (kernel_lint); the threads that RUN
+those programs — the serving engine's RLock+Condition, the scheduler
+and BlockPool it guards, HTTP handler threads, the watchdog, the
+prefetch device stage — were verified only by review. This pass makes
+the lock discipline declared and machine-checked:
+
+- TH601 unguarded shared state — classes that own a lock declare which
+        fields it guards (`# guarded by: _mu` trailing comments on the
+        `__init__` assignments, or a class-level `GUARDED_BY` dict);
+        every read/write of a declared field outside the declared lock
+        is a finding, and a lock-owning class with NO declarations at
+        all is one too (the FW405 closure move: new shared state can't
+        dodge the pass by staying silent). `__init__` and methods
+        declared `# threadlint: lock-free (reason)` are exempt;
+        `# guarded by: none (reason)` declares a deliberately lock-free
+        field; `# requires: _mu` on a `def` marks a helper whose
+        callers must hold the lock (checked at the call sites).
+- TH602 lock-order cycles — the nested-acquisition graph: `with
+        self._mu:` bodies that acquire other locks directly or through
+        self/typed-attribute calls (closed transitively over
+        self-calls, `# threadlint: type=` attributes, and
+        KNOWN_MODULE_LOCKS). Any cycle is a deadlock by construction;
+        the finding names every edge with its source site.
+- TH603 blocking call under lock — device dispatch (`*_jit` /
+        `block_until_ready` / `device_put`), socket/`wfile` writes,
+        bounded `queue.put`, thread `.join()`, and `time.sleep` inside
+        a held-lock region: each an eventual engine stall. A lock
+        annotated `# threadlint: dispatch-lock` is EXPECTED to
+        serialize device dispatch (the engine's step lock is the step
+        serializer by design) and exempts only the dispatch class —
+        sleep/join/socket under it still fail.
+- TH604 condition misuse & unbounded blocking on shutdown paths —
+        `Condition.wait` not lexically inside a `while` predicate loop;
+        timeout-less `.acquire()` / blocking `queue.get()` / bare
+        `.join()` reachable (one self-call level) from HTTP handler
+        methods or `stop`/`shutdown`/`close`/`drain`.
+
+Conventions the pass reads (all trailing comments, greppable):
+
+    self._mu = threading.RLock()            # threadlint: dispatch-lock
+    self._cv = threading.Condition(self._mu)  # holding _cv == holding _mu
+    self._n  = 0        # guarded by: _mu
+    self._hot = []      # guarded by: none (single-writer, racy len ok)
+    self._sink = sink   # threadlint: type=JsonlSink
+    def _reap(self):    # requires: _mu
+    def stop(self):     # threadlint: lock-free (manual bounded acquires)
+    class Scheduler:    # guarded by: ServingEngine._mu
+
+Known static limits (documented, not silent): manual `.acquire()`
+regions are not tracked as held (methods built on them declare
+lock-free); nested function bodies are skipped (execution time
+unknown); a dotted guard (`# guarded by: ServingEngine._mu`) documents
+cross-object ownership but is not checked across objects. Suppress one
+line with `# threadlint: disable=TH6xx`.
+
+Runtime twin: `analysis/lockwatch.py` proxies record the edges actually
+taken; `tools/trace_check.py` requires observed ⊆ static and acyclic.
+Entry point: `tools/threaddoctor.py` (ci.sh stage-3 leg).
+"""
+import ast
+import os
+import re
+
+from . import Finding, SEV_ERROR
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the threaded host-side runtime under the pass (repo-relative)
+MODULES = (
+    "paddle_tpu/serving/engine.py",
+    "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/serving/kv_cache.py",
+    "paddle_tpu/serving/http.py",
+    "paddle_tpu/serving/resilience.py",
+    "paddle_tpu/monitor.py",
+    "paddle_tpu/telemetry/sink.py",
+    "paddle_tpu/telemetry/recorder.py",
+    "paddle_tpu/telemetry/reqtrace.py",
+    "paddle_tpu/telemetry/watchdog.py",
+    "paddle_tpu/telemetry/metrics_http.py",
+    "paddle_tpu/io/prefetch.py",
+    "paddle_tpu/distributed/elastic.py",
+    "paddle_tpu/analysis/lockwatch.py",
+)
+
+# pre-seed legacy modules NOT under the pass — explicit, with reasons,
+# instead of silently passing. Moving one off this list means
+# annotating it and fixing what the pass finds.
+EXEMPT = {
+    "paddle_tpu/distributed/heter.py":
+        "pre-seed PS heter runtime: thread use predates the annotation "
+        "convention; superseded paths, kept for API parity",
+    "paddle_tpu/distributed/ps.py":
+        "pre-seed parameter-server runtime: native pskv.cc owns the "
+        "real synchronization; the python shim is legacy surface",
+    "paddle_tpu/reader.py":
+        "pre-seed reader combinators: deprecated in favor of "
+        "io/prefetch.py (see its multiprocess_reader note)",
+}
+
+# module-level bound-method aliases that are statically unresolvable
+# (e.g. monitor.incr = _registry.incr): calls through these module
+# names acquire the listed lock nodes
+KNOWN_MODULE_LOCKS = {
+    "monitor": ("StatRegistry._mu",),
+}
+
+_DISABLE_RE = re.compile(r"#\s*threadlint:\s*disable=([A-Z0-9,\s]+)")
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z_][A-Za-z0-9_.]*|none)")
+_REQUIRES_RE = re.compile(r"#\s*requires:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_LOCKFREE_RE = re.compile(r"#\s*threadlint:\s*lock-free")
+_DISPATCH_RE = re.compile(r"#\s*threadlint:\s*dispatch-lock")
+_TYPE_RE = re.compile(r"#\s*threadlint:\s*type=([A-Za-z_][A-Za-z0-9_]*)")
+
+_LOCK_CTORS = frozenset(("Lock", "RLock", "make_lock", "make_rlock"))
+_COND_CTORS = frozenset(("Condition", "make_condition"))
+_QUEUE_CTORS = frozenset(("Queue", "LifoQueue", "PriorityQueue"))
+_BLOCKING_DEVICE = frozenset(("block_until_ready", "device_put"))
+_THREADISH = ("thread", "worker", "proc", "pool")
+_ENTRY_METHODS = frozenset(("stop", "shutdown", "close", "drain"))
+
+
+def _dotted(node):
+    """Call func -> tuple of name parts ('self','_mu','acquire') or ()."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("")
+    return tuple(reversed(parts))
+
+
+def _disabled_rules(src_lines, lineno):
+    if 0 < lineno <= len(src_lines):
+        m = _DISABLE_RE.search(src_lines[lineno - 1])
+        if m:
+            return {r.strip() for r in m.group(1).split(",")}
+    return set()
+
+
+def _scan_nodes(node):
+    """ast.walk pruning nested function/lambda bodies (their execution
+    time is unknown to the held-lock tracker)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class _Method:
+    __slots__ = ("name", "lineno", "lockfree", "requires", "acquires",
+                 "acq_events", "call_events", "self_calls", "attr_calls",
+                 "known_calls", "blocking")
+
+    def __init__(self, name, lineno):
+        self.name = name
+        self.lineno = lineno
+        self.lockfree = False
+        self.requires = None        # own-lock attr the caller must hold
+        self.acquires = set()       # qualified lock nodes acquired via `with`
+        self.acq_events = []        # (held frozenset, node, lineno)
+        self.call_events = []       # (held frozenset, kind, data, lineno)
+        self.self_calls = set()
+        self.attr_calls = set()     # (typed attr, method) — closure input
+        self.known_calls = set()    # KNOWN_MODULE_LOCKS module names
+        self.blocking = []          # (description, lineno) — TH604 reach
+
+
+class _ClassInfo:
+    __slots__ = ("name", "lineno", "bases", "locks", "conds", "dispatch",
+                 "guarded", "external", "attr_types", "queue_attrs",
+                 "methods", "has_guard_decl", "is_module")
+
+    def __init__(self, name, lineno, bases=(), is_module=False):
+        self.name = name            # node-name prefix (class or module stem)
+        self.lineno = lineno
+        self.bases = tuple(bases)
+        self.locks = {}             # attr -> canonical lock attr (aliases fold)
+        self.conds = set()          # attrs that are Conditions
+        self.dispatch = set()       # canonical attrs marked dispatch-lock
+        self.guarded = {}           # field -> lock attr | "none" | dotted
+        self.external = None        # class-line `# guarded by: Other._mu`
+        self.attr_types = {}        # attr -> class name
+        self.queue_attrs = {}       # attr -> bounded?
+        self.methods = {}
+        self.has_guard_decl = False
+        self.is_module = is_module
+
+    def qual(self, attr):
+        return f"{self.name}.{attr}"
+
+
+class _ModuleInfo:
+    __slots__ = ("path", "stem", "classes", "mod", "findings",
+                 "src_lines", "functions")
+
+    def __init__(self, path, stem):
+        self.path = path
+        self.stem = stem
+        self.classes = {}
+        self.mod = _ClassInfo(stem, 0, is_module=True)
+        self.findings = []
+        self.src_lines = []
+        self.functions = set()      # module-level function names
+
+
+class _ModuleLinter:
+    def __init__(self, path, src, stem=None):
+        self.mi = _ModuleInfo(
+            path, stem or os.path.splitext(os.path.basename(path))[0])
+        self.mi.src_lines = src.splitlines()
+        self.src = src
+        self._seen = set()          # finding dedup
+
+    # ---------------------------------------------------------------- emit
+    def _add(self, rule, lineno, message, suggestion=None):
+        if rule in _disabled_rules(self.mi.src_lines, lineno):
+            return
+        key = (rule, lineno, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.mi.findings.append(Finding(
+            rule, SEV_ERROR, f"{self.mi.path}:{lineno}", message,
+            suggestion))
+
+    # --------------------------------------------------------------- parse
+    def run(self):
+        try:
+            tree = ast.parse(self.src)
+        except SyntaxError as e:
+            self.mi.findings.append(Finding(
+                "TH600", SEV_ERROR, f"{self.mi.path}:{e.lineno}",
+                f"syntax error: {e.msg}"))
+            return self.mi
+        # module-level fields/locks + function/class inventory
+        for st in tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                self._classify_field(
+                    self.mi.mod, st.targets[0].id, st.value, st.lineno)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.mi.functions.add(st.name)
+        for st in tree.body:
+            if isinstance(st, ast.ClassDef):
+                self._parse_class(st)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(st, None)
+        self._coverage_checks()
+        return self.mi
+
+    def _line(self, lineno):
+        if 0 < lineno <= len(self.mi.src_lines):
+            return self.mi.src_lines[lineno - 1]
+        return ""
+
+    def _classify_field(self, ci, attr, value, lineno):
+        line = self._line(lineno)
+        if isinstance(value, ast.Call):
+            chain = _dotted(value.func)
+            tail = chain[-1] if chain else ""
+            if tail in _LOCK_CTORS:
+                ci.locks[attr] = attr
+                if _DISPATCH_RE.search(line):
+                    ci.dispatch.add(attr)
+            elif tail in _COND_CTORS:
+                # Condition(self._mu) / make_condition(name, self._mu):
+                # holding the condition == holding the aliased lock
+                lock_args = value.args[1:] if tail == "make_condition" \
+                    else value.args
+                alias = None
+                for a in lock_args:
+                    if isinstance(a, ast.Attribute) \
+                            and isinstance(a.value, ast.Name) \
+                            and a.value.id == "self" and a.attr in ci.locks:
+                        alias = ci.locks[a.attr]
+                    elif isinstance(a, ast.Name) and a.id in ci.locks:
+                        alias = ci.locks[a.id]
+                ci.locks[attr] = alias if alias else attr
+                ci.conds.add(attr)
+            elif tail in _QUEUE_CTORS:
+                bounded = bool(value.args)
+                for kw in value.keywords:
+                    if kw.arg == "maxsize":
+                        bounded = not (isinstance(kw.value, ast.Constant)
+                                       and kw.value.value in (0, None))
+                if value.args and isinstance(value.args[0], ast.Constant) \
+                        and value.args[0].value in (0, None):
+                    bounded = False
+                ci.queue_attrs[attr] = bounded
+            elif tail and tail[:1].isupper():
+                ci.attr_types[attr] = tail
+        m = _TYPE_RE.search(line)
+        if m:
+            ci.attr_types[attr] = m.group(1)
+        m = _GUARDED_RE.search(line)
+        if m and attr not in ci.locks:
+            ci.guarded[attr] = m.group(1)
+            ci.has_guard_decl = True
+
+    def _parse_class(self, node):
+        ci = _ClassInfo(node.name, node.lineno,
+                        bases=[".".join(p for p in _dotted(b) if p)
+                               for b in node.bases])
+        self.mi.classes[node.name] = ci
+        m = _GUARDED_RE.search(self._line(node.lineno))
+        if m:
+            ci.external = m.group(1)
+            ci.has_guard_decl = True
+        init = next((st for st in node.body
+                     if isinstance(st, ast.FunctionDef)
+                     and st.name == "__init__"), None)
+        if init is not None:
+            for st in ast.walk(init):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    t = st.targets[0]
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self._classify_field(ci, t.attr, st.value, st.lineno)
+        for st in node.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name) \
+                    and st.targets[0].id == "GUARDED_BY" \
+                    and isinstance(st.value, ast.Dict):
+                for k, v in zip(st.value.keys, st.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(v, ast.Constant):
+                        ci.guarded[str(k.value)] = str(v.value)
+                        ci.has_guard_decl = True
+        for st in node.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(st, ci)
+
+    def _coverage_checks(self):
+        # TH601 coverage: a lock-owning class (or module) with zero
+        # guarded-by declarations — new shared state dodging the pass
+        for ci in list(self.mi.classes.values()) + [self.mi.mod]:
+            owns = {a for a, c in ci.locks.items() if a == c}
+            if owns and not ci.has_guard_decl:
+                what = "module" if ci.is_module else f"class `{ci.name}`"
+                self._add(
+                    "TH601", ci.lineno or 1,
+                    f"{what} owns lock(s) {sorted(owns)} but declares no "
+                    "guarded fields: shared state is invisible to the "
+                    "concurrency doctor",
+                    suggestion="add `# guarded by: <lock>` trailing "
+                               "comments on the fields it protects (or "
+                               "`# guarded by: none (reason)` for "
+                               "deliberately lock-free ones)")
+
+    # ------------------------------------------------------------- walker
+    def _walk_function(self, node, ci):
+        name = node.name
+        owner = ci if ci is not None else self.mi.mod
+        meth = _Method(name, node.lineno)
+        owner.methods[name] = meth
+        defline = self._line(node.lineno)
+        meth.lockfree = bool(_LOCKFREE_RE.search(defline))
+        m = _REQUIRES_RE.search(defline)
+        if m:
+            meth.requires = m.group(1)
+        if name == "__init__":
+            # single-threaded by convention: fields are born here
+            return
+        held = set()
+        if meth.requires:
+            held.add(self._qual_lock(owner, meth.requires))
+        walker = _HeldWalker(self, owner, meth)
+        walker.walk(node.body, frozenset(held), in_while=False)
+
+    def _qual_lock(self, ci, attr):
+        canonical = ci.locks.get(attr, attr)
+        return ci.qual(canonical)
+
+
+class _HeldWalker:
+    """Statement-recursive walk of one function body tracking the set
+    of held lock nodes from lexical `with <lock>:` regions."""
+
+    def __init__(self, linter, ci, meth):
+        self.L = linter
+        self.ci = ci                 # owning class OR the module pseudo-class
+        self.mod = linter.mi.mod
+        self.meth = meth
+
+    # lock node of a with-context expression, or None
+    def _lock_of(self, expr):
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" \
+                and not self.ci.is_module and expr.attr in self.ci.locks:
+            return self.ci.qual(self.ci.locks[expr.attr])
+        if isinstance(expr, ast.Name) and expr.id in self.mod.locks:
+            return self.mod.qual(self.mod.locks[expr.id])
+        return None
+
+    def _dispatch_nodes(self):
+        out = {self.ci.qual(a) for a in self.ci.dispatch}
+        out |= {self.mod.qual(a) for a in self.mod.dispatch}
+        return out
+
+    def walk(self, stmts, held, in_while):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in st.items:
+                    lk = self._lock_of(item.context_expr)
+                    if lk is not None:
+                        acquired.append(lk)
+                    else:
+                        self._exprs(item.context_expr, held, in_while)
+                for lk in acquired:
+                    self.meth.acquires.add(lk)
+                    if held:
+                        self.meth.acq_events.append(
+                            (frozenset(held), lk, st.lineno))
+                self.walk(st.body, held | set(acquired), in_while)
+            elif isinstance(st, ast.While):
+                self._exprs(st.test, held, in_while)
+                self.walk(st.body, held, True)
+                self.walk(st.orelse, held, in_while)
+            elif isinstance(st, ast.For):
+                self._exprs(st.iter, held, in_while)
+                self._exprs(st.target, held, in_while)
+                self.walk(st.body, held, in_while)
+                self.walk(st.orelse, held, in_while)
+            elif isinstance(st, ast.If):
+                self._exprs(st.test, held, in_while)
+                self.walk(st.body, held, in_while)
+                self.walk(st.orelse, held, in_while)
+            elif isinstance(st, ast.Try):
+                self.walk(st.body, held, in_while)
+                for h in st.handlers:
+                    self.walk(h.body, held, in_while)
+                self.walk(st.orelse, held, in_while)
+                self.walk(st.finalbody, held, in_while)
+            else:
+                self._exprs(st, held, in_while)
+
+    # ----------------------------------------------------- expression pass
+    def _exprs(self, node, held, in_while):
+        for n in _scan_nodes(node):
+            if isinstance(n, ast.Attribute):
+                self._check_field_attr(n, held)
+            elif isinstance(n, ast.Name):
+                self._check_field_name(n, held)
+            elif isinstance(n, ast.Call):
+                self._check_call(n, held, in_while)
+
+    def _guard_violation(self, required, held):
+        if required == "none" or "." in required:
+            # deliberate lock-free / cross-object guard (documented,
+            # not checkable intra-class)
+            return None
+        owner = self.ci if not self.ci.is_module else self.mod
+        return None if self._qual(owner, required) in held \
+            else self._qual(owner, required)
+
+    @staticmethod
+    def _qual(ci, attr):
+        return ci.qual(ci.locks.get(attr, attr))
+
+    def _check_field_attr(self, n, held):
+        if self.ci.is_module or self.meth.lockfree:
+            return
+        if not (isinstance(n.value, ast.Name) and n.value.id == "self"):
+            return
+        required = self.ci.guarded.get(n.attr)
+        if required is None:
+            return
+        if required == "none" or "." in required:
+            return
+        need = self._qual(self.ci, required)
+        if need not in held:
+            self.L._add(
+                "TH601", n.lineno,
+                f"`self.{n.attr}` is declared guarded by "
+                f"`{required}` but accessed in `{self.meth.name}` "
+                f"without holding it",
+                suggestion=f"wrap the access in `with self.{required}:` "
+                           f"or declare the method `# threadlint: "
+                           f"lock-free (reason)` / `# requires: "
+                           f"{required}`")
+
+    def _check_field_name(self, n, held):
+        if self.meth.lockfree:
+            return
+        required = self.mod.guarded.get(n.id)
+        if required is None or required == "none" or "." in required:
+            return
+        need = self._qual(self.mod, required)
+        if need not in held:
+            self.L._add(
+                "TH601", n.lineno,
+                f"module global `{n.id}` is declared guarded by "
+                f"`{required}` but accessed in `{self.meth.name}` "
+                f"without holding it",
+                suggestion=f"wrap the access in `with {required}:`")
+
+    def _check_call(self, call, held, in_while):
+        chain = _dotted(call.func)
+        if not chain:
+            return
+        tail = chain[-1]
+        recv = chain[-2] if len(chain) >= 2 else ""
+        kwargs = {k.arg for k in call.keywords}
+
+        is_self_call = len(chain) == 2 and chain[0] == "self" \
+            and not self.ci.is_module
+        is_attr_call = len(chain) == 3 and chain[0] == "self" \
+            and not self.ci.is_module
+        is_mod_fn = len(chain) == 1 and chain[0] in self.L.mi.functions
+
+        if is_attr_call:
+            self.meth.attr_calls.add((chain[1], tail))
+        elif len(chain) >= 1 and chain[0] in KNOWN_MODULE_LOCKS:
+            self.meth.known_calls.add(chain[0])
+
+        if is_self_call:
+            self.meth.self_calls.add(tail)
+            callee = self.ci.methods.get(tail)
+            req = callee.requires if callee else None
+            if req is None:
+                # forward reference: requires parsed from the def line
+                m = _REQUIRES_RE.search(self._defline_of(self.ci, tail))
+                req = m.group(1) if m else None
+            if req and not self.meth.lockfree \
+                    and self._qual(self.ci, req) not in held:
+                self.L._add(
+                    "TH601", call.lineno,
+                    f"`self.{tail}()` requires `{req}` held "
+                    f"(# requires) but `{self.meth.name}` calls it "
+                    "without the lock",
+                    suggestion=f"call under `with self.{req}:`")
+
+        # TH602 graph events (resolved after all modules parse)
+        if held:
+            if is_self_call:
+                self.meth.call_events.append(
+                    (frozenset(held), "self", tail, call.lineno))
+            elif is_attr_call:
+                self.meth.call_events.append(
+                    (frozenset(held), "attr", (chain[1], tail),
+                     call.lineno))
+            elif is_mod_fn:
+                self.meth.call_events.append(
+                    (frozenset(held), "modfn", tail, call.lineno))
+            elif chain[0] in KNOWN_MODULE_LOCKS:
+                self.meth.call_events.append(
+                    (frozenset(held), "known", chain[0], call.lineno))
+
+        # TH603: blocking call in a held-lock region
+        if held and not self.meth.lockfree:
+            self._th603(call, chain, tail, recv, held)
+
+        # TH604a: Condition.wait outside a predicate loop
+        if tail == "wait" and len(chain) == 3 and chain[0] == "self" \
+                and not self.ci.is_module and chain[1] in self.ci.conds \
+                and not in_while and not self.meth.lockfree:
+            self.L._add(
+                "TH604", call.lineno,
+                f"`self.{chain[1]}.wait()` outside a `while` predicate "
+                f"loop in `{self.meth.name}`: spurious wakeups make a "
+                "bare wait a correctness bug",
+                suggestion="re-test the predicate in a `while` around "
+                           "the wait (or use wait_for)")
+
+        # TH604b candidates: unbounded blocking (checked against the
+        # stop()/handler reachability set after the walk)
+        if not self.meth.lockfree:
+            self._collect_blocking(call, chain, tail, recv, kwargs)
+
+    def _defline_of(self, ci, meth_name):
+        # look ahead for a not-yet-walked method's def line
+        for line in self.L.mi.src_lines:
+            if re.match(rf"\s*def\s+{re.escape(meth_name)}\s*\(", line):
+                return line
+        return ""
+
+    def _th603(self, call, chain, tail, recv, held):
+        dispatch = self._dispatch_nodes()
+        non_dispatch = [h for h in held if h not in dispatch]
+        site = f"`{'.'.join(c for c in chain if c)}()`"
+        lockdesc = ", ".join(sorted(held))
+
+        if tail == "sleep" and recv == "time":
+            self.L._add(
+                "TH603", call.lineno,
+                f"{site} while holding {lockdesc}: every other thread "
+                "on the lock stalls for the full sleep",
+                suggestion="sleep outside the lock (or use a "
+                           "Condition.wait with timeout)")
+        elif tail == "join" and any(t in recv.lower() for t in _THREADISH):
+            self.L._add(
+                "TH603", call.lineno,
+                f"{site} while holding {lockdesc}: joining a thread "
+                "that may need the same lock to exit is a deadlock",
+                suggestion="release the lock before joining")
+        elif tail == "sendall" or "wfile" in chain:
+            self.L._add(
+                "TH603", call.lineno,
+                f"{site} while holding {lockdesc}: a slow client blocks "
+                "every thread on the lock",
+                suggestion="copy the payload under the lock, write it "
+                           "outside")
+        elif (tail in _BLOCKING_DEVICE or tail.endswith("_jit")
+                or tail.endswith("_dispatch")):
+            if non_dispatch:
+                self.L._add(
+                    "TH603", call.lineno,
+                    f"device dispatch {site} while holding "
+                    f"{', '.join(sorted(non_dispatch))}: host threads "
+                    "serialize behind device latency",
+                    suggestion="dispatch outside the lock, or mark the "
+                               "step-serializing lock `# threadlint: "
+                               "dispatch-lock` if serialization is the "
+                               "design")
+        elif tail == "put" and not self.ci.is_module \
+                and self.ci.queue_attrs.get(recv, False):
+            blocks = True
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    blocks = False
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value is False:
+                blocks = False
+            if blocks:
+                self.L._add(
+                    "TH603", call.lineno,
+                    f"blocking put on bounded queue `self.{recv}` while "
+                    f"holding {lockdesc}: if the consumer needs the "
+                    "lock, both sides wedge",
+                    suggestion="use put_nowait/put(block=False) under "
+                               "the lock, or put outside it")
+
+    def _collect_blocking(self, call, chain, tail, recv, kwargs):
+        lineno = call.lineno
+        if tail == "acquire" and len(chain) >= 2:
+            is_lock = (not self.ci.is_module and len(chain) == 3
+                       and chain[0] == "self" and recv in self.ci.locks) \
+                or (len(chain) == 2 and recv in self.mod.locks)
+            if is_lock and "timeout" not in kwargs:
+                blocking = True
+                if call.args:
+                    a0 = call.args[0]
+                    if isinstance(a0, ast.Constant) and a0.value is False:
+                        blocking = False
+                    elif len(call.args) >= 2:
+                        blocking = False    # positional timeout
+                if blocking:
+                    self.meth.blocking.append(
+                        ("timeout-less "
+                         f"`{'.'.join(c for c in chain if c)}()`",
+                         lineno))
+        elif tail == "get" and not self.ci.is_module \
+                and recv in self.ci.queue_attrs:
+            blocking = "timeout" not in kwargs and len(call.args) < 2
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    blocking = False
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value is False:
+                blocking = False
+            if blocking:
+                self.meth.blocking.append(
+                    (f"blocking `self.{recv}.get()` without timeout",
+                     lineno))
+        elif tail == "join" and any(t in recv.lower() for t in _THREADISH):
+            if "timeout" not in kwargs and not call.args:
+                self.meth.blocking.append(
+                    (f"`{'.'.join(c for c in chain if c)}()` without "
+                     "timeout", lineno))
+
+
+# ------------------------------------------------------------------ graph
+def _method_locks(classes, ci, meth_name, _depth=0, _seen=None):
+    """Locks a method may acquire: its own `with` acquisitions plus the
+    transitive closure over self-calls, typed-attribute calls
+    (`# threadlint: type=`/constructor-inferred), and
+    KNOWN_MODULE_LOCKS calls — so `with self._mu: self._record(...)`
+    reaches the sink lock `_record` takes through `self._sink.write`,
+    and the static graph stays a superset of what lockwatch can
+    observe."""
+    if _seen is None:
+        _seen = set()
+    key = (ci.name, meth_name)
+    if key in _seen:
+        return set()
+    _seen.add(key)
+    meth = ci.methods.get(meth_name)
+    if meth is None:
+        return set()
+    out = set(meth.acquires)
+    if meth.requires:
+        out.add(ci.qual(ci.locks.get(meth.requires, meth.requires)))
+    for callee in meth.self_calls:
+        out |= _method_locks(classes, ci, callee, _depth + 1, _seen)
+    for attr, m2 in meth.attr_calls:
+        tname = ci.attr_types.get(attr)
+        if tname in classes:
+            _tmi, tci = classes[tname]
+            out |= _method_locks(classes, tci, m2, _depth + 1, _seen)
+    for mod in meth.known_calls:
+        out |= set(KNOWN_MODULE_LOCKS[mod])
+    return out
+
+
+def _build_graph(mods):
+    """Cross-module nested-acquisition graph + TH602 cycle findings."""
+    classes = {}
+    for mi in mods:
+        for ci in mi.classes.values():
+            classes[ci.name] = (mi, ci)
+
+    edges = {}      # (a, b) -> first site string
+
+    def add_edge(a, b, site):
+        if a != b and (a, b) not in edges:
+            edges[(a, b)] = site
+
+    for mi in mods:
+        for ci in list(mi.classes.values()) + [mi.mod]:
+            for meth in ci.methods.values():
+                site_base = f"{mi.path}:%d {ci.name}.{meth.name}"
+                for held, lk, ln in meth.acq_events:
+                    for h in held:
+                        add_edge(h, lk, site_base % ln)
+                for held, kind, data, ln in meth.call_events:
+                    targets = set()
+                    if kind == "self":
+                        targets = _method_locks(classes, ci, data)
+                    elif kind == "modfn":
+                        targets = _method_locks(classes, mi.mod, data)
+                    elif kind == "attr":
+                        attr, m2 = data
+                        tname = ci.attr_types.get(attr)
+                        if tname in classes:
+                            _tmi, tci = classes[tname]
+                            targets = _method_locks(classes, tci, m2)
+                            req = (tci.methods.get(m2).requires
+                                   if m2 in tci.methods else None)
+                            if req:
+                                targets = set(targets)
+                                targets.add(tci.qual(
+                                    tci.locks.get(req, req)))
+                    elif kind == "known":
+                        targets = set(KNOWN_MODULE_LOCKS[data])
+                    for h in held:
+                        for t in targets:
+                            add_edge(h, t, site_base % ln)
+
+    findings = []
+    adj = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    from . import lockwatch
+    for cyc in lockwatch.find_cycles(adj):
+        edge_descs = []
+        for a, b in zip(cyc, cyc[1:]):
+            edge_descs.append(f"{a} -> {b} (at {edges[(a, b)]})")
+        findings.append(Finding(
+            "TH602", SEV_ERROR, cyc[0],
+            "lock-order cycle — a deadlock by construction: "
+            + "; ".join(edge_descs),
+            suggestion="impose one global acquisition order and take "
+                       "the locks in it (or collapse them into one)"))
+    edge_list = sorted([a, b, site] for (a, b), site in edges.items())
+    return edge_list, findings
+
+
+def _reachability_findings(mods):
+    """TH604b: unbounded blocking reachable from HTTP handlers or
+    stop/shutdown/close/drain, one self-call level deep."""
+    findings = []
+    for mi in mods:
+        for ci in mi.classes.values():
+            is_handler = any("BaseHTTPRequestHandler" in b
+                             for b in ci.bases)
+            entries = set(ci.methods) if is_handler else \
+                {m for m in ci.methods if m in _ENTRY_METHODS}
+            reach = set(entries)
+            for m in entries:
+                reach |= ci.methods[m].self_calls
+            for m in sorted(reach):
+                meth = ci.methods.get(m)
+                if meth is None:
+                    continue
+                for desc, ln in meth.blocking:
+                    f = Finding(
+                        "TH604", SEV_ERROR, f"{mi.path}:{ln}",
+                        f"{desc} in `{ci.name}.{m}` is reachable from "
+                        + ("an HTTP handler" if is_handler
+                           else "a stop/shutdown path")
+                        + ": an unbounded block wedges shutdown",
+                        suggestion="pass a timeout and handle expiry")
+                    if f.rule_id not in _disabled_rules(mi.src_lines, ln):
+                        findings.append(f)
+    return findings
+
+
+# ------------------------------------------------------------------ entry
+def lint_sources(sources):
+    """Lint a set of (path, source, stem) triples as one closed world.
+    Returns (findings, graph) with graph = {"nodes": [...],
+    "edges": [[held, acquired, site], ...]}."""
+    mods = []
+    findings = []
+    for path, src, stem in sources:
+        mi = _ModuleLinter(path, src, stem=stem).run()
+        mods.append(mi)
+        findings.extend(mi.findings)
+    edge_list, cyc_findings = _build_graph(mods)
+    findings.extend(cyc_findings)
+    findings.extend(_reachability_findings(mods))
+    nodes = sorted({e[0] for e in edge_list} | {e[1] for e in edge_list})
+    return findings, {"nodes": nodes, "edges": edge_list}
+
+
+def lint_source(src, path="<string>"):
+    """Single-module convenience (tests, specimens)."""
+    return lint_sources([(path, src, None)])
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def lint_files(paths):
+    return lint_sources([(p, _read(p), None) for p in paths])
+
+
+def lint_repo(repo=REPO, modules=MODULES):
+    """The in-tree pass: every MODULES entry (EXEMPT is the explicit
+    not-covered list, not an input here)."""
+    return lint_files([os.path.join(repo, m) for m in modules])
+
+
+def static_lock_graph(repo=REPO, modules=MODULES):
+    """The static nested-acquisition graph over the in-tree modules —
+    what lockwatch's observed edges must be a subgraph of."""
+    _findings, graph = lint_repo(repo, modules)
+    return graph
+
+
+__all__ = [
+    "MODULES", "EXEMPT", "KNOWN_MODULE_LOCKS",
+    "lint_source", "lint_sources", "lint_files", "lint_repo",
+    "static_lock_graph",
+]

@@ -92,7 +92,7 @@ def get_worker_info():
 # --------------------------------------------------------------------------
 
 _INPUT_LOCK = threading.Lock()
-_INPUT_STATS = None                 # stats of the most recent batch fetch
+_INPUT_STATS = None                 # guarded by: _INPUT_LOCK — most recent batch-fetch stats
 _INTERIOR = threading.local()       # set in pipeline-internal threads
 
 
@@ -631,7 +631,7 @@ def make_pool(loader):
 # the multi-worker iterator (sampler order preserved, bounded in-flight)
 # --------------------------------------------------------------------------
 
-class MultiWorkerIterator:
+class MultiWorkerIterator:    # guarded by: none (single active iterator per pool — _invalidate poisons the old one before a new one may submit)
     """Drives a worker pool through one pass of the batch sampler.
 
     Index feeding has backpressure (jobs in flight <= pool capacity —
@@ -1000,6 +1000,21 @@ class _DeviceIterator:
 
     def close(self):
         self._stop.set()
+        # Drain-and-join until the stage thread is really gone. A single
+        # drain raced the stage thread: it could already be inside
+        # `q.put(batch, timeout=0.25)` when stop was set, so its put
+        # succeeded AFTER our sweep and a device-resident batch stayed
+        # pinned in the queue for the iterator's remaining lifetime.
+        # Draining in a loop keeps the queue unblocked until the thread
+        # observes stop and exits; the final sweep catches anything the
+        # last put landed.
+        t = self._thread
+        while t.is_alive():
+            self._drain()
+            t.join(timeout=0.05)
+        self._drain()
+
+    def _drain(self):
         try:
             while True:
                 self._q.get_nowait()

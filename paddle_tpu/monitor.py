@@ -29,8 +29,9 @@ Three stat kinds (Prometheus-compatible semantics, exported verbatim by
 """
 import bisect
 import os
-import threading
 import time
+
+from .analysis import lockwatch
 
 __all__ = ["incr", "set_value", "set_gauge", "get", "get_gauge",
            "observe_hist", "get_hist", "snapshot_hists", "hist_quantile",
@@ -59,7 +60,7 @@ def _default_rank():
 DEFAULT_HIST_BOUNDS = tuple(0.25 * (2.0 ** i) for i in range(26))
 
 
-class LogHistogram:
+class LogHistogram:    # guarded by: StatRegistry._mu
     """Streaming log-bucketed histogram (Prometheus `histogram` shape:
     cumulative `le` buckets + sum + count at export). `observe` is O(log
     buckets); `quantile` interpolates linearly inside the target bucket
@@ -144,11 +145,11 @@ class LogHistogram:
 
 class StatRegistry:
     def __init__(self):
-        self._mu = threading.Lock()
-        self._stats = {}
-        self._gauges = {}
-        self._hists = {}
-        self._rank = None
+        self._mu = lockwatch.make_lock("StatRegistry._mu")
+        self._stats = {}    # guarded by: _mu
+        self._gauges = {}   # guarded by: _mu
+        self._hists = {}    # guarded by: _mu
+        self._rank = None   # guarded by: _mu
 
     def incr(self, name, delta=1):
         if delta < 0:
@@ -204,8 +205,7 @@ class StatRegistry:
         with self._mu:
             self._rank = int(rank)
 
-    def _identity(self):
-        # call with self._mu held
+    def _identity(self):    # requires: _mu
         rank = self._rank if self._rank is not None else _default_rank()
         return {"process.uptime_s": round(time.monotonic() - _START_TIME, 3),
                 "process.rank": rank}

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor
+from ..analysis import lockwatch
 from ..core import autograd
 from ..core.tensor import Tensor
 from ..generation import _cast_params
@@ -164,7 +165,7 @@ class ServingEngine:
         self.cfg = config or EngineConfig(**overrides)
         cfg = self.cfg
         self.engine_id = next(_ENGINE_IDS)
-        self._sink = sink
+        self._sink = sink               # threadlint: type=JsonlSink
         self.model = model
         mcfg = model.config
         if cfg.weights == "wo8":
@@ -189,14 +190,19 @@ class ServingEngine:
                     b._value = jax.device_put(b._value, cfg.device)
 
         num_blocks = self._resolve_num_blocks()
-        self.pool = BlockPool(num_blocks)
+        self.pool = BlockPool(num_blocks)   # guarded by: _mu
         with jax.default_device(cfg.device) if cfg.device is not None \
                 else contextlib.nullcontext():
-            self.cache = PagedKVCache(
+            self.cache = PagedKVCache(   # guarded by: _mu
                 mcfg.num_layers, num_blocks, self.block_size, self.hidden,
                 dtype=self._compute_dtype)
-        self.prefix_index = PrefixIndex(self.block_size, pool=self.pool) \
-            if cfg.enable_prefix_cache else None
+        # guarded by: none (immutable ref; entries mutate under _mu)
+        self.prefix_index = (
+            PrefixIndex(self.block_size, pool=self.pool)
+            if cfg.enable_prefix_cache else None)
+        # the Scheduler object carries no lock of its own: every one of
+        # its methods runs under the engine lock (its class line says
+        # `# guarded by: ServingEngine._mu`); the REFERENCE never moves
         self.sched = Scheduler(self.pool, self.block_size, cfg.max_slots,
                                self.max_model_len,
                                prefix_index=self.prefix_index)
@@ -206,19 +212,23 @@ class ServingEngine:
         self._bound = [p for _, p in named]
         self._build_fns()
 
-        self._mu = threading.RLock()
-        self._cv = threading.Condition(self._mu)
-        self._thread = None
-        self._stopping = False
-        self._stopped = False
-        self._draining = False
-        self._dead = False
-        self._restarts = 0              # CONSECUTIVE failed-step restarts
+        # the engine lock IS the step serializer: one dispatch at a
+        # time by design, so device calls under it are expected
+        # (lockwatch proxies when armed; raw RLock otherwise)
+        self._mu = lockwatch.make_rlock("ServingEngine._mu")  # threadlint: dispatch-lock
+        self._cv = lockwatch.make_condition("ServingEngine._cv", self._mu)
+        self._thread = None     # guarded by: none (start/stop confined; racy is_alive probes ok)
+        self._stopping = False  # guarded by: none (one-way flag; loop re-reads each iteration)
+        self._stopped = False   # guarded by: none (stop-path flag, set without the lock by design)
+        self._draining = False  # guarded by: _mu
+        self._dead = False      # guarded by: _mu
+        self._restarts = 0      # guarded by: none (serve-loop-thread confined) — CONSECUTIVE failed-step restarts
         self._sleep = time.sleep        # injectable (tests pin backoff)
         self._join_timeout_s = 30.0     # stop(): loop-join bound
         self._stop_lock_timeout_s = 5.0  # stop(): wedged-lock bound
-        self.admission = AdmissionController(cfg.max_queue, cfg.max_slots)
-        self._counts = {"admitted": 0, "finished": 0, "failed": 0,
+        self.admission = AdmissionController(  # guarded by: _mu
+            cfg.max_queue, cfg.max_slots)
+        self._counts = {"admitted": 0, "finished": 0, "failed": 0,  # guarded by: _mu
                         "cancelled": 0, "expired": 0, "shed": 0}
         # latency lives in streaming log-bucketed histograms on the
         # monitor registry (scraped as true Prometheus histograms);
@@ -226,18 +236,18 @@ class ServingEngine:
         # step AND at scrape time (refresh_latency_gauges), so a
         # stalled engine can no longer serve percentiles frozen at the
         # last finished request. `_last_latency_obs` age-stamps them.
-        self._last_latency_obs = None
-        self._finished = 0
-        self.tracer = RequestTracer(
-            engine_id=self.engine_id, sink=sink,
-            exemplar_k=cfg.trace_exemplars) \
-            if cfg.enable_tracing else None
-        self.kv_peak_utilization = 0.0
+        self._last_latency_obs = None   # guarded by: _mu
+        self._finished = 0              # guarded by: _mu
+        self.tracer = (  # threadlint: type=RequestTracer  # guarded by: none (immutable ref; tracer is self-locked)
+            RequestTracer(engine_id=self.engine_id, sink=sink,
+                          exemplar_k=cfg.trace_exemplars)
+            if cfg.enable_tracing else None)
+        self.kv_peak_utilization = 0.0  # guarded by: _mu
         # prefix-cache accounting: offered = positions each admission
         # would have to prefill cold, saved = positions a cache hit
         # covered instead (saved <= offered by construction — the
         # trace_check cross-rule pins it)
-        self._prefix_stats = {"lookups": 0, "hits": 0,
+        self._prefix_stats = {"lookups": 0, "hits": 0,  # guarded by: _mu
                               "tokens_saved": 0, "tokens_offered": 0}
         monitor.set_gauge("serving.kv_blocks_total", self.pool.capacity)
         monitor.set_gauge("serving.draining", 0)
@@ -585,7 +595,7 @@ class ServingEngine:
             self._update_gauges()
             return did
 
-    def _reap(self, now=None):
+    def _reap(self, now=None):     # requires: _mu
         """Step-boundary enforcement of cancellation + server-side
         deadlines: every reaped request releases its slot and KV
         blocks to the pool IMMEDIATELY and its stream terminates with
@@ -615,7 +625,7 @@ class ServingEngine:
                 break
         return n
 
-    def start(self):
+    def start(self):    # threadlint: lock-free (caller-serialized lifecycle; flags are none-guarded)
         if self._thread is not None and self._thread.is_alive():
             return self
         if self._dead:
@@ -630,7 +640,7 @@ class ServingEngine:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self):     # threadlint: lock-free (manual bounded acquires — see body comments)
         """Stop the serve loop, then FAIL every request still queued or
         in flight with `EngineStoppedError` — a submitter blocked on a
         handle must get a clean error, never hang forever on a stream
@@ -690,11 +700,11 @@ class ServingEngine:
     # graceful drain
     # ------------------------------------------------------------------
     @property
-    def draining(self):
+    def draining(self):     # threadlint: lock-free (racy scrape by design)
         return self._draining
 
     @property
-    def dead(self):
+    def dead(self):     # threadlint: lock-free (racy scrape by design)
         return self._dead
 
     def drain(self, timeout=None):
@@ -798,7 +808,7 @@ class ServingEngine:
                 # blocks): don't spin the lock hot
                 time.sleep(0.002)
 
-    def _rebuild_arenas(self):
+    def _rebuild_arenas(self):     # requires: _mu
         """Fresh pool + fresh K/V arenas: after a failed step the
         donated buffers are suspect, and every surviving request holds
         zero blocks by construction (failed or requeued). The prefix
@@ -898,7 +908,7 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # device-step drivers
     # ------------------------------------------------------------------
-    def _cow_fork(self, req, bi, evict=True):
+    def _cow_fork(self, req, bi, evict=True):     # requires: _mu
         """Copy-on-write: make `req.blocks[bi]` safe to write. A block
         another request (or the prefix index) can read must never be
         mutated — fork it into a fresh private block (device-side row
@@ -942,7 +952,7 @@ class ServingEngine:
             req.trace.note_cow_fork(time.monotonic())
         return True
 
-    def _prefill_one(self):
+    def _prefill_one(self):     # requires: _mu
         sched = self.sched
         # prefill growth normally WAITS for blocks instead of evicting
         # (a not-yet-streaming request must never thrash the decode
@@ -1005,7 +1015,7 @@ class ServingEngine:
             return True
         return False
 
-    def _decode_once(self):
+    def _decode_once(self):     # requires: _mu
         sched = self.sched
         # grow blocks oldest-first so eviction lands on the youngest
         for req in list(sched.admit_order):
@@ -1100,7 +1110,8 @@ class ServingEngine:
         self._sink.write(make_serving_record(
             event, engine=self.engine_id, **fields))
 
-    def _finalize(self, req, status, event, error=None, exc=None,
+    def _finalize(self, req, status, event,  # requires: _mu
+                  error=None, exc=None,
                   counter=None, **fields):
         """The single terminal transition: release slot + blocks via
         the scheduler, account the outcome, emit the typed record.
@@ -1122,7 +1133,7 @@ class ServingEngine:
             # for every outcome, not just clean finishes
             self.tracer.finish(req, req.finish_time)
 
-    def _emit(self, req, tok, logp, now=None):
+    def _emit(self, req, tok, logp, now=None):     # requires: _mu
         req.push_token(tok, now=now)
         monitor.incr("serving.tokens_generated")
         if req.done:
@@ -1139,7 +1150,7 @@ class ServingEngine:
                 self._last_latency_obs = time.monotonic()
                 self.admission.note_tpot_ms(t)  # feeds shed prediction
 
-    def _update_gauges(self):
+    def _update_gauges(self):     # requires: _mu
         monitor.set_gauge("serving.queue_depth", len(self.sched.waiting))
         monitor.set_gauge("serving.running", self.sched.num_running())
         monitor.set_gauge("serving.prefilling", len(self.sched.prefilling))
@@ -1204,10 +1215,16 @@ class ServingEngine:
                 continue
             monitor.set_gauge(p50_name, float(p50))
             monitor.set_gauge(p99_name, float(p99))
-        if self._last_latency_obs is not None:
+        # `_last_latency_obs` is a step-loop field: take the engine
+        # lock for the read — HTTP scrape threads land here directly,
+        # and an unlocked read raced the step loop's store (the RLock
+        # makes the _update_gauges re-entry free)
+        with self._mu:
+            last = self._last_latency_obs
+        if last is not None:
             monitor.set_gauge(
                 "serving.slo_gauge_age_s",
-                round(time.monotonic() - self._last_latency_obs, 3))
+                round(time.monotonic() - last, 3))
 
     def prefix_stats(self):
         """Snapshot of the prefix-cache accounting: lookups, hits,

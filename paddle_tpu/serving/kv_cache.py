@@ -84,7 +84,7 @@ NULL_BLOCK = 0
 _UNSET = object()
 
 
-class BlockPool:
+class BlockPool:    # guarded by: ServingEngine._mu
     """Refcounted free-list allocator over `num_blocks` physical blocks
     (block 0 reserved). Any free block serves any request — paging
     means fragmentation cannot strand capacity — and the LIFO
@@ -308,7 +308,7 @@ class _PrefixNode:
         self.last_used = 0
 
 
-class PrefixIndex:
+class PrefixIndex:    # guarded by: ServingEngine._mu
     """Block-granular radix index over token-id chunks.
 
     Each trie edge is one FULL block of token ids; the node at its end

@@ -85,7 +85,7 @@ class SamplingParams:
         return self.decode_strategy == "greedy"
 
 
-class Request:
+class Request:    # guarded by: ServingEngine._mu
     """One in-flight generation. `tokens_all` = prompt + generated; the
     positions 0..n_prefilled-1 have K/V in the paged cache. A decode
     step consumes tokens_all[n_prefilled] (writing its K/V at that
@@ -263,7 +263,7 @@ class RequestHandle:
                 "n_tokens": len(r.out_tokens), "state": r.state}
 
 
-class Scheduler:
+class Scheduler:    # guarded by: ServingEngine._mu
     """Slot + block bookkeeping for the continuous-batching loop.
 
     Invariants:

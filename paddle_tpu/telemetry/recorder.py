@@ -37,10 +37,10 @@ from . import mfu as _mfu
 from .sink import JsonlSink, make_step_record
 
 _LOCK = threading.Lock()
-_RECORDER_STACK = []          # active (context-entered) recorders
-_OPEN_STEPS = []              # open _StepWindow objects (compile sink)
-_OPEN_SPANS = []              # spans entered but not yet exited (any thread)
-_LISTENER_INSTALLED = False
+_RECORDER_STACK = []          # guarded by: _LOCK — active (context-entered) recorders
+_OPEN_STEPS = []              # guarded by: _LOCK — open _StepWindow objects (compile sink)
+_OPEN_SPANS = []              # guarded by: _LOCK — spans entered but not yet exited (any thread)
+_LISTENER_INSTALLED = False   # guarded by: none (idempotent install; main-thread hook)
 
 # jax.monitoring events that constitute "compile" for the split; all
 # three fire on a jit cache miss and none on a hit
@@ -72,7 +72,8 @@ def _install_listener():
 
 def current_recorder():
     """The innermost context-active TelemetryRecorder, or None."""
-    return _RECORDER_STACK[-1] if _RECORDER_STACK else None
+    with _LOCK:
+        return _RECORDER_STACK[-1] if _RECORDER_STACK else None
 
 
 class _StepWindow:
@@ -477,7 +478,11 @@ class TelemetryRecorder:
 
     # -- context activation (TrainStep auto-record) ------------------------
     def __enter__(self):
-        _RECORDER_STACK.append(self)
+        # under _LOCK: `current_recorder()` is consulted from other
+        # threads (emit_record's fallback chain, span()), and an
+        # unlocked append/remove raced those reads
+        with _LOCK:
+            _RECORDER_STACK.append(self)
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb):
@@ -495,7 +500,8 @@ class TelemetryRecorder:
                     if self._win in _OPEN_STEPS:
                         _OPEN_STEPS.remove(self._win)
                 self._win = None
-        _RECORDER_STACK.remove(self)
+        with _LOCK:
+            _RECORDER_STACK.remove(self)
         if self.sink is not None:
             if self._owns_sink:
                 # we opened this file handle; release it (a later write

@@ -37,9 +37,9 @@ TIMELINE that names its cause:
 """
 import heapq
 import itertools
-import threading
 
 from .. import monitor
+from ..analysis import lockwatch
 from .sink import REQTRACE_SPAN_KINDS, make_reqtrace_record
 
 __all__ = ["RequestTrace", "RequestTracer", "CAUSES",
@@ -186,11 +186,11 @@ class RequestTracer:
         self.engine_id = int(engine_id)
         self.rank = int(rank)
         self.exemplar_k = int(exemplar_k)
-        self._sink = sink
-        self._mu = threading.Lock()
-        self._heap = []              # (e2e_ms, seq, record) min-heap
-        self._seq = itertools.count()
-        self.n_traces = 0
+        self._sink = sink   # threadlint: type=JsonlSink
+        self._mu = lockwatch.make_lock("RequestTracer._mu")
+        self._heap = []              # guarded by: _mu — (e2e_ms, seq, record) min-heap
+        self._seq = itertools.count()   # guarded by: _mu
+        self.n_traces = 0            # guarded by: _mu
 
     def start(self, rid, t0):
         return RequestTrace(rid, t0)

@@ -13,8 +13,9 @@ host-side step ledger.
 import atexit
 import json
 import os
-import threading
 import weakref
+
+from ..analysis import lockwatch
 
 # one process-wide atexit hook over weak refs: sinks stay collectable
 # (a per-instance atexit.register would pin every sink + its fd for the
@@ -543,6 +544,62 @@ def make_kernel_record(kernel, findings=(), rank=0, module=None,
     return rec
 
 
+# required keys of a Concurrency Doctor record (analysis/threadlint +
+# analysis/lockwatch via tools/threaddoctor.py); optional: locks,
+# n_locks, modules
+THREAD_LINT_RECORD_KEYS = ("schema", "kind", "rank", "source",
+                           "n_findings", "findings", "n_edges", "edges")
+
+# the TH rule vocabulary (analysis/threadlint's docstring is the
+# documented source; this tuple is what the record validator enforces)
+THREAD_LINT_RULES = ("TH600", "TH601", "TH602", "TH603", "TH604")
+
+# what a thread_lint record may claim to be: the static pass over the
+# source, or the lockwatch runtime witness
+THREAD_LINT_SOURCES = ("static", "lockwatch")
+
+
+def make_thread_lint_record(source, findings=(), edges=(), rank=0,
+                            locks=None, modules=None, **extra):
+    """One Concurrency Doctor verdict as a first-class record
+    (kind='thread_lint'). source='static' carries threadlint's findings
+    plus the nested-acquisition graph edges ([held, acquired, site]);
+    source='lockwatch' carries the runtime witness — observed
+    acquisition-order edges ([held, acquired, count]) and the per-lock
+    snapshot under 'locks' (the watchdog black-box section).
+    tools/trace_check.py cross-rules a static/lockwatch pair in the
+    same file: the observed edge set must be a SUBGRAPH of the static
+    graph, and any observed cycle fails outright."""
+    fs = []
+    for f in findings:
+        if isinstance(f, dict):
+            fs.append({"rule": str(f.get("rule", "")),
+                       "message": str(f.get("message", ""))})
+        else:
+            fs.append({"rule": str(getattr(f, "rule_id", "")),
+                       "message": str(getattr(f, "message", ""))})
+    es = [[e[0], e[1], e[2]] for e in edges]
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "thread_lint",
+        "rank": int(rank),
+        "source": str(source),
+        "n_findings": len(fs),
+        "findings": fs,
+        "n_edges": len(es),
+        "edges": es,
+    }
+    if locks is not None:
+        rec["locks"] = [dict(row) for row in locks]
+        rec["n_locks"] = len(rec["locks"])
+    if modules is not None:
+        rec["modules"] = [str(m) for m in modules]
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 # required keys of a kernel-observatory measurement record
 # (telemetry/kernel_obs via tools/kernellab.py); optional: dtype,
 # fallback_ms, speedup, compile_ms, flops, bytes_accessed, flops_frac,
@@ -746,9 +803,9 @@ class JsonlSink:
         self.path = os.fspath(path)
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
-        self._mu = threading.Lock()
-        self._n = 0
-        self._f = open(self.path, "a")
+        self._mu = lockwatch.make_lock("JsonlSink._mu")
+        self._n = 0     # guarded by: _mu
+        self._f = open(self.path, "a")  # guarded by: _mu
         if not _ATEXIT_INSTALLED:
             atexit.register(_close_live_sinks)
             _ATEXIT_INSTALLED = True
@@ -779,7 +836,7 @@ class JsonlSink:
                 self._f.flush()
                 self._f.close()
 
-    def __len__(self):
+    def __len__(self):  # threadlint: lock-free (racy record count is fine for progress/tests)
         return self._n
 
 
@@ -895,6 +952,73 @@ def validate_step_record(rec):
                                   or v < 0):
                 problems.append(
                     f"'{key}' not a non-negative number: {v!r}")
+        return problems
+    if kind == "thread_lint":
+        for key in THREAD_LINT_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"thread_lint record missing '{key}'")
+        src = rec.get("source")
+        if src is not None and src not in THREAD_LINT_SOURCES:
+            problems.append(
+                f"unknown thread_lint source {src!r} (expected one of "
+                f"{list(THREAD_LINT_SOURCES)})")
+        n = rec.get("n_findings")
+        fs = rec.get("findings")
+        if n is not None and (not isinstance(n, int) or n < 0):
+            problems.append(f"'n_findings' not a non-negative int: {n!r}")
+        if fs is not None:
+            if not isinstance(fs, list):
+                problems.append("'findings' not a list")
+            else:
+                if isinstance(n, int) and n != len(fs):
+                    problems.append(
+                        f"n_findings {n} but {len(fs)} findings listed "
+                        "— the count and the list disagree")
+                for j, f in enumerate(fs):
+                    if not isinstance(f, dict):
+                        problems.append(f"finding {j} not a dict")
+                        continue
+                    if f.get("rule") not in THREAD_LINT_RULES:
+                        problems.append(
+                            f"finding {j} rule {f.get('rule')!r} not in "
+                            f"the TH vocabulary "
+                            f"{list(THREAD_LINT_RULES)}")
+                    if not str(f.get("message", "")).strip():
+                        problems.append(
+                            f"finding {j} carries no message — a "
+                            "finding the ledger cannot explain")
+        ne = rec.get("n_edges")
+        es = rec.get("edges")
+        if ne is not None and (not isinstance(ne, int) or ne < 0):
+            problems.append(f"'n_edges' not a non-negative int: {ne!r}")
+        if es is not None:
+            if not isinstance(es, list):
+                problems.append("'edges' not a list")
+            else:
+                if isinstance(ne, int) and ne != len(es):
+                    problems.append(
+                        f"n_edges {ne} but {len(es)} edges listed — "
+                        "the count and the list disagree")
+                for j, e in enumerate(es):
+                    if (not isinstance(e, list) or len(e) != 3
+                            or not isinstance(e[0], str)
+                            or not isinstance(e[1], str)):
+                        problems.append(
+                            f"edge {j} not a [held, acquired, "
+                            f"site-or-count] triple: {e!r}")
+        locks = rec.get("locks")
+        if locks is not None:
+            if not isinstance(locks, list):
+                problems.append("'locks' not a list")
+            else:
+                for j, row in enumerate(locks):
+                    if not isinstance(row, dict) or \
+                            not str(row.get("name", "")).strip():
+                        problems.append(f"lock row {j} names no lock")
+                    elif not isinstance(row.get("acquires"), int):
+                        problems.append(
+                            f"lock row {j} ({row.get('name')}) carries "
+                            "no integer 'acquires' count")
         return problems
     if kind == "kernelbench":
         for key in KERNELBENCH_RECORD_KEYS:

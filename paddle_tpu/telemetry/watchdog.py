@@ -31,6 +31,7 @@ import time
 import traceback
 
 from .. import monitor
+from ..analysis import lockwatch
 
 __all__ = ["HangWatchdog", "dump_black_box"]
 
@@ -50,8 +51,9 @@ def dump_black_box(reason="", dump_dir=".", ring=(), path=None, extra=None):
 
     Contents: reason, pid/rank/uptime, ALL thread stacks, open
     telemetry spans (name + category + age + thread — the stuck
-    collective is named here), the full monitor snapshot, and the
-    last-N step records. Best-effort by design: a dump must never turn
+    collective is named here), the lockwatch lock table (holder, hold
+    duration, waiters — empty unless `lockwatch.arm()` ran), the full
+    monitor snapshot, and the last-N step records. Best-effort by design: a dump must never turn
     a hang into a crash, so every section degrades to an error string
     rather than raising."""
     from . import recorder as _recorder
@@ -69,6 +71,7 @@ def dump_black_box(reason="", dump_dir=".", ring=(), path=None, extra=None):
         "pid": os.getpid(),
         "threads": _section(_thread_stacks),
         "open_spans": _section(_recorder.open_spans),
+        "locks": _section(lockwatch.snapshot),
         "monitor": _section(monitor.snapshot),
         "ring": list(ring),
     }
@@ -114,13 +117,13 @@ class HangWatchdog:
         self.on_dump = on_dump
         self._poll_s = poll_s if poll_s is not None else \
             min(max(self.deadline_s / 4.0, 0.02), 30.0)
-        self._mu = threading.Lock()
-        self._armed_at = None
-        self._dumped_this_window = False
+        self._mu = lockwatch.make_lock("HangWatchdog._mu")
+        self._armed_at = None             # guarded by: _mu
+        self._dumped_this_window = False  # guarded by: _mu
         self._stop = threading.Event()
-        self._thread = None
-        self.dumps = []
-        self.fires = 0
+        self._thread = None  # guarded by: none (caller-serialized lifecycle)
+        self.dumps = []      # guarded by: none (checker-thread confined)
+        self.fires = 0       # guarded by: none (checker-thread confined)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):

@@ -217,6 +217,27 @@ def test_abandoned_device_iterator_stage_thread_stops():
     assert not th.is_alive()
 
 
+def test_device_iterator_close_joins_stage_and_leaves_queue_empty():
+    """close() must not RACE the stage thread: a single queue sweep
+    could run while the stage was already blocked inside
+    `q.put(batch, timeout=0.25)` — its put then succeeded AFTER the
+    sweep and a device batch stayed pinned in the queue forever.
+    close() now drains until the stage thread has exited, so the queue
+    is verifiably empty afterwards (repeated, to catch the timing)."""
+    from paddle_tpu.io import prefetch_to_device
+    for trial in range(8):
+        loader = DataLoader(ArangeDataset(64), batch_size=4,
+                            num_workers=0)
+        it = iter(prefetch_to_device(loader, size=1))
+        next(it)         # queue full, stage blocked in its next put
+        it.close()
+        assert not it._thread.is_alive()
+        assert it._q.qsize() == 0, \
+            f"trial {trial}: {it._q.qsize()} batch(es) left pinned"
+        with pytest.raises(StopIteration):
+            next(it)
+
+
 def test_bench_gate_update_baseline_refuses_null_metrics(tmp_path):
     """--update-baseline on a run with a null tracked value must refuse:
     rolling it forward would silently drop the metric from gate

@@ -1,0 +1,589 @@
+"""Concurrency Doctor (paddle_tpu/analysis/threadlint.py + the
+lockwatch runtime witness): TH601 guarded-field discipline and the
+silent-lock-owner coverage half, TH602 lock-order cycles (same-class
+ABBA and the transitive cross-object closure), TH603 blocking calls
+under a lock, TH604 Condition.wait discipline + timeout-less blocking
+on shutdown/HTTP paths, the in-tree modules staying clean, the typed
+thread_lint records, the trace_check cross-rules both ways, and the
+lockwatch witness tracing real cross-thread acquisitions."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import lockwatch, threadlint
+from paddle_tpu.analysis.threadlint import (
+    lint_files, lint_repo, lint_source, static_lock_graph)
+from paddle_tpu.telemetry import sink as sink_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+SPECIMENS = os.path.join(TOOLS, "specimens")
+
+
+def _rules(findings):
+    return [f.rule_id for f in findings]
+
+
+@pytest.fixture(autouse=True)
+def _clean_watch():
+    """Every test starts and ends with a disarmed, empty witness."""
+    lockwatch.disarm()
+    lockwatch.reset()
+    yield
+    lockwatch.disarm()
+    lockwatch.reset()
+
+
+# ---------------------------------------------------------------------------
+# TH601: guarded fields + coverage
+# ---------------------------------------------------------------------------
+
+_GUARDED_OK = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0        # guarded by: _mu
+
+    def bump(self):
+        with self._mu:
+            self.n += 1
+"""
+
+_GUARDED_BAD = _GUARDED_OK.replace(
+    "        with self._mu:\n            self.n += 1",
+    "        self.n += 1")
+
+_REQUIRES_OK = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0        # guarded by: _mu
+
+    def bump(self):
+        with self._mu:
+            self._bump_locked()
+
+    def _bump_locked(self):    # requires: _mu
+        self.n += 1
+"""
+
+_NONE_OK = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0        # guarded by: none (write-once before start)
+
+    def bump(self):
+        self.n += 1
+"""
+
+
+def test_th601_positive_and_negative():
+    bad, _ = lint_source(_GUARDED_BAD)
+    assert "TH601" in _rules(bad)
+    assert "self.n" in bad[0].message and "bump" in bad[0].message
+    good, _ = lint_source(_GUARDED_OK)
+    assert good == []
+
+
+def test_th601_requires_annotation_satisfies_guard():
+    findings, _ = lint_source(_REQUIRES_OK)
+    assert findings == []
+
+
+def test_th601_guarded_by_none_is_a_declaration():
+    findings, _ = lint_source(_NONE_OK)
+    assert findings == []
+
+
+def test_th601_silent_lock_owner_coverage():
+    src = """
+import threading
+
+class Quiet:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.jobs = []
+
+    def push(self, j):
+        with self._mu:
+            self.jobs.append(j)
+"""
+    findings, _ = lint_source(src)
+    assert _rules(findings) == ["TH601"]
+    assert "Quiet" in findings[0].message
+
+
+def test_th601_module_globals():
+    src = """
+import threading
+
+_MU = threading.Lock()
+_STATE = None    # guarded by: _MU
+
+
+def poke():
+    global _STATE
+    _STATE = 1
+"""
+    findings, _ = lint_source(src, "mod.py")
+    assert "TH601" in _rules(findings)
+    fixed = src.replace("    _STATE = 1",
+                        "    with _MU:\n        _STATE = 1")
+    findings, _ = lint_source(fixed, "mod.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TH602: lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_th602_abba_names_both_edges():
+    findings, graph = lint_files(
+        [os.path.join(SPECIMENS, "thread_deadlock.py")])
+    cyc = [f for f in findings if f.rule_id == "TH602"
+           and "SpecimenDeadlock._a" in f.message]
+    assert cyc, _rules(findings)
+    msg = cyc[0].message
+    # both directions, each with its source site
+    assert "_a -> " in msg and "_b -> " in msg
+    assert "forward" in msg and "backward" in msg
+    # and the cross-object cycle through the typed attributes
+    cross = [f for f in findings if f.rule_id == "TH602"
+             and "SpecimenOwner._mu" in f.message
+             and "SpecimenPeer._mu" in f.message]
+    assert cross
+    edges = {(a, b) for a, b, _ in graph["edges"]}
+    assert ("SpecimenDeadlock._a", "SpecimenDeadlock._b") in edges
+    assert ("SpecimenDeadlock._b", "SpecimenDeadlock._a") in edges
+
+
+def test_th602_acyclic_nesting_is_clean():
+    src = """
+import threading
+
+class Outer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0    # guarded by: _a
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_fwd(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    findings, graph = lint_source(src)
+    assert findings == []
+    assert [(a, b) for a, b, _ in graph["edges"]] == \
+        [("Outer._a", "Outer._b")]
+
+
+# ---------------------------------------------------------------------------
+# TH603: blocking under a lock
+# ---------------------------------------------------------------------------
+
+_BLOCKING = """
+import queue
+import threading
+import time
+
+class Pump:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+
+    def push(self, x):
+        with self._mu:
+            self._q.put(x)
+
+    def nap(self):
+        with self._mu:
+            time.sleep(1.0)
+"""
+
+
+def test_th603_blocking_call_under_lock():
+    findings, _ = lint_source(_BLOCKING)
+    th603 = [f for f in findings if f.rule_id == "TH603"]
+    assert len(th603) == 2
+    texts = " ".join(f.message for f in th603)
+    assert "put" in texts and "sleep" in texts
+
+
+def test_th603_dispatch_lock_exemption_is_class_scoped():
+    """`# threadlint: dispatch-lock` exempts ONLY device dispatch under
+    the marked lock (the engine's step lock IS the step serializer by
+    design) — sleeps and bounded puts under it stay findings."""
+    src = """
+import threading
+
+class Step:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0    # guarded by: _mu
+
+    def step(self):
+        with self._mu:
+            self.decode_jit()
+
+    def decode_jit(self):
+        pass
+"""
+    findings, _ = lint_source(src)
+    assert "TH603" in _rules(findings)    # unmarked lock: flagged
+    marked = src.replace(
+        "self._mu = threading.Lock()",
+        "self._mu = threading.Lock()  # threadlint: dispatch-lock")
+    findings, _ = lint_source(marked)
+    assert findings == []
+    # but the marked lock does NOT excuse the other blocking classes
+    findings, _ = lint_source(_BLOCKING.replace(
+        "self._mu = threading.Lock()",
+        "self._mu = threading.Lock()  # threadlint: dispatch-lock"))
+    assert len([f for f in findings if f.rule_id == "TH603"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# TH604: condition discipline + reachable timeout-less blocking
+# ---------------------------------------------------------------------------
+
+def test_th604_wait_outside_predicate_loop():
+    src = """
+import threading
+
+class Gate:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.ready = False    # guarded by: _mu
+
+    def await_ready(self):
+        with self._cv:
+            self._cv.wait()
+"""
+    findings, _ = lint_source(src)
+    assert "TH604" in _rules(findings)
+    looped = src.replace(
+        "            self._cv.wait()",
+        "            while not self.ready:\n"
+        "                self._cv.wait()")
+    findings, _ = lint_source(looped)
+    assert findings == []
+
+
+def test_th604_timeout_less_join_on_shutdown_path():
+    src = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None)
+
+    def stop(self):
+        self._thread.join()
+"""
+    findings, _ = lint_source(src)
+    assert "TH604" in _rules(findings)
+    bounded = src.replace("self._thread.join()",
+                          "self._thread.join(timeout=5.0)")
+    findings, _ = lint_source(bounded)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the in-tree modules + specimens through the real file API
+# ---------------------------------------------------------------------------
+
+def test_in_tree_modules_clean_and_graph_acyclic():
+    findings, graph = lint_repo()
+    assert findings == [], [f.to_dict() for f in findings]
+    adj = {}
+    for a, b, _site in graph["edges"]:
+        adj.setdefault(a, set()).add(b)
+    assert lockwatch.find_cycles(adj) == []
+    # the transitive closure must see the engine nesting its lock over
+    # the sink/monitor locks — an empty graph means a blind analyzer
+    edges = {(a, b) for a, b, _ in graph["edges"]}
+    assert ("ServingEngine._mu", "JsonlSink._mu") in edges
+    assert ("ServingEngine._mu", "StatRegistry._mu") in edges
+
+
+def test_specimen_unguarded_caught_by_name():
+    findings, _ = lint_files(
+        [os.path.join(SPECIMENS, "thread_unguarded.py")])
+    assert _rules(findings).count("TH601") == 2
+    texts = " ".join(f"{f.location} {f.message}" for f in findings)
+    assert "self.count" in texts and "SpecimenSilent" in texts
+
+
+def test_exempt_list_is_documented_and_disjoint():
+    for mod, reason in threadlint.EXEMPT.items():
+        assert mod not in threadlint.MODULES
+        assert len(reason) > 10    # a real reason, not a placeholder
+    for mod in threadlint.MODULES:
+        assert os.path.exists(os.path.join(REPO, mod)), mod
+
+
+# ---------------------------------------------------------------------------
+# lockwatch: the runtime witness
+# ---------------------------------------------------------------------------
+
+def test_lockwatch_disarmed_returns_raw_primitives():
+    lk = lockwatch.make_lock("X._mu")
+    assert type(lk) is type(threading.Lock())
+    assert lockwatch.snapshot() == []
+
+
+def test_lockwatch_traces_cross_thread_nested_acquisition():
+    lockwatch.arm()
+    a = lockwatch.make_lock("A._mu")
+    b = lockwatch.make_lock("B._mu")
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=nested)
+    t.start()
+    t.join()
+    assert ("A._mu", "B._mu", 1) in lockwatch.edges()
+    assert lockwatch.observed_cycles() == []
+    with a:
+        row = next(r for r in lockwatch.snapshot()
+                   if r["name"] == "A._mu")
+        assert row["holder"] == "MainThread"
+        assert row["acquires"] == 2
+    row = next(r for r in lockwatch.snapshot() if r["name"] == "A._mu")
+    assert row["holder"] is None
+
+
+def test_lockwatch_observed_cycle_and_record():
+    lockwatch.arm()
+    a = lockwatch.make_lock("A._mu")
+    b = lockwatch.make_lock("B._mu")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = lockwatch.observed_cycles()
+    assert cycles and set(cycles[0][:-1]) == {"A._mu", "B._mu"}
+    rec = lockwatch.observed_record()
+    assert sink_mod.validate_step_record(rec) == []
+    assert any(f["rule"] == "TH602" for f in rec["findings"])
+
+
+def test_lockwatch_rlock_reentry_is_not_an_edge():
+    lockwatch.arm()
+    mu = lockwatch.make_rlock("R._mu")
+    with mu:
+        with mu:
+            pass
+    assert lockwatch.edges() == []
+
+
+def test_lockwatch_condition_shares_lock_node():
+    lockwatch.arm()
+    mu = lockwatch.make_rlock("C._mu")
+    cv = lockwatch.make_condition("C._cv", mu)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        hits.append(1)
+        cv.notify_all()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert lockwatch.edges() == []    # one node, no self-edges
+
+
+# ---------------------------------------------------------------------------
+# thread_lint records + trace_check cross-rules both ways
+# ---------------------------------------------------------------------------
+
+def _check(path):
+    sys.path.insert(0, TOOLS)
+    import trace_check
+    return trace_check.check_pair(str(path))
+
+
+def _write(path, *records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_thread_lint_record_schema():
+    findings, graph = lint_repo()
+    rec = sink_mod.make_thread_lint_record(
+        source="static", findings=findings, edges=graph["edges"],
+        modules=threadlint.MODULES)
+    assert sink_mod.validate_step_record(rec) == []
+    assert rec["n_edges"] == len(graph["edges"])
+    bad = dict(rec)
+    bad["source"] = "vibes"
+    assert sink_mod.validate_step_record(bad)
+    bad = dict(rec)
+    bad["findings"] = [{"rule": "KN501", "message": "wrong family"}]
+    bad["n_findings"] = 1
+    assert sink_mod.validate_step_record(bad)
+
+
+def test_cross_rule_observed_subset_of_static(tmp_path):
+    static = sink_mod.make_thread_lint_record(
+        source="static",
+        edges=[["A._mu", "B._mu", "a.py:1 A.fwd"]])
+    ok_obs = sink_mod.make_thread_lint_record(
+        source="lockwatch", edges=[["A._mu", "B._mu", 4]])
+    problems, stats = _check(_write(tmp_path / "ok.jsonl",
+                                    static, ok_obs))
+    assert problems == []
+    assert stats["n_thread_lint"] == 2
+
+    rogue = sink_mod.make_thread_lint_record(
+        source="lockwatch", edges=[["B._mu", "C._mu", 1]])
+    problems, _ = _check(_write(tmp_path / "rogue.jsonl",
+                                static, rogue))
+    assert any("absent from the static graph" in p for p in problems)
+
+
+def test_cross_rule_observed_cycle_must_carry_finding(tmp_path):
+    cyclic = sink_mod.make_thread_lint_record(
+        source="lockwatch",
+        edges=[["A._mu", "B._mu", 2], ["B._mu", "A._mu", 1]])
+    problems, _ = _check(_write(tmp_path / "cyc.jsonl", cyclic))
+    assert any("TH602" in p for p in problems)
+
+    confessed = sink_mod.make_thread_lint_record(
+        source="lockwatch",
+        findings=[{"rule": "TH602",
+                   "message": "observed lock-order cycle: "
+                              "A._mu -> B._mu -> A._mu"}],
+        edges=[["A._mu", "B._mu", 2], ["B._mu", "A._mu", 1]])
+    problems, _ = _check(_write(tmp_path / "conf.jsonl", confessed))
+    # self-incriminating record passes the cross-rule (the CALLER
+    # decides a cycle is fatal — serving_smoke/drill do)
+    assert not any("TH602" in p for p in problems)
+
+
+def test_static_graph_contains_observed_engine_edges():
+    """The witness <-> analyzer contract on the REAL modules: anything
+    lockwatch can observe from the engine under load must already be a
+    static edge (the smoke/drill gate depends on this superset)."""
+    graph = static_lock_graph()
+    edges = {(a, b) for a, b, _ in graph["edges"]}
+    assert ("ServingEngine._mu", "JsonlSink._mu") in edges
+    assert ("ServingEngine._mu", "RequestTracer._mu") in edges
+
+
+# ---------------------------------------------------------------------------
+# regression: the in-tree races the doctor's first pass found
+# ---------------------------------------------------------------------------
+
+def test_recorder_stack_mutation_is_thread_safe(tmp_path):
+    """_RECORDER_STACK is appended/removed by recorder contexts while
+    `current_recorder()` reads it from other threads (emit_record's
+    fallback, span()). The unlocked mutation raced those reads; hammer
+    both sides and require every read to be consistent."""
+    from paddle_tpu.telemetry.recorder import (TelemetryRecorder,
+                                               current_recorder)
+
+    stop = threading.Event()
+    errors = []
+
+    def churn(i):
+        try:
+            while not stop.is_set():
+                with TelemetryRecorder(
+                        sink=str(tmp_path / f"r{i}.jsonl")):
+                    pass
+        except Exception as e:       # pragma: no cover - the regression
+            errors.append(e)
+
+    def read():
+        try:
+            while not stop.is_set():
+                rec = current_recorder()
+                assert rec is None or isinstance(rec, TelemetryRecorder)
+        except Exception as e:       # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, args=(i,))
+               for i in range(3)] + [threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert errors == []
+    assert current_recorder() is None
+
+
+def test_engine_latency_gauge_read_is_locked():
+    """refresh_latency_gauges is called straight from HTTP scrape
+    threads; its read of the step-loop's `_last_latency_obs` must take
+    the engine lock (the static pass proves it — this pins the rule to
+    the method so a revert is a named failure, not a lint diff)."""
+    import ast
+    import inspect
+
+    from paddle_tpu.serving.engine import ServingEngine
+
+    src = inspect.getsource(ServingEngine.refresh_latency_gauges)
+    tree = ast.parse("class _D:\n" + src if src.startswith("    ")
+                     else src)
+    locked_reads = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr == "_last_latency_obs":
+                    locked_reads.append(sub)
+    assert locked_reads, ("_last_latency_obs is no longer read under "
+                          "`with self._mu:` in refresh_latency_gauges")
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_threaddoctor_selfcheck_cli(tmp_path):
+    report = tmp_path / "doctor.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "threaddoctor.py"),
+         "--selfcheck", "--report", str(report)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(report.read_text())
+    assert data["in_tree"]["findings"] == []
+    assert data["lockwatch"]["records_ok"] is True
+    assert data["lockwatch"]["abba_cycles"]

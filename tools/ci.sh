@@ -90,6 +90,19 @@ JAX_PLATFORMS=cpu python tools/kerneldoctor.py --selfcheck
 # kernel must validate under trace_check and stay quiet, and the
 # timing DB must refuse non-finite rows and round-trip losslessly
 JAX_PLATFORMS=cpu python tools/kernellab.py --selfcheck
+# concurrency doctor gate (tools/threaddoctor.py over paddle_tpu/
+# analysis/threadlint.py + lockwatch.py), the doctor pattern applied
+# to the host-side threaded runtime: the checked-in broken specimens
+# must be caught BY NAME — the unguarded-field class
+# (tools/specimens/thread_unguarded.py -> TH601, incl. the silent
+# lock-owner coverage half) and the ABBA / cross-object lock-order
+# cycles (tools/specimens/thread_deadlock.py -> TH602 naming both
+# edges) — every module in threadlint.MODULES must lint clean, the
+# lockwatch witness must trace a real cross-thread nested acquisition
+# and catch a reversed order as an observed cycle, and the emitted
+# kind=thread_lint records must validate under tools/trace_check.py
+# including the observed-subset-of-static cross-rule
+JAX_PLATFORMS=cpu python tools/threaddoctor.py --selfcheck
 
 echo "== [4/10] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays

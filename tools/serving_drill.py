@@ -428,12 +428,19 @@ def drill(telemetry_path=None, rated_only=False, n_wave=8, max_new=12):
         telemetry_path = os.path.join(
             tempfile.mkdtemp(prefix="serving_drill_"),
             "serving_drill.jsonl")
+    # arm the lock-order witness for the whole drill: overload +
+    # shedding is exactly the load shape that surfaces an acquisition
+    # order the smoke's polite traffic never takes
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from serving_smoke import _lockwatch_arm, _lockwatch_close
+    _lockwatch_arm()
     sink = telemetry.JsonlSink(telemetry_path)
     model = _build()
     if not rated_only:
         overload_fault_leg(model, sink, findings, n_wave=n_wave,
                            max_new=max_new)
     rated_leg(model, sink, findings)
+    findings += _lockwatch_close(sink)
     sink.close()
     if not rated_only:
         # the combined lifecycle ledger must validate — including the

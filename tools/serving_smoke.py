@@ -78,6 +78,41 @@ def _build(seed=0):
     return GPTForPretraining(cfg)
 
 
+def _lockwatch_arm():
+    """Arm the lock-order witness BEFORE the engine/sink construct
+    their locks, so the run's real acquisition graph is observed."""
+    from paddle_tpu.analysis import lockwatch
+
+    lockwatch.reset()
+    lockwatch.arm()
+
+
+def _lockwatch_close(sink):
+    """Ledger the witness evidence into `sink` and disarm. Writes the
+    static nested-acquisition graph record next to the observed one so
+    trace_check's cross-rules gate observed ⊆ static on this very
+    file; any observed cycle is a finding here (deadlock-in-waiting
+    under the smoke load), as is any static finding (the armed run
+    doubles as a live threadlint pass)."""
+    from paddle_tpu.analysis import lockwatch, threadlint
+    from paddle_tpu.telemetry import sink as sink_mod
+
+    findings = []
+    cycles = lockwatch.observed_cycles()
+    if cycles:
+        findings.append(
+            f"observed lock-order cycle(s) under load: {cycles}")
+    s_findings, graph = threadlint.lint_repo()
+    findings += [f"threadlint: {f!r}" for f in s_findings]
+    sink.write(sink_mod.make_thread_lint_record(
+        source="static", findings=s_findings, edges=graph["edges"],
+        modules=threadlint.MODULES))
+    sink.write(lockwatch.observed_record())
+    lockwatch.disarm()
+    lockwatch.reset()
+    return findings
+
+
 def _references(model, prompts, max_new):
     import paddle_tpu as paddle
 
@@ -103,6 +138,7 @@ def smoke(n_requests=6, max_new=12):
 
     tel_path = os.path.join(tempfile.mkdtemp(prefix="serving_smoke_"),
                             "serving_smoke.jsonl")
+    _lockwatch_arm()
     sink = telemetry.JsonlSink(tel_path)
     with telemetry.CompileObservatory(sink=sink, action="record") as obs:
         engine = ServingEngine(model, max_slots=4, block_size=8,
@@ -186,8 +222,10 @@ def smoke(n_requests=6, max_new=12):
                             "pool — the allocator is leaking blocks")
 
     # the ledger itself must validate: compile records, serving
-    # lifecycle records, AND the reqtrace decomposition cross-rule
-    # (every trace's spans must sum to its e2e latency within 1%)
+    # lifecycle records, the reqtrace decomposition cross-rule (every
+    # trace's spans must sum to its e2e latency within 1%), AND the
+    # lock witness pair (observed acquisition edges ⊆ static graph)
+    findings += _lockwatch_close(sink)
     sink.close()
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import trace_check
@@ -336,6 +374,7 @@ def prefix_smoke(n_requests=6, max_new=8):
 
     tel_path = os.path.join(tempfile.mkdtemp(prefix="serving_prefix_"),
                             "serving_prefix.jsonl")
+    _lockwatch_arm()
     sink = telemetry.JsonlSink(tel_path)
     with telemetry.CompileObservatory(sink=sink, action="record") as obs:
         engine = ServingEngine(model, max_slots=4, block_size=8,
@@ -383,6 +422,7 @@ def prefix_smoke(n_requests=6, max_new=8):
                     f"{fam} compiled {n} times during the shared-prefix "
                     "leg — prefix resume broke the fixed-shape contract "
                     f"(cause diffs in {tel_path})")
+    findings += _lockwatch_close(sink)
     sink.close()
     n_saved = int(monitor.get_gauge("serving.prefill_tokens_saved", 0))
     print(f"prefix smoke: {n_requests} streams over 2 templates, "

@@ -1,0 +1,60 @@
+"""Concurrency-doctor specimen: ABBA lock-order cycle (TH602).
+
+Two locks taken in opposite orders on two paths — the textbook
+deadlock. threaddoctor --selfcheck must produce a TH602 finding that
+names BOTH edges (`SpecimenDeadlock._a -> SpecimenDeadlock._b` and the
+reverse) with their source sites, plus the cross-object variant:
+`SpecimenOwner._mu -> SpecimenPeer._mu` via a one-level attribute call
+closing a cycle with SpecimenPeer's callback path.
+
+This file is LINTED (analysis/threadlint.py), never imported by the
+runtime. Keep it broken.
+"""
+import threading
+
+
+class SpecimenDeadlock:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0            # guarded by: _a
+
+    def forward(self):
+        with self._a:
+            with self._b:     # edge _a -> _b
+                self.n += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:     # edge _b -> _a: the ABBA cycle
+                self.n -= 1
+
+
+class SpecimenPeer:
+    def __init__(self, owner):
+        self._mu = threading.Lock()
+        self._owner = owner   # threadlint: type=SpecimenOwner
+        self.hits = 0         # guarded by: _mu
+
+    def poke(self):
+        with self._mu:
+            self.hits += 1
+
+    def callback(self):
+        with self._mu:
+            self._owner.touch()   # edge SpecimenPeer._mu -> SpecimenOwner._mu
+
+
+class SpecimenOwner:
+    def __init__(self, peer):
+        self._mu = threading.Lock()
+        self._peer = peer     # threadlint: type=SpecimenPeer
+        self.state = 0        # guarded by: _mu
+
+    def touch(self):
+        with self._mu:
+            self.state += 1
+
+    def kick(self):
+        with self._mu:
+            self._peer.poke()     # edge SpecimenOwner._mu -> SpecimenPeer._mu

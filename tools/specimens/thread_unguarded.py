@@ -1,0 +1,39 @@
+"""Concurrency-doctor specimen: unguarded shared state (TH601).
+
+A deliberately broken class the threaddoctor --selfcheck must catch BY
+NAME: `SpecimenUnguarded.count` is declared guarded by `_mu` but
+`bump()` mutates it lock-free — the race the annotation convention
+exists to make impossible to write silently. `SpecimenSilent` owns a
+lock but declares nothing at all — the coverage half of TH601 (shared
+state invisible to the doctor) must flag it too.
+
+This file is LINTED (analysis/threadlint.py), never imported by the
+runtime. Keep it broken.
+"""
+import threading
+
+
+class SpecimenUnguarded:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.count = 0        # guarded by: _mu
+
+    def bump(self):
+        self.count += 1       # no lock held -> TH601 by name
+
+    def read(self):
+        with self._mu:
+            return self.count
+
+
+class SpecimenSilent:
+    """Owns a lock, declares no guarded fields: the TH601 coverage
+    finding (the FW405 closure move applied to threading)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.items = []
+
+    def add(self, x):
+        with self._mu:
+            self.items.append(x)

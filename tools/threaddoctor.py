@@ -1,0 +1,402 @@
+#!/usr/bin/env python
+"""Concurrency Doctor CLI: static lock-discipline + deadlock analysis
+of the host-side threaded runtime (paddle_tpu/analysis/threadlint.py),
+with the lockwatch runtime witness as its dynamic cross-check.
+
+The threading-level sibling of tools/kerneldoctor.py: parses the
+threaded modules (threadlint.MODULES — serving engine, prefetch
+pipeline, telemetry sinks/recorder/watchdog, monitor) as one closed
+world and derives WITHOUT running a server:
+
+  TH601 unguarded shared state (a field declared `# guarded by: X`
+        written/read without X held) + the coverage half (a class that
+        owns a lock but declares nothing is flagged, not skipped)
+  TH602 lock-order cycles in the static nested-acquisition graph
+        (closed transitively over self-calls, typed attributes and
+        KNOWN_MODULE_LOCKS), the finding naming EVERY edge with its
+        source site
+  TH603 blocking call under a non-dispatch lock (device dispatch,
+        sockets, bounded queue.put, Thread.join, sleep)
+  TH604 Condition.wait outside a predicate loop; timeout-less blocking
+        reachable from HTTP handlers / shutdown paths
+
+    JAX_PLATFORMS=cpu python tools/threaddoctor.py \
+        [--report doctor.json] [--telemetry run.jsonl]
+
+--selfcheck (the ci.sh stage-3 gate) is the usual two-sided pattern:
+  a) the checked-in broken specimens must be caught BY NAME —
+     tools/specimens/thread_unguarded.py (lock-free mutation of a
+     guarded field -> TH601, silent lock owner -> TH601 coverage) and
+     tools/specimens/thread_deadlock.py (same-class ABBA and
+     cross-object cycles -> TH602 naming both edges);
+  b) every in-tree module in threadlint.MODULES must lint clean
+     (EXEMPT is the explicit, documented not-covered list);
+  c) coverage proof: a synthetic class that owns a lock but declares
+     no guarded fields must be flagged — the doctor cannot be blinded
+     by silence;
+  d) the emitted kind=thread_lint records (source=static AND
+     source=lockwatch) must validate under tools/trace_check.py,
+     including its cross-rules: a lockwatch record whose own edges
+     form a cycle must fail, and an observed edge outside the static
+     graph must fail;
+  e) the lockwatch witness end-to-end: armed factories trace real
+     cross-thread nested acquisitions into named edges, the snapshot
+     names holders, the black-box dump grows a `locks` section, and a
+     deliberately reversed acquisition order is caught as an observed
+     TH602 cycle.
+
+Exit codes: 0 clean; 12 findings on in-tree modules; 9 selfcheck miss
+(a specimen not caught, coverage hole, or invalid records — the doctor
+itself is broken).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPECIMEN_DIR = os.path.join(REPO, "tools", "specimens")
+
+# the synthetic for selfcheck leg (c): owns a lock, declares nothing —
+# must produce the TH601 coverage finding or the doctor has a blind
+# spot exactly where annotations are missing
+_SILENT_SYNTHETIC = """\
+import threading
+
+class Quiet:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.jobs = []
+
+    def push(self, j):
+        with self._mu:
+            self.jobs.append(j)
+"""
+
+
+def static_record(findings, graph):
+    from paddle_tpu.telemetry import sink
+    from paddle_tpu.analysis import threadlint
+
+    return sink.make_thread_lint_record(
+        source="static", findings=findings, edges=graph["edges"],
+        modules=threadlint.MODULES)
+
+
+def print_report(findings, graph):
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import threadlint
+
+    print(f"modules linted: {len(threadlint.MODULES)} "
+          f"(+{len(threadlint.EXEMPT)} exempt)")
+    print(f"lock graph: {len(graph['nodes'])} nodes, "
+          f"{len(graph['edges'])} nested-acquisition edges")
+    for a, b, site in graph["edges"]:
+        print(f"  {a} -> {b}   [{site.replace(REPO + os.sep, '')}]")
+    if findings:
+        print(analysis.format_findings(findings))
+    else:
+        print("no findings")
+
+
+def _caught(findings, rule, *names):
+    """Findings of `rule` whose location+message mention every name."""
+    out = []
+    for f in findings:
+        if f.rule_id != rule:
+            continue
+        text = f"{f.location} {f.message}"
+        if all(n in text for n in names):
+            out.append(f)
+    return out
+
+
+def run_selfcheck():
+    """The two-sided gate. Returns (ok, report dict)."""
+    from paddle_tpu.analysis import lockwatch, threadlint
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_check
+
+    ok = True
+    report = {}
+
+    def fail(msg):
+        nonlocal ok
+        print(f"SELFCHECK FAILED: {msg}", file=sys.stderr)
+        ok = False
+
+    # a) broken specimens caught by name
+    spec_expect = {
+        "thread_unguarded.py": [
+            ("TH601", ("self.count", "bump")),
+            ("TH601", ("SpecimenSilent",)),
+        ],
+        "thread_deadlock.py": [
+            ("TH602", ("SpecimenDeadlock._a", "SpecimenDeadlock._b")),
+            ("TH602", ("SpecimenOwner._mu", "SpecimenPeer._mu")),
+        ],
+    }
+    for fname, expected in spec_expect.items():
+        findings, _graph = threadlint.lint_files(
+            [os.path.join(SPECIMEN_DIR, fname)])
+        report[fname] = {"findings": [f.to_dict() for f in findings]}
+        for rule, names in expected:
+            if not _caught(findings, rule, *names):
+                fail(f"{fname} did not produce a {rule} finding naming "
+                     f"{names} (got: "
+                     f"{[(f.rule_id, f.location) for f in findings]})")
+        report[fname]["caught"] = ok
+
+    # the ABBA finding must name BOTH edges with their sites — a cycle
+    # report that shows one direction sends the reader to the wrong fix
+    abba, _g = threadlint.lint_files(
+        [os.path.join(SPECIMEN_DIR, "thread_deadlock.py")])
+    for f in _caught(abba, "TH602", "SpecimenDeadlock._a"):
+        if not ("_a -> " in f.message and "_b -> " in f.message):
+            fail("ABBA TH602 finding does not name both edges: "
+                 f"{f.message!r}")
+
+    # b) every in-tree module clean
+    findings, graph = threadlint.lint_repo()
+    report["in_tree"] = {
+        "n_modules": len(threadlint.MODULES),
+        "nodes": graph["nodes"], "edges": graph["edges"],
+        "findings": [f.to_dict() for f in findings]}
+    if findings:
+        fail(f"{len(findings)} finding(s) on in-tree modules:")
+        for f in findings:
+            print(f"  {f!r}", file=sys.stderr)
+    if not graph["edges"]:
+        fail("the in-tree static lock graph has no edges — the "
+             "transitive closure is broken (the serving engine alone "
+             "nests its lock over the sink/monitor locks)")
+
+    # c) coverage proof: a silent lock owner cannot hide
+    cov, _g = threadlint.lint_source(_SILENT_SYNTHETIC, "synthetic.py")
+    report["coverage_synthetic"] = [f.to_dict() for f in cov]
+    if not _caught(cov, "TH601", "Quiet"):
+        fail("a lock-owning class with no guarded-by declarations was "
+             "not flagged — the doctor can be blinded by silence")
+
+    # d+e) lockwatch witness end-to-end, then records through
+    # trace_check (positive and both negative cross-rules)
+    report["lockwatch"] = _witness_leg(fail, lockwatch, findings, graph,
+                                       trace_check)
+    return ok, report
+
+
+def _witness_leg(fail, lockwatch, static_findings, static_graph,
+                 trace_check):
+    """Arm the witness, drive a real cross-thread nested acquisition,
+    and validate the records + cross-rules both ways."""
+    from paddle_tpu.telemetry import watchdog
+
+    report = {}
+    lockwatch.reset()
+    lockwatch.arm()
+    try:
+        outer = lockwatch.make_lock("SelfcheckOuter._mu")
+        inner = lockwatch.make_lock("SelfcheckInner._mu")
+
+        def nested():
+            with outer:
+                with inner:
+                    pass
+
+        t = threading.Thread(target=nested)
+        t.start()
+        t.join()
+        obs = lockwatch.edges()
+        report["edges"] = [[a, b, n] for a, b, n in obs]
+        if ("SelfcheckOuter._mu", "SelfcheckInner._mu", 1) not in obs:
+            fail("lockwatch missed a cross-thread nested acquisition "
+                 f"(observed: {obs})")
+        with outer:
+            snap = lockwatch.snapshot()
+            row = next((r for r in snap
+                        if r["name"] == "SelfcheckOuter._mu"), None)
+            if row is None or row["holder"] != "MainThread":
+                fail(f"lockwatch snapshot does not name the holder "
+                     f"(got {row})")
+            box_path = watchdog.dump_black_box(
+                reason="threaddoctor selfcheck",
+                path=tempfile.mktemp(suffix=".json"))
+        with open(box_path) as f:
+            box = json.load(f)
+        os.unlink(box_path)
+        locks_section = box.get("locks")
+        report["blackbox_locks"] = locks_section
+        if not isinstance(locks_section, list) or not any(
+                r.get("name") == "SelfcheckOuter._mu"
+                for r in locks_section):
+            fail("black-box dump has no usable `locks` section "
+                 f"(got {locks_section!r})")
+        if lockwatch.observed_cycles():
+            fail("observed cycles before the ABBA drill — the witness "
+                 "state is dirty")
+
+        # records must validate: static + observed in one file. The
+        # observed selfcheck edge is NOT in the in-tree static graph,
+        # so the subgraph cross-rule must FIRE on the pair (negative
+        # proof) — then pass once the static record covers the edge.
+        ok_rec = _records_validate(fail, lockwatch, static_findings,
+                                   static_graph, trace_check)
+        report["records_ok"] = ok_rec
+
+        # deliberately reversed order (sequential, so no real deadlock)
+        # must surface as an observed TH602 cycle
+        def reversed_nested():
+            with inner:
+                with outer:
+                    pass
+
+        t = threading.Thread(target=reversed_nested)
+        t.start()
+        t.join()
+        cycles = lockwatch.observed_cycles()
+        report["abba_cycles"] = cycles
+        if not cycles:
+            fail("a reversed acquisition order produced no observed "
+                 "TH602 cycle")
+        rec = lockwatch.observed_record()
+        if not any(f["rule"] == "TH602" for f in rec["findings"]):
+            fail("observed_record() of a cyclic graph carries no "
+                 "TH602 finding")
+    finally:
+        lockwatch.disarm()
+        lockwatch.reset()
+    return report
+
+
+def _records_validate(fail, lockwatch, static_findings, static_graph,
+                      trace_check):
+    """Write (static, observed) pairs through a real JSONL file and
+    check_pair. Three passes: valid pair OK; observed edge outside the
+    static graph FAILS; cyclic observed edges without a finding FAIL."""
+    from paddle_tpu.telemetry import sink as sink_mod
+
+    ok = True
+    obs = lockwatch.observed_record()
+
+    # the selfcheck locks are synthetic, so splice their edge into the
+    # static record for the positive pass
+    covered = dict(static_record(static_findings, static_graph))
+    covered["edges"] = covered["edges"] + [
+        ["SelfcheckOuter._mu", "SelfcheckInner._mu", "synthetic"]]
+    covered["n_edges"] = len(covered["edges"])
+
+    def pair_problems(*records):
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".jsonl", delete=False) as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+            path = f.name
+        try:
+            # check_pair's NAMED stats, not the positional count tuple
+            # (see kerneldoctor._records_validate for the history)
+            problems, stats = trace_check.check_pair(path)
+            return problems, stats
+        finally:
+            os.unlink(path)
+
+    for rec in (covered, obs):
+        errs = sink_mod.validate_step_record(rec)
+        if errs:
+            fail(f"thread_lint record invalid at the sink layer: {errs}")
+            ok = False
+
+    problems, stats = pair_problems(covered, obs)
+    if problems:
+        fail("valid (static, lockwatch) record pair did not validate:")
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        ok = False
+    if stats["n_thread_lint"] != 2:
+        fail(f"wrote 2 thread_lint records, trace_check counted "
+             f"{stats['n_thread_lint']}")
+        ok = False
+
+    # negative 1: observed edge absent from the static graph must fail
+    uncovered = static_record(static_findings, static_graph)
+    problems, _stats = pair_problems(uncovered, obs)
+    if not any("absent from the static graph" in p for p in problems):
+        fail("an observed edge outside the static graph was not "
+             "flagged — the subgraph cross-rule is dead")
+        ok = False
+
+    # negative 2: a lockwatch record whose own edges form a cycle but
+    # carry no TH602 finding must fail
+    cyclic = sink_mod.make_thread_lint_record(
+        source="lockwatch",
+        edges=[["A._mu", "B._mu", 3], ["B._mu", "A._mu", 1]])
+    problems, _stats = pair_problems(cyclic)
+    if not any("TH602" in p for p in problems):
+        fail("a cyclic lockwatch record with no TH602 finding was not "
+             "flagged — the cycle cross-rule is dead")
+        ok = False
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--report", default=None,
+                    help="write the JSON report here")
+    ap.add_argument("--telemetry", default=None,
+                    help="append kind=thread_lint records to this JSONL")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="broken specimens + in-tree clean + coverage "
+                         "synthetic + witness + record validation")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import threadlint
+
+    if args.selfcheck:
+        ok, report = run_selfcheck()
+        report["tool"] = "threaddoctor"
+        if args.report:
+            with open(args.report, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+        if ok:
+            print("thread doctor selfcheck OK: both broken specimens "
+                  "caught by name, "
+                  f"{report['in_tree']['n_modules']} in-tree modules "
+                  "clean, silent lock owner flagged, witness traces "
+                  "edges + catches reversed order, records validate "
+                  "both ways")
+        return 0 if ok else 9
+
+    findings, graph = threadlint.lint_repo()
+    print_report(findings, graph)
+    report = {
+        "tool": "threaddoctor",
+        "findings": [f.to_dict() for f in findings],
+        "summary": analysis.summarize(findings),
+        "graph": graph,
+        "modules": list(threadlint.MODULES),
+        "exempt": {k: v for k, v in threadlint.EXEMPT.items()},
+    }
+    if args.telemetry:
+        from paddle_tpu.telemetry.sink import JsonlSink
+        sink = JsonlSink(args.telemetry)
+        sink.write(static_record(findings, graph))
+        sink.close()
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"report: {args.report}")
+    if findings:
+        print(f"thread doctor: {len(findings)} finding(s)")
+        return 12
+    print(f"thread doctor: {len(threadlint.MODULES)} modules clean, "
+          f"{len(graph['edges'])} acquisition edges, no cycles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,8 @@ def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
     n_serving_records, n_kernel_records, n_reqtrace_records,
-    n_kernelbench_records, problems). Positional consumers should
+    n_kernelbench_records, n_thread_lint_records, problems). Positional
+    consumers should
     prefer check_pair's named stats dict — this tuple GROWS when a new
     record kind lands (kerneldoctor's selfcheck was silently broken by
     exactly such an append once).
@@ -38,11 +39,11 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
-                                                     "metrics file (0 "
-                                                     "bytes): no step "
-                                                     "was ever "
-                                                     "recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
+                                                        "metrics file "
+                                                        "(0 bytes): no "
+                                                        "step was ever "
+                                                        "recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -53,8 +54,8 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: unreadable: "
-                                                 f"{e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
+                                                    f"unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -70,6 +71,7 @@ def check_metrics_jsonl(path):
     problems += check_kernel_records(records, path)
     problems += check_reqtrace_records(records, path)
     problems += check_kernelbench_records(records, path)
+    problems += check_thread_lint_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -93,9 +95,12 @@ def check_metrics_jsonl(path):
     n_kernelbench = sum(1 for r in records
                         if isinstance(r, dict)
                         and r.get("kind") == "kernelbench")
+    n_thread_lint = sum(1 for r in records
+                        if isinstance(r, dict)
+                        and r.get("kind") == "thread_lint")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
             n_elastic, n_serving, n_kernel, n_reqtrace, n_kernelbench,
-            problems)
+            n_thread_lint, problems)
 
 
 def check_compile_records(records, path):
@@ -487,6 +492,67 @@ def check_kernel_records(records, path):
     return problems
 
 
+def check_thread_lint_records(records, path):
+    """Cross-record rules for Concurrency Doctor results
+    (kind=thread_lint, analysis/threadlint + analysis/lockwatch via
+    tools/threaddoctor.py; per-record schema — source vocabulary, TH
+    rule vocabulary, n_findings/n_edges agreement, edge-triple shape —
+    lives in sink.validate_step_record):
+
+    - a source=lockwatch record whose OWN edge list contains a cycle
+      must carry a TH602 finding — a witness that writes down the
+      circular acquisition order but claims the run was clean is
+      doctored or never looked at its own graph;
+    - when the same file carries a source=static record (the analyzer's
+      nested-acquisition graph), every observed lockwatch edge must be
+      a subgraph edge of the static union: an observed edge the
+      analyzer never derived means a real acquisition path it is blind
+      to (un-annotated lock, manual .acquire(), reflection) and the
+      static TH602 verdict cannot be trusted.
+    """
+    from paddle_tpu.analysis.lockwatch import find_cycles
+
+    problems = []
+    static_edges = set()
+    has_static = False
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "thread_lint":
+            continue
+        if rec.get("source") == "static":
+            has_static = True
+            for e in rec.get("edges", []):
+                if isinstance(e, list) and len(e) == 3:
+                    static_edges.add((e[0], e[1]))
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "thread_lint":
+            continue
+        if rec.get("source") != "lockwatch":
+            continue
+        edges = [e for e in rec.get("edges", [])
+                 if isinstance(e, list) and len(e) == 3]
+        adj = {}
+        for a, b, _count in edges:
+            adj.setdefault(a, set()).add(b)
+        cycles = find_cycles(adj)
+        rules = {f.get("rule") for f in rec.get("findings", [])
+                 if isinstance(f, dict)}
+        if cycles and "TH602" not in rules:
+            loops = ["->".join(c) for c in cycles]
+            problems.append(
+                f"{path}:{i + 1}: lockwatch record's own edges contain "
+                f"lock-order cycle(s) {loops} but carry no TH602 "
+                "finding — the observed graph and the verdict disagree")
+        if has_static:
+            for a, b, _count in edges:
+                if (a, b) not in static_edges:
+                    problems.append(
+                        f"{path}:{i + 1}: observed lock-order edge "
+                        f"{a} -> {b} is absent from the static graph "
+                        "in this file — the analyzer is blind to a "
+                        "real acquisition path")
+    return problems
+
+
 # the serving-lifecycle event families (paddle_tpu.serving; per-record
 # schema lives in sink.validate_step_record)
 _SERVING_TERMINAL = ("finished", "failed", "cancelled", "expired")
@@ -788,14 +854,15 @@ def check_pair(jsonl_path, trace_path=None):
     valid; stats carries the already-computed counts so callers don't
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
-     n_serving, n_kernel, n_reqtrace, n_kernelbench, problems) = \
-        check_metrics_jsonl(jsonl_path)
+     n_serving, n_kernel, n_reqtrace, n_kernelbench, n_thread_lint,
+     problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
              "n_elastic": n_elastic, "n_serving": n_serving,
              "n_kernel": n_kernel, "n_reqtrace": n_reqtrace,
              "n_kernelbench": n_kernelbench,
+             "n_thread_lint": n_thread_lint,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -852,6 +919,8 @@ def main(argv):
         msg += f" ({stats['n_reqtrace']} request traces)"
     if stats.get("n_kernelbench"):
         msg += f" ({stats['n_kernelbench']} kernel measurements)"
+    if stats.get("n_thread_lint"):
+        msg += f" ({stats['n_thread_lint']} thread-lint records)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
