@@ -16,10 +16,19 @@ contribute (n-1)/n of the operand bytes (each device keeps its own
 shard), `ppermute` the full operand (every element moves one hop),
 `psum`/`pmean` 2(n-1)/n (ring all-reduce). Axis sizes come from the
 `axis_sizes` argument; unknown axes count at full payload.
+
+Third honesty leg (`check_commbench_wire_bytes`): the mesh
+observatory's MEASURED sweep records (telemetry/comm_obs) claim
+wire_bytes through the same `_wire_bytes` convention — this check
+rebuilds each measured sweep program, re-traces it, and requires the
+record's claim to agree with this module's jaxpr-derived accounting
+within the same 2x band the analytic-vs-traced legs use. Analytic
+terms, traced programs, and measured records now all triangulate.
 """
 import numpy as np
 
-__all__ = ["collective_wire_bytes", "trace_collective_wire_bytes"]
+__all__ = ["check_commbench_wire_bytes", "collective_wire_bytes",
+           "trace_collective_wire_bytes"]
 
 # primitive name -> wire-fraction rule
 _FULL = ("ppermute",)
@@ -112,3 +121,74 @@ def trace_collective_wire_bytes(fn, *args, axis_sizes=None):
     import jax
     closed = jax.make_jaxpr(fn)(*args)
     return collective_wire_bytes(closed, axis_sizes=axis_sizes)
+
+
+# primitive names each sweep op's program may legitimately lower to
+# (pmean -> psum + divide is the existing precedent; reduce_scatter is
+# lax.psum_scatter's primitive of the same name)
+_OP_PRIMS = {
+    "psum": ("psum",),
+    "all_gather": ("all_gather",),
+    "reduce_scatter": ("reduce_scatter",),
+    "all_to_all": ("all_to_all",),
+    "ppermute": ("ppermute",),
+}
+
+
+def check_commbench_wire_bytes(records, mesh=None, band=2.0):
+    """Third leg of the comm honesty loop: measured commbench records'
+    claimed wire_bytes vs this module's jaxpr-derived accounting of the
+    SAME sweep program, rebuilt and re-traced (never executed) on the
+    live mesh. Returns problem strings ([] == honest): a claim off by
+    more than `band`x either way, a rebuilt program whose jaxpr shows
+    no collective, or a record naming an axis the mesh lacks. Records
+    that are not measurement rows (event=db_update echoes, null
+    timings) or that claim no wire_bytes are skipped — there is
+    nothing to cross-check. Runs inside `commlab --selfcheck`, so CI
+    enforces that the harness and the auditor cannot drift apart."""
+    import jax
+    from ..distributed import env
+    from ..telemetry import comm_obs
+
+    mesh = mesh if mesh is not None else env.current_mesh()
+    if mesh is None:
+        return ["check_commbench_wire_bytes: no mesh — pass mesh= or "
+                "env.build_mesh(...) first"]
+    problems = []
+    axis_sizes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    for i, rec in enumerate(records or ()):
+        if not isinstance(rec, dict) or rec.get("kind") != "commbench":
+            continue
+        if rec.get("event") not in (None, "measure"):
+            continue
+        claimed = rec.get("wire_bytes")
+        op, axis = rec.get("op"), rec.get("axis")
+        if not claimed or op not in _OP_PRIMS:
+            continue
+        if axis not in axis_sizes:
+            problems.append(
+                f"record {i} ({op}): axis {axis!r} not on the live mesh "
+                f"(axes: {sorted(axis_sizes)})")
+            continue
+        fn, sds, _spec, _actual = comm_obs.sweep_program(
+            op, axis, mesh, rec.get("payload_bytes", 0))
+        acct = trace_collective_wire_bytes(
+            fn, jax.ShapeDtypeStruct(sds.shape, sds.dtype),
+            axis_sizes=axis_sizes)
+        analytic = sum(e["bytes"] for name, e in acct.items()
+                       if name in _OP_PRIMS[op])
+        if analytic <= 0:
+            problems.append(
+                f"record {i} ({op} over {axis!r}): rebuilt sweep program "
+                "traces to NO collective bytes — the harness and the "
+                "auditor disagree about what the sweep runs")
+            continue
+        ratio = float(claimed) / analytic
+        if not (1.0 / band) <= ratio <= band:
+            problems.append(
+                f"record {i} ({op} over {axis!r}, "
+                f"{rec.get('payload_bytes')} B): claimed wire_bytes "
+                f"{float(claimed):.0f} vs jaxpr-derived {analytic:.0f} "
+                f"({ratio:.2f}x, band {band:.1f}x) — the measurement's "
+                "byte claim does not describe the program it measured")
+    return problems

@@ -63,7 +63,8 @@ def estimate_layout_cost(*, n_params, num_layers, hidden_size,
                          dp=1, pp=1, mp=1, sp=1, ep=1, zero_stage=1,
                          micro_batch=1, num_micro=None, chip="v5p",
                          param_dtype_bytes=4, compute_dtype_bytes=2,
-                         dp_over_dcn=False, peak_flops=None, ici_bw=None):
+                         dp_over_dcn=False, peak_flops=None, ici_bw=None,
+                         comm_calibration=None):
     """Analytic per-step cost of one dp x pp x mp x sp x ep layout:
     compute seconds from the PaLM-style FLOPs count against the chip's
     bf16 peak (pipeline-bubble adjusted), plus per-collective ICI
@@ -88,6 +89,17 @@ def estimate_layout_cost(*, n_params, num_layers, hidden_size,
     the memory planner charges). dp_over_dcn marks the dp axis as the
     outer axis of a two-level (multi-slice) plan: its collectives then
     divide by DCN bandwidth, not ICI.
+
+    comm_calibration: optional {op: factor} multiplicative corrections
+    from MEASURED collective latencies (the mesh observatory —
+    telemetry/comm_obs via planner.calibration_from_comm_records; op
+    names are comm_obs.SWEEP_OPS). Each comm term is scaled by its
+    collective's factor (dp grads + tp allreduces -> psum, the ZeRO-3
+    gather -> all_gather, sp/pp ring hops -> ppermute, ep
+    dispatch/combine -> all_to_all); a factor of 2.0 means this mesh
+    measured that collective at half the analytic bandwidth, so its
+    terms cost double. Missing ops default to 1.0 — analytic. This is
+    the comm sibling of the planner's HBM `calibration` ratio.
     """
     n_chips = dp * pp * mp * sp * ep
     if num_micro is None:
@@ -109,33 +121,40 @@ def estimate_layout_cost(*, n_params, num_layers, hidden_size,
     bubble_frac = (pp - 1) / (num_micro + pp - 1) if pp > 1 else 0.0
     compute_s /= max(1e-9, 1.0 - bubble_frac)
 
+    # measured per-collective corrections (mesh observatory); missing
+    # ops stay analytic (factor 1.0)
+    cal = comm_calibration or {}
+    _c = lambda op: float(cal.get(op, 1.0))  # noqa: E731
+
     local_layers = max(1, -(-num_layers // pp))
     # per-chip shard of the gradient (f32 master grads)
     grad_shard = n_params * param_dtype_bytes / (mp * pp)
-    dp_grad_s = _allreduce_wire_bytes(grad_shard, dp) / dp_bw
+    dp_grad_s = _allreduce_wire_bytes(grad_shard, dp) / dp_bw * _c("psum")
     if zero_stage >= 3:
         # bf16 param all-gather before use, fwd + bwd recompute
         gather = _allgather_wire_bytes(
             n_params * compute_dtype_bytes / (mp * pp), dp)
-        dp_grad_s += 2 * gather / dp_bw
+        dp_grad_s += 2 * gather / dp_bw * _c("all_gather")
 
     # activation tile entering/leaving each TP region
     act_tile = micro_batch * (seq_len // sp) * hidden_size \
         * compute_dtype_bytes
     tp_s = (4 * local_layers * num_micro *
-            _allreduce_wire_bytes(act_tile, mp)) / ici_bw
+            _allreduce_wire_bytes(act_tile, mp)) / ici_bw * _c("psum")
 
     # K and V blocks circulating the sp ring; act_tile is already the
     # per-device (seq/sp) local block, so each of the (sp-1) hops moves
     # the full kv_tile — no further /sp
     kv_tile = 2 * act_tile
     sp_s = (2 * local_layers * num_micro * (sp - 1) * kv_tile
-            ) / ici_bw if sp > 1 else 0.0
+            ) / ici_bw * _c("ppermute") if sp > 1 else 0.0
 
-    pp_s = (2 * num_micro * act_tile / ici_bw) if pp > 1 else 0.0
+    pp_s = (2 * num_micro * act_tile / ici_bw) * _c("ppermute") \
+        if pp > 1 else 0.0
 
     ep_s = (4 * local_layers * num_micro *
-            _allgather_wire_bytes(act_tile, ep)) / ici_bw if ep > 1 else 0.0
+            _allgather_wire_bytes(act_tile, ep)) / ici_bw \
+        * _c("all_to_all") if ep > 1 else 0.0
 
     comm_s = dp_grad_s + tp_s + sp_s + pp_s + ep_s
     step_s = compute_s + comm_s

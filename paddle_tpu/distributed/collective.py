@@ -121,13 +121,16 @@ def guarded_wait(name, value, axis_name=None, deadline_s=None):
     return value
 
 
-def _comm_span(name, tensor=None, axis_name=None):
+def _comm_span(name, tensor=None, axis_name=None, traced=False):
     """Telemetry hook shared by every collective: a host span tagged
     cat='collective' (so TelemetryRecorder attributes per-step comm time
     and the Chrome trace shows it per rank) plus a `comm.<name>` monitor
     counter. For the shard_map primitives the span covers trace time and
     the named_scope inside `_traced_collective` labels the op in the
-    XPlane device trace, where its real run time lives.
+    XPlane device trace, where its real run time lives — those spans
+    arrive with `traced=True`, and the step-record comm attribution
+    (TelemetryRecorder -> comm_ms/comm_frac) excludes them so trace
+    time never masquerades as communication wall time.
 
     The same hook feeds the graph doctor's cross-rank deadlock detector:
     under an active `analysis.collective_order.capture()` every
@@ -142,21 +145,38 @@ def _comm_span(name, tensor=None, axis_name=None):
         _corder.note(name, axis=axis_name,
                      shape=getattr(v, "shape", None),
                      dtype=getattr(v, "dtype", None))
-    # axis/shape ride as span attrs: the hang watchdog's black-box dump
-    # then names not just WHICH collective a stalled step is inside but
-    # over which mesh axis and payload shape (the first question a
-    # pod-hang postmortem asks)
+    # axis/shape/bytes ride as span attrs: the hang watchdog's black-box
+    # dump then names not just WHICH collective a stalled step is inside
+    # but over which mesh axis and what payload (the first questions a
+    # pod-hang postmortem asks), and the mesh observatory
+    # (telemetry/comm_obs) gets payload bytes + axis size uniformly on
+    # every collective span
     attrs = {}
     if axis_name is not None:
         attrs["axis"] = str(axis_name)
+        try:
+            mesh = env.current_mesh()
+            if mesh is not None and axis_name in mesh.shape:
+                attrs["axis_size"] = int(mesh.shape[axis_name])
+        except Exception:
+            pass
     shape = getattr(v, "shape", None)
     if shape is not None:
         attrs["shape"] = str(tuple(shape))
+        dt = getattr(v, "dtype", None)
+        if dt is not None:
+            try:
+                attrs["bytes"] = int(np.prod(shape, dtype=np.int64)
+                                     * np.dtype(dt).itemsize)
+            except (TypeError, ValueError):
+                pass
+    if traced:
+        attrs["traced"] = True
     return telemetry.span(f"collective.{name}", cat="collective", **attrs)
 
 
 def _traced_collective(name, fn, t, axis_name=None):
-    with _comm_span(name, tensor=t, axis_name=axis_name):
+    with _comm_span(name, tensor=t, axis_name=axis_name, traced=True):
         return apply(lambda v: jax.named_scope(f"collective.{name}")(fn)(v),
                      t)
 

@@ -24,7 +24,10 @@ Layers:
               hand-written spec through the same battery;
               `calibration_from_records` closes the loop from the
               compile observatory's measured `memory_analysis()`
-              bytes.
+              bytes; `calibration_from_comm_records` closes the comm
+              loop from the mesh observatory's measured collective
+              latencies (telemetry/comm_obs) into per-collective
+              cost-model corrections.
 
 CLI: `tools/autoshard.py` (plan table, per-candidate rejection
 reasons, JSON report, `--selfcheck`), gated in `tools/ci.sh` stage 3.
@@ -40,9 +43,9 @@ from .rules import (  # noqa: F401
 )
 from .planner import (  # noqa: F401
     AbstractParam, Candidate, InfeasiblePlanError, Layout, MeshSpec,
-    Plan, abstract_params_for, calibration_from_records,
-    default_rules_for, evaluate_layout, gpt_abstract_params,
-    gpt_moe_abstract_params, plan,
+    Plan, abstract_params_for, calibration_from_comm_records,
+    calibration_from_records, default_rules_for, evaluate_layout,
+    gpt_abstract_params, gpt_moe_abstract_params, plan,
 )
 
 __all__ = [
@@ -53,6 +56,7 @@ __all__ = [
     "parameter_spec_from_name",
     "AbstractParam", "Candidate", "InfeasiblePlanError", "Layout",
     "MeshSpec", "Plan", "abstract_params_for",
-    "calibration_from_records", "default_rules_for", "evaluate_layout",
+    "calibration_from_comm_records", "calibration_from_records",
+    "default_rules_for", "evaluate_layout",
     "gpt_abstract_params", "gpt_moe_abstract_params", "plan",
 ]

@@ -312,6 +312,47 @@ def calibration_from_records(records):
     return float(min(hi, max(lo, np.median(ratios))))
 
 
+def calibration_from_comm_records(records):
+    """Per-collective cost corrections from mesh-observatory records
+    (telemetry/comm_obs via tools/commlab): for each op,
+    median(measured time_ms / analytic predicted_ms) over
+    kind=commbench measurement records carrying both — the comm
+    sibling of `calibration_from_records`. The resulting {op: factor}
+    dict feeds `estimate_layout_cost(comm_calibration=...)`, scaling
+    that collective's terms: a mesh measuring psum at half the
+    analytic ICI bandwidth prices every allreduce term at 2x. Each
+    factor is clamped to the same sanity band as the HBM ratio (one
+    corrupt record must not flip a ranking by 10x); ops with no
+    qualifying record are absent — the cost model defaults them to
+    1.0 (analytic). Returns {} when nothing qualifies."""
+    ratios = {}
+    for rec in records or ():
+        if not isinstance(rec, dict) or rec.get("kind") != "commbench":
+            continue
+        if rec.get("event") not in (None, "measure"):
+            continue   # db_update echoes would double-count their rows
+        op = rec.get("op")
+        measured = rec.get("time_ms")
+        predicted = rec.get("predicted_ms")
+        if op and isinstance(measured, (int, float)) and measured > 0 \
+                and isinstance(predicted, (int, float)) and predicted > 0:
+            ratios.setdefault(str(op), []).append(
+                float(measured) / float(predicted))
+    lo, hi = _CALIBRATION_BAND
+    return {op: float(min(hi, max(lo, np.median(rs))))
+            for op, rs in sorted(ratios.items())}
+
+
+def _resolve_comm_calibration(comm_calibration):
+    """{op: factor} from either an explicit dict or an iterable of
+    commbench records; {} (fully analytic) when None."""
+    if comm_calibration is None:
+        return {}
+    if isinstance(comm_calibration, dict):
+        return {str(k): float(v) for k, v in comm_calibration.items()}
+    return calibration_from_comm_records(comm_calibration)
+
+
 # ---------------------------------------------------------------------------
 # proxy trace: ONE dimension-reduced jaxpr, shared by every candidate
 # ---------------------------------------------------------------------------
@@ -396,7 +437,8 @@ def _resolve_tagged(named, resolved):
 
 
 def _evaluate(cfg, layout, chip, budget, rules, tagged,
-              calibration_ratio, verify, dp_over_dcn, global_batch):
+              calibration_ratio, verify, dp_over_dcn, global_batch,
+              comm_calibration=None):
     """Run one layout through memory accounting, the sharding-lint
     battery and the cost model. Returns a Candidate (never raises on a
     bad layout — rejection is data). `global_batch` (sequences per
@@ -455,7 +497,8 @@ def _evaluate(cfg, layout, chip, budget, rules, tagged,
         cfg, chip=chip, n_params=cand.memory.params, dp=layout.dp,
         pp=layout.pp, mp=layout.mp, sp=layout.sp, ep=layout.ep,
         zero_stage=layout.zero_stage, micro_batch=layout.micro_batch,
-        num_micro=num_micro, dp_over_dcn=dp_over_dcn)
+        num_micro=num_micro, dp_over_dcn=dp_over_dcn,
+        comm_calibration=comm_calibration)
     # only ERROR-severity findings reject: warnings (e.g. an SH208
     # dead rule, which is a layout-INDEPENDENT property of the rule
     # set) stay attached to the candidate — rejecting every layout
@@ -472,7 +515,8 @@ def _evaluate(cfg, layout, chip, budget, rules, tagged,
 def evaluate_layout(model_cfg, layout, chip="v5p", hbm_budget=None,
                     headroom=0.8, rules=None, calibration=None,
                     verify="sharding", dp_over_dcn=False,
-                    global_batch=None, param_dtype=np.float32):
+                    global_batch=None, param_dtype=np.float32,
+                    comm_calibration=None):
     """Evaluate ONE explicit layout through the same battery plan()
     runs — how a hand-written spec gets compared against the planner's
     pick (the parity tests), and how an existing run's layout gets
@@ -490,7 +534,9 @@ def evaluate_layout(model_cfg, layout, chip="v5p", hbm_budget=None,
         global_batch = layout.n_chips
     return _evaluate(model_cfg, layout, chip, budget, rules, tagged,
                      float(ratio or 1.0), verify, dp_over_dcn,
-                     global_batch)
+                     global_batch,
+                     comm_calibration=_resolve_comm_calibration(
+                         comm_calibration))
 
 
 # ---------------------------------------------------------------------------
@@ -512,6 +558,7 @@ class Plan:
     candidates: list
     calibration: float = 1.0
     verify: dict = field(default_factory=dict)
+    comm_calibration: dict = field(default_factory=dict)
 
     @property
     def chosen(self):
@@ -572,7 +619,9 @@ class Plan:
             cost_step_s=float(self.cost.get("step_time_s", 0.0)),
             hbm_budget_bytes=int(self.hbm_budget),
             calibration=float(self.calibration),
-            verify=dict(self.verify))
+            verify=dict(self.verify),
+            **({"comm_calibration": dict(self.comm_calibration)}
+               if self.comm_calibration else {}))
 
     def to_dict(self):
         return {
@@ -580,6 +629,7 @@ class Plan:
             "n_chips": int(self.n_chips),
             "hbm_budget_bytes": int(self.hbm_budget),
             "calibration": float(self.calibration),
+            "comm_calibration": dict(self.comm_calibration),
             "chosen": self.layout.to_dict(),
             "projected_hbm_bytes": int(self.projected_hbm_bytes),
             "cost": {k: (float(v) if isinstance(v, float) else v)
@@ -690,7 +740,7 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
          max_mp=8, remat=True, headroom=0.8, verify="full",
          calibration=None, rules=None, model_name=None,
          dp_over_dcn=False, global_batch=None, cost_slack=0.10,
-         param_dtype=np.float32):
+         param_dtype=np.float32, comm_calibration=None):
     """Search dp x fsdp(zero) x tp x pp x sp x ep layouts for
     `model_cfg` on `mesh_shape` chips of `chip`, and return the
     cheapest candidate that passes the full Graph Doctor battery with
@@ -713,6 +763,12 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
                 records (`calibration_from_records`) — measured
                 memory_analysis() bytes over projected, scaling every
                 candidate's HBM projection.
+    comm_calibration: {op: factor} dict, or an iterable of
+                mesh-observatory commbench records
+                (`calibration_from_comm_records`) — measured collective
+                time over the analytic prediction, scaling each
+                candidate's per-collective cost terms. The comm
+                sibling of `calibration`.
     global_batch: sequences per step every candidate is costed at
                 (default: one per chip) — the fixed unit of work that
                 makes high-dp and high-pp layouts comparable.
@@ -732,6 +788,7 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
     ratio = calibration if isinstance(calibration, (int, float)) \
         else calibration_from_records(calibration)
     ratio = float(ratio or 1.0)
+    comm_cal = _resolve_comm_calibration(comm_calibration)
     named = abstract_params_for(model_cfg, dtype=param_dtype)
     tagged = _resolve_tagged(named, match_partition_rules(rules, named))
     if model_name is None:
@@ -750,7 +807,8 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
     if global_batch is None:
         global_batch = n
     candidates = [_evaluate(model_cfg, lo, chip, budget, rules, tagged,
-                            ratio, verify, dp_over_dcn, global_batch)
+                            ratio, verify, dp_over_dcn, global_batch,
+                            comm_calibration=comm_cal)
                   for lo in layouts]
     feasible = sorted((c for c in candidates if c.feasible),
                       key=Candidate.sort_key)
@@ -794,7 +852,7 @@ def plan(model_cfg, mesh_shape=None, hbm_budget=None, chip="v5p", *,
     return Plan(model=model_name, chip=chip, n_chips=n,
                 hbm_budget=budget, layout=chosen.layout, rules=rules,
                 candidates=candidates, calibration=ratio,
-                verify=verify_info)
+                verify=verify_info, comm_calibration=comm_cal)
 
 
 def _iter_all(jaxpr):

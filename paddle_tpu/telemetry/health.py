@@ -102,6 +102,23 @@ class HealthConfig:
                       the roofline floor: the KN503 counts the
                       prediction rides on are inflated). Latched per
                       kernel.
+    comm_bw_tol       multiplicative tolerance between a commbench
+                      record's measured time_ms and its best-known DB
+                      latency db_ms (telemetry/comm_obs via
+                      tools/comm_db.json): `comm_bw_degraded` fires when
+                      measured exceeds (1+tol) x db_ms — ONE-SIDED,
+                      faster than the DB is good news the next
+                      --update-db rolls in. Latched per op. Records
+                      without db_ms (flag off, or no DB row for the
+                      key) are exempt: no reference, no jurisdiction.
+    straggler_rel     per-rank step-boundary skew rule: a rank whose
+                      step_ms exceeds the step's fastest rank by this
+                      relative fraction ...
+    straggler_abs_ms  ... AND by at least this many absolute ms fires
+                      `straggler` (latched per rank; silent when only
+                      one rank reports — no skew to judge). A slow rank
+                      holds every collective barrier open for the whole
+                      mesh, which is why this lives with the comm rules.
     ckpt_stall_s      a kind=ckpt commit record whose save_ms exceeds
                       this many seconds fires `checkpoint_stall`
                       (resilience.CheckpointManager records)
@@ -125,7 +142,8 @@ class HealthConfig:
                  z_loss=8.0, z_grad=8.0, z_step_time=8.0,
                  rel_step_time=1.5, storm_compiles=5, storm_window_steps=32,
                  hbm_drift_tol=0.15, flops_drift_tol=0.25,
-                 kernel_drift_tol=3.0,
+                 kernel_drift_tol=3.0, comm_bw_tol=1.0,
+                 straggler_rel=0.5, straggler_abs_ms=10.0,
                  ckpt_stall_s=300.0, tail_cause_frac=0.6,
                  tail_cause_count=4, hang_deadline_s=None, dump_dir=".",
                  dump_on_exception=True, ring_size=64):
@@ -147,6 +165,9 @@ class HealthConfig:
         self.hbm_drift_tol = float(hbm_drift_tol)
         self.flops_drift_tol = float(flops_drift_tol)
         self.kernel_drift_tol = float(kernel_drift_tol)
+        self.comm_bw_tol = float(comm_bw_tol)
+        self.straggler_rel = float(straggler_rel)
+        self.straggler_abs_ms = float(straggler_abs_ms)
         self.ckpt_stall_s = float(ckpt_stall_s)
         self.tail_cause_frac = float(tail_cause_frac)
         self.tail_cause_count = int(tail_cause_count)
@@ -259,6 +280,21 @@ class AnomalyDetector:
     - checkpoint_stall     a ckpt commit whose save_ms exceeds
                            ckpt_stall_s — saves that slow eat the
                            preemption grace window
+    - comm_bw_degraded     mesh-observatory records (kind='commbench',
+                           telemetry/comm_obs via tools/commlab): a
+                           measured collective more than (1+comm_bw_tol)x
+                           SLOWER than its best-known DB latency db_ms.
+                           One-sided + latched per op; records without
+                           db_ms are exempt (no DB reference riding the
+                           record — flag off or no row for the key)
+    - straggler            per-rank step-boundary skew over step records
+                           from >= 2 ranks: a rank whose step_ms exceeds
+                           the step's fastest rank by straggler_rel AND
+                           straggler_abs_ms — it is holding every
+                           collective barrier open for the mesh. Latched
+                           per rank; compile steps exempt (a recompiling
+                           rank is legitimately slow); silent with one
+                           rank reporting
     - tail_latency         request-trace records (kind='reqtrace',
                            telemetry.reqtrace): tail_cause_count
                            requests dominated (>= tail_cause_frac of
@@ -285,6 +321,7 @@ class AnomalyDetector:
         self._drift_latched = set()   # (kind, fn) already flagged
         self._tail_counts = {}        # cause -> dominated-request count
         self._tail_latched = set()    # causes already paged
+        self._step_ranks = {}         # step -> {rank: step_ms} (skew)
         self.anomalies = []
         self._n = 0
 
@@ -334,8 +371,21 @@ class AnomalyDetector:
             found = self._observe_kernelbench(rec)
             self.anomalies.extend(found)
             return found
+        if rec.get("kind") == "commbench":
+            found = self._observe_commbench(rec)
+            self.anomalies.extend(found)
+            return found
         step = rec.get("step", self._n - 1)
         found = []
+
+        # straggler first: per-rank skew is judged on the raw step
+        # boundary, independently of what the z-rules think of the
+        # value; compile steps are exempt like step_time_regression
+        # (a recompiling rank is legitimately slow)
+        if _finite(rec.get("step_ms")) and rec.get("rank") is not None \
+                and not rec.get("compile_ms"):
+            found.extend(self._observe_straggler(
+                step, int(rec["rank"]), float(rec["step_ms"])))
 
         # hard NaN/Inf first: a poisoned step must not feed the windows
         nan_n = rec.get("nan_count") or 0
@@ -519,6 +569,86 @@ class AnomalyDetector:
                 f"–{band:.2f}x) — the KN503 counts or the peak tables "
                 "no longer describe this kernel",
                 expected=predicted, z=round(ratio, 3)))
+        return found
+
+    def _observe_commbench(self, rec):
+        """The comm_bw_degraded rule over one mesh-observatory
+        measurement record (telemetry/comm_obs via tools/commlab):
+        measured time_ms vs the best-known DB latency db_ms riding ON
+        the record — the same reference in flight and in offline replay
+        (tools/healthwatch.py, commlab --selfcheck), so they agree.
+        ONE-SIDED: only slower-than-(1+comm_bw_tol)x-the-DB fires;
+        faster is good news the next --update-db rolls into the DB.
+        Latched per op (a sweep measures one op at many payloads — one
+        page, not N) and re-armed by an in-band measurement. Records
+        without db_ms (PADDLE_TPU_COMM_DB off, or no row for this key)
+        are exempt: no reference, no jurisdiction."""
+        c = self.config
+        found = []
+        op = rec.get("op", "?")
+        measured = rec.get("time_ms")
+        reference = rec.get("db_ms")
+        if not isinstance(measured, (int, float)) or measured <= 0 \
+                or not isinstance(reference, (int, float)) \
+                or reference <= 0:
+            return found
+        ratio = float(measured) / float(reference)
+        band = 1.0 + c.comm_bw_tol
+        if ratio <= band:
+            self._drift_latched.discard(("comm_bw_degraded", op))
+        elif ("comm_bw_degraded", op) not in self._drift_latched:
+            self._drift_latched.add(("comm_bw_degraded", op))
+            found.append(Anomaly(
+                "comm_bw_degraded", rec.get("step", self._n - 1),
+                float(measured),
+                f"{op} over axis {rec.get('axis', '?')!r} "
+                f"(n={rec.get('axis_size', '?')}, "
+                f"{rec.get('payload_bytes', '?')} B): measured "
+                f"{float(measured):.3f} ms is {ratio:.1f}x slower than "
+                f"the best-known {float(reference):.3f} ms "
+                f"(band {band:.2f}x) — an ICI link or a peer is "
+                "degraded, or the DB row no longer describes this mesh",
+                expected=reference, z=round(ratio, 3)))
+        return found
+
+    def _observe_straggler(self, step, rank, step_ms):
+        """Per-rank step-boundary skew: collect step_ms by rank per
+        step, judge every rank of the step against its fastest — a rank
+        persistently past BOTH the relative and absolute bands is
+        holding every collective barrier open for the whole mesh.
+        Latched per rank (one page per straggling host, not one per
+        step) and re-armed when the rank comes back in band. With one
+        rank reporting there is no skew to judge — silent."""
+        c = self.config
+        ranks = self._step_ranks.setdefault(step, {})
+        ranks[rank] = step_ms
+        # settle old steps: ranks report a step at most a few steps
+        # apart (the skew being measured IS that gap), so anything 8+
+        # steps behind the newest is closed bookkeeping
+        if len(self._step_ranks) > 8:
+            for s in [s for s in self._step_ranks if s < step - 8]:
+                del self._step_ranks[s]
+        found = []
+        if len(ranks) < 2:
+            return found
+        fastest = min(ranks.values())
+        for r, ms in sorted(ranks.items()):
+            slow = ms > fastest * (1.0 + c.straggler_rel) \
+                and (ms - fastest) >= c.straggler_abs_ms
+            if not slow:
+                self._drift_latched.discard(("straggler", r))
+            elif ("straggler", r) not in self._drift_latched:
+                self._drift_latched.add(("straggler", r))
+                found.append(Anomaly(
+                    "straggler", step, float(ms),
+                    f"rank {r}: step {step} took {ms:.1f} ms vs the "
+                    f"fastest rank's {fastest:.1f} ms "
+                    f"(+{ms - fastest:.1f} ms; threshold "
+                    f"+{c.straggler_rel * 100:.0f}% and >= "
+                    f"{c.straggler_abs_ms:.0f} ms) — every collective "
+                    "barrier waits for this rank",
+                    expected=fastest,
+                    z=round(ms / max(fastest, 1e-9), 3)))
         return found
 
     def _observe_ckpt(self, rec):

@@ -413,7 +413,13 @@ class TelemetryRecorder:
                                peak_flops=self.peak_flops,
                                n_devices=self.n_devices)
         mem_bytes = self._live_bytes() if self.track_memory else None
-        coll = self._collect_collectives(win.span_start)
+        coll, comm_ms = self._collect_collectives(win.span_start)
+        # compute-vs-communication decomposition: the wall-time
+        # collective total and its bounded share of the step
+        # (telemetry/comm_obs — validated by sink + trace_check)
+        step_ms_total = step_s * 1000.0
+        comm_frac = min(1.0, comm_ms / step_ms_total) \
+            if step_ms_total > 0 else 0.0
 
         # an external step source (StepTimer) reports its OWN AOT cache
         # counters; they override the recorder's listener-derived ones
@@ -436,7 +442,9 @@ class TelemetryRecorder:
             compile_ms=compile_ms, rank=self.rank, loss=loss_val,
             tokens_per_sec=tokens_per_sec, mfu=mfu_val, mem_bytes=mem_bytes,
             cache_hits=cache_hits, cache_misses=cache_misses,
-            collectives=coll, **extra)
+            collectives=coll,
+            comm_ms=comm_ms if coll else None,
+            comm_frac=comm_frac if coll else None, **extra)
         # the whole step is also a span, so the JSONL ledger and the
         # chrome trace describe the same intervals
         self.add_span(f"step {self._step_idx}", win.t0, step_s, cat="step")
@@ -521,12 +529,23 @@ class TelemetryRecorder:
             return None
 
     def _collect_collectives(self, span_start):
-        coll = {}
+        """Aggregate this step's wall-time collective spans into the
+        per-op breakdown + their total, (coll_or_None, comm_ms). Spans
+        tagged traced=true (distributed/collective.py's shard_map
+        primitives) cover TRACE time, not communication wall time —
+        they stay in the chrome trace but never enter the step record's
+        comm attribution."""
+        coll, comm_ms = {}, 0.0
         for sp in self.spans[span_start:]:
-            if sp.get("cat") == "collective":
-                ms, calls = coll.get(sp["name"], (0.0, 0))
-                coll[sp["name"]] = (ms + sp["dur"] * 1000.0, calls + 1)
-        return coll or None
+            if sp.get("cat") != "collective":
+                continue
+            if (sp.get("args") or {}).get("traced"):
+                continue
+            ms, calls = coll.get(sp["name"], (0.0, 0))
+            dur_ms = sp["dur"] * 1000.0
+            coll[sp["name"]] = (ms + dur_ms, calls + 1)
+            comm_ms += dur_ms
+        return coll or None, comm_ms
 
     def export_chrome_tracing(self, path, extra_sources=(), align_on=None):
         """Export this recorder's spans (plus any peer ranks') as one

@@ -40,7 +40,7 @@ STEP_OPTIONAL_KEYS = ("loss", "tokens_per_sec", "mfu", "mem_bytes",
                       "inf_count", "input_wait_ms", "input_queue_depth",
                       "input_bound_frac", "moe_entropy",
                       "moe_dropped_frac", "moe_overflow", "moe_aux_loss",
-                      "moe_num_experts", "extra")
+                      "moe_num_experts", "comm_ms", "comm_frac", "extra")
 # input-pipeline fields (io.prefetch loader health taps: how long the
 # step blocked waiting for its batch, ready-queue depth at fetch, and
 # the EMA input-bound fraction — host-bound vs chip-bound as a number)
@@ -56,6 +56,13 @@ HEALTH_KEYS = ("grad_norm", "update_ratio", "nan_count", "inf_count")
 # load-balancing aux-loss value
 MOE_KEYS = ("moe_entropy", "moe_dropped_frac", "moe_overflow",
             "moe_aux_loss", "moe_num_experts")
+# communication-attribution fields (telemetry/comm_obs + recorder):
+# wall-time collective.* span milliseconds summed over the step
+# (trace-time spans tagged traced=true are excluded) and that sum as a
+# fraction of step_ms in [0, 1] — compute-vs-communication step
+# decomposition as a number; the per-op breakdown stays in
+# 'collectives'
+COMM_KEYS = ("comm_ms", "comm_frac")
 
 # required keys of a compile-event record (telemetry.compile_obs); the
 # optional attachments are hbm (memory_analysis breakdown), cost
@@ -125,9 +132,14 @@ REQTRACE_RECORD_KEYS = ("schema", "kind", "rank", "rid", "outcome",
 # [submit, finish] wall-clock interval — each begins where the previous
 # ended — which is what makes the decomposition invariant (durations
 # sum to e2e_ms) checkable by tools/trace_check.py.
+# `collective` / `transfer` are the multi-chip vocabulary (ROADMAP
+# multi-chip serving item): time inside a cross-chip collective or a
+# host<->device / chip<->chip transfer. They tile like every other
+# kind, so the decomposition invariant is unchanged — a trace carrying
+# them still sums to e2e_ms.
 REQTRACE_SPAN_KINDS = ("queued", "admit", "shed", "prefill_chunk",
                        "decode", "preempt", "cow_fork", "restart_replay",
-                       "finalize")
+                       "finalize", "collective", "transfer")
 # trace outcomes: the four terminal request states plus `shed` (the
 # request never entered the engine; its trace is the admission verdict)
 REQTRACE_OUTCOMES = ("finished", "failed", "cancelled", "expired",
@@ -142,7 +154,8 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      input_queue_depth=None, input_bound_frac=None,
                      moe_entropy=None, moe_dropped_frac=None,
                      moe_overflow=None, moe_aux_loss=None,
-                     moe_num_experts=None, **extra):
+                     moe_num_experts=None, comm_ms=None, comm_frac=None,
+                     **extra):
     """Normalize one step's measurements into the schema dict."""
     rec = {
         "schema": SCHEMA_VERSION,
@@ -197,6 +210,13 @@ def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
         rec["moe_aux_loss"] = round(float(moe_aux_loss), 6)
     if moe_num_experts is not None:
         rec["moe_num_experts"] = int(moe_num_experts)
+    # communication attribution (telemetry/comm_obs): wall-time
+    # collective span sum + its fraction of the step — validated below
+    # and bounded by tools/trace_check.py
+    if comm_ms is not None:
+        rec["comm_ms"] = round(float(comm_ms), 4)
+    if comm_frac is not None:
+        rec["comm_frac"] = round(float(comm_frac), 6)
     if collectives:
         rec["collectives"] = {
             str(k): {"ms": round(float(v[0]), 4), "calls": int(v[1])}
@@ -685,6 +705,92 @@ def make_kernelbench_record(kernel, sig, backend, kernel_ms, rank=0,
     return rec
 
 
+# required keys of a mesh-observatory measurement record
+# (telemetry/comm_obs via tools/commlab.py); optional: compile_ms,
+# wire_bytes, achieved_bw, peak_bw, bw_frac, predicted_ms, db_ms,
+# db_key, medium, n_samples, warmup, event, seed
+COMMBENCH_RECORD_KEYS = ("schema", "kind", "rank", "op", "axis",
+                         "axis_size", "payload_bytes", "backend",
+                         "time_ms")
+
+# the sweep's op vocabulary — the shard_map collectives
+# distributed/collective.py issues (telemetry/comm_obs.SWEEP_OPS)
+COMMBENCH_OPS = ("psum", "all_gather", "reduce_scatter", "all_to_all",
+                 "ppermute")
+
+# what one commbench record may claim to be (cross-checked by
+# tools/trace_check.py: a db_update must reference a measured row)
+COMMBENCH_EVENTS = ("measure", "db_update")
+
+
+def make_commbench_record(op, axis, axis_size, payload_bytes, backend,
+                          time_ms, rank=0, compile_ms=None,
+                          wire_bytes=None, achieved_bw=None, peak_bw=None,
+                          bw_frac=None, predicted_ms=None, db_ms=None,
+                          db_key=None, medium=None, n_samples=None,
+                          warmup=None, event=None, seed=None, **extra):
+    """One measured collective data point as a first-class typed record
+    (kind='commbench') — the communication sibling of kind='kernelbench':
+    the kernel observatory measures what one chip computes, the mesh
+    observatory measures what the mesh moves. `op` + `axis_size` +
+    `payload_bytes` + `backend` reproduce the DB key
+    (telemetry/comm_obs.db_key); `time_ms` is the compile-excluded
+    execute median (compile_ms rides separately); `achieved_bw` /
+    `bw_frac` place it against the planner's `ICI_BW_BY_CHIP` /
+    `DCN_BW_BYTES` peaks; `predicted_ms` is the analytic floor
+    `calibration_from_comm_records` ratios against; `db_ms` is the
+    best-known DB latency the comm_bw_degraded rule judges against
+    (absent when the PADDLE_TPU_COMM_DB flag is off — no reference, no
+    jurisdiction). Non-finite timings become None + an error note, like
+    make_kernelbench_record — a NaN never rides the ledger silently."""
+    def _clean(v):
+        if v is None:
+            return None, False
+        bad = isinstance(v, float) and (v != v or v in (float("inf"),
+                                                        float("-inf")))
+        return (None if bad else float(v)), bad
+
+    time_ms, bad = _clean(time_ms)
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "commbench",
+        "rank": int(rank),
+        "op": str(op),
+        "axis": str(axis),
+        "axis_size": int(axis_size),
+        "payload_bytes": int(payload_bytes),
+        "backend": str(backend),
+        "time_ms": time_ms,
+    }
+    if bad:
+        rec["error"] = "non-finite time_ms"
+    for key, v in (("compile_ms", compile_ms), ("wire_bytes", wire_bytes),
+                   ("achieved_bw", achieved_bw), ("peak_bw", peak_bw),
+                   ("bw_frac", bw_frac), ("predicted_ms", predicted_ms),
+                   ("db_ms", db_ms)):
+        v, bad = _clean(v)
+        if v is not None:
+            rec[key] = v
+        elif bad:
+            rec["error"] = f"non-finite {key}"
+    if db_key is not None:
+        rec["db_key"] = str(db_key)
+    if medium is not None:
+        rec["medium"] = str(medium)
+    if n_samples is not None:
+        rec["n_samples"] = int(n_samples)
+    if warmup is not None:
+        rec["warmup"] = int(warmup)
+    if event is not None:
+        rec["event"] = str(event)
+    if seed is not None:
+        rec["seed"] = int(seed)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 # required keys of an auto-sharding plan record (paddle_tpu.planner);
 # optional: chip, n_chips, projected_hbm_bytes, measured_hbm_bytes,
 # hbm_budget_bytes, cost_step_s, calibration, verify
@@ -1060,6 +1166,47 @@ def validate_step_record(rec):
                             f"(expected one of "
                             f"{list(KERNELBENCH_EVENTS)})")
         return problems
+    if kind == "commbench":
+        for key in COMMBENCH_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"commbench record missing '{key}'")
+        op = rec.get("op")
+        if op is not None and op not in COMMBENCH_OPS:
+            problems.append(f"unknown commbench op {op!r} (expected one "
+                            f"of {list(COMMBENCH_OPS)})")
+        for key in ("time_ms", "compile_ms", "predicted_ms", "db_ms",
+                    "wire_bytes", "achieved_bw", "peak_bw"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
+        if rec.get("time_ms") is None and "error" not in rec:
+            problems.append("commbench record with null time_ms "
+                            "carries no 'error' note")
+        v = rec.get("bw_frac")
+        if v is not None and (not isinstance(v, (int, float))
+                              or v != v or not 0.0 <= v <= 1.0):
+            problems.append(
+                f"'bw_frac' not a bandwidth fraction in [0, 1]: {v!r}")
+        for key in ("axis_size", "payload_bytes"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, int) or v < 1):
+                problems.append(f"'{key}' not a positive int: {v!r}")
+        for key in ("n_samples", "warmup"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative int: {v!r}")
+        m = rec.get("medium")
+        if m is not None and m not in ("ici", "dcn"):
+            problems.append(f"'medium' not 'ici'/'dcn': {m!r}")
+        ev = rec.get("event")
+        if ev is not None and ev not in COMMBENCH_EVENTS:
+            problems.append(f"unknown commbench event {ev!r} "
+                            f"(expected one of "
+                            f"{list(COMMBENCH_EVENTS)})")
+        return problems
     if kind == "plan":
         for key in PLAN_RECORD_KEYS:
             if key not in rec:
@@ -1285,6 +1432,15 @@ def validate_step_record(rec):
             problems.append(f"'{key}' negative: {v!r}")
         if key == "moe_dropped_frac" and v > 1.0:
             problems.append(f"'moe_dropped_frac' above 1.0: {v!r}")
+    for key in COMM_KEYS:
+        v = rec.get(key)
+        if v is None:
+            continue
+        if not isinstance(v, (int, float)) or v != v or v < 0:
+            problems.append(
+                f"'{key}' not a non-negative number: {v!r}")
+        elif key == "comm_frac" and v > 1.0:
+            problems.append(f"'comm_frac' above 1.0: {v!r}")
     return problems
 
 

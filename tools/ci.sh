@@ -103,6 +103,16 @@ JAX_PLATFORMS=cpu python tools/kernellab.py --selfcheck
 # kind=thread_lint records must validate under tools/trace_check.py
 # including the observed-subset-of-static cross-rule
 JAX_PLATFORMS=cpu python tools/threaddoctor.py --selfcheck
+# comm lab gate (tools/commlab.py over telemetry/comm_obs.py), the
+# kernel-lab pattern applied to the mesh: the checked-in degraded
+# specimen (tools/specimens/commbench_degraded.jsonl) must trip the
+# comm_bw_degraded anomaly BY NAME through the real AnomalyDetector
+# while its in-band and reference-free rows stay silent, a clean sweep
+# over every (op, size>1 axis) of the dp=2,mp=4 mesh must validate
+# under trace_check AND pass the comm_audit wire-byte honesty leg
+# (claimed bytes vs a re-trace of the same sweep program), and the
+# comm DB must refuse non-finite rows and round-trip losslessly
+JAX_PLATFORMS=cpu python tools/commlab.py --selfcheck
 
 echo "== [4/10] training health + compile observatory + bench gates =="
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
@@ -170,6 +180,22 @@ JAX_PLATFORMS=cpu python tools/kernellab.py --smoke \
     2>> /tmp/bench_health_ci.err \
     || { tail -40 /tmp/bench_health_ci.err >&2
          echo "FATAL: kernel-lab smoke failed"; exit 1; }
+# comm-lab smoke (tools/commlab.py --smoke): every shard_map collective
+# measured over every size>1 axis of the dp=2,mp=4 mesh at the CPU
+# smoke rungs — compile-excluded median-of-k — with the kind=commbench
+# records gated through trace_check AND the comm_audit wire-byte leg
+# inside the tool (exit 13 on any finding) and its comm.<op>.smoke_ms
+# kind=bench rows appended to the SAME gated file, so bench_gate tracks
+# collective smoke timings record-against-record (direction 'info'
+# until a real-mesh round binds the device) and healthwatch replays the
+# comm_bw_degraded rule over the measurements below (quiet here:
+# PADDLE_TPU_COMM_DB is off in CI, so no DB reference rides the
+# records and the rule has no jurisdiction)
+JAX_PLATFORMS=cpu python tools/commlab.py --smoke \
+    --telemetry /tmp/bench_health_ci.jsonl \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: comm-lab smoke failed"; exit 1; }
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \

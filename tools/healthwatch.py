@@ -16,14 +16,17 @@ exactly what CI gates on. Two modes:
         --expect nan,loss_spike,grad_explosion,step_time_regression
 
 Step records (kind=step) run the rolling-window rules (NaN/Inf, loss
-spike, grad explosion, step-time regression — compile steps exempt);
-phase records (kind=phase, bench.py output) are checked for recorded
-errors and non-finite metrics; checkpoint records (kind=ckpt,
-paddle_tpu.resilience) run the checkpoint_failed / checkpoint_stall
-rules; request-trace records (kind=reqtrace, telemetry.reqtrace) run
-the tail_latency rule — requests dominated by a serving pathology
-(queue wait / preemption / warm restart / CoW) count per cause and
-page past the threshold. Detector knobs (--window, --z-loss, --z-grad,
+spike, grad explosion, step-time regression — compile steps exempt)
+plus the per-rank straggler rule (step-boundary skew across ranks of
+the same step); phase records (kind=phase, bench.py output) are checked
+for recorded errors and non-finite metrics; checkpoint records
+(kind=ckpt, paddle_tpu.resilience) run the checkpoint_failed /
+checkpoint_stall rules; mesh-observatory records (kind=commbench,
+telemetry/comm_obs) run the comm_bw_degraded rule against the DB
+reference riding on the record; request-trace records (kind=reqtrace,
+telemetry.reqtrace) run the tail_latency rule — requests dominated by
+a serving pathology (queue wait / preemption / warm restart / CoW)
+count per cause and page past the threshold. Detector knobs (--window, --z-loss, --z-grad,
 --z-step-time, --min-points, --ckpt-stall-s, --tail-frac,
 --tail-count) mirror HealthConfig.
 
@@ -70,6 +73,13 @@ def analyze_file(path, config):
             # failed saves / corrupt-checkpoint fallbacks / slow commits
             # replay through the same checkpoint_failed/checkpoint_stall
             # rules the in-flight manager runs
+            pass
+        elif kind == "commbench":
+            # mesh-observatory measurements (telemetry/comm_obs via
+            # tools/commlab): replay through the same comm_bw_degraded
+            # rule the in-flight detector runs — the DB reference rides
+            # ON the record (db_ms), so offline replay and production
+            # judge against the identical number
             pass
         elif kind == "reqtrace":
             # per-request serving traces (telemetry.reqtrace): replay

@@ -24,7 +24,8 @@ def check_metrics_jsonl(path):
     """Returns (n_records, n_step_records, n_compile_records,
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
     n_serving_records, n_kernel_records, n_reqtrace_records,
-    n_kernelbench_records, n_thread_lint_records, problems). Positional
+    n_kernelbench_records, n_thread_lint_records, n_commbench_records,
+    problems). Positional
     consumers should
     prefer check_pair's named stats dict — this tuple GROWS when a new
     record kind lands (kerneldoctor's selfcheck was silently broken by
@@ -39,11 +40,12 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: empty "
-                                                        "metrics file "
-                                                        "(0 bytes): no "
-                                                        "step was ever "
-                                                        "recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
+                                                           "empty "
+                                                           "metrics file "
+                                                           "(0 bytes): no "
+                                                           "step was ever "
+                                                           "recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -54,8 +56,8 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
-                                                    f"unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
+                                                       f"unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -72,6 +74,7 @@ def check_metrics_jsonl(path):
     problems += check_reqtrace_records(records, path)
     problems += check_kernelbench_records(records, path)
     problems += check_thread_lint_records(records, path)
+    problems += check_commbench_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -98,9 +101,12 @@ def check_metrics_jsonl(path):
     n_thread_lint = sum(1 for r in records
                         if isinstance(r, dict)
                         and r.get("kind") == "thread_lint")
+    n_commbench = sum(1 for r in records
+                      if isinstance(r, dict)
+                      and r.get("kind") == "commbench")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
             n_elastic, n_serving, n_kernel, n_reqtrace, n_kernelbench,
-            n_thread_lint, problems)
+            n_thread_lint, n_commbench, problems)
 
 
 def check_compile_records(records, path):
@@ -816,6 +822,110 @@ def check_kernelbench_records(records, path):
     return problems
 
 
+# how far achieved_bw / bw_frac / predicted_ms may drift from the
+# values recomputable from their own inputs on the same record
+COMMBENCH_DERIVED_TOL = 0.05
+
+
+def check_commbench_records(records, path):
+    """Cross-rules over mesh-observatory measurement records
+    (kind='commbench', telemetry/comm_obs via tools/commlab.py). The
+    schema basics (non-negative ms, bw_frac in [0, 1], positive
+    axis_size/payload) live in sink.validate_step_record; here the
+    claims that must be recomputable from the record's own fields:
+
+    - achieved_bw must equal wire_bytes / (time_ms / 1e3) within 5% —
+      a bandwidth the ledger cannot reproduce is a doctored row;
+    - bw_frac must equal min(1, achieved_bw / peak_bw) within 5%, and
+      requires BOTH inputs on the record;
+    - predicted_ms must equal wire_bytes / peak_bw * 1e3 within 5% —
+      the analytic floor the calibration ratio divides by must match
+      the peak the record claims to have been priced against;
+    - wire_bytes must lie in (0, 2 x payload_bytes] — no wire-fraction
+      convention (comm_audit: (n-1)/n, full, or ring 2(n-1)/n) moves
+      more than twice the operand;
+    - a db_update event must reference, by db_key, a measured row in
+      the SAME file — the DB may only roll forward from measurements
+      the ledger shows (the kernelbench rule).
+    """
+    problems = []
+    measured_keys = set()
+    for r in records:
+        if isinstance(r, dict) and r.get("kind") == "commbench" \
+                and r.get("event") in (None, "measure") \
+                and r.get("db_key"):
+            measured_keys.add(r["db_key"])
+
+    def _num(v):
+        return isinstance(v, (int, float)) and v == v
+
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "commbench":
+            continue
+        label = f"{rec.get('op')} over {rec.get('axis')!r}"
+        tm, wb = rec.get("time_ms"), rec.get("wire_bytes")
+        abw, pbw = rec.get("achieved_bw"), rec.get("peak_bw")
+        frac, pm = rec.get("bw_frac"), rec.get("predicted_ms")
+        payload = rec.get("payload_bytes")
+        if _num(wb) and isinstance(payload, int) and payload > 0 \
+                and not 0.0 < wb <= 2.0 * payload:
+            problems.append(
+                f"{path}:{i + 1}: commbench {label} claims wire_bytes "
+                f"{wb} outside (0, 2 x payload_bytes {payload}] — no "
+                "wire-fraction convention moves that")
+        if _num(abw):
+            if not _num(tm) or tm <= 0 or not _num(wb) or wb <= 0:
+                problems.append(
+                    f"{path}:{i + 1}: commbench {label} claims "
+                    f"achieved_bw {abw} without positive time_ms and "
+                    "wire_bytes — a bandwidth with no inputs on the "
+                    "ledger")
+            else:
+                want = wb / (tm / 1e3)
+                if abs(abw - want) > COMMBENCH_DERIVED_TOL * want:
+                    problems.append(
+                        f"{path}:{i + 1}: commbench {label} achieved_bw "
+                        f"{abw:.4g} does not match wire_bytes/"
+                        f"(time_ms/1e3) = {want:.4g} — the claim and "
+                        "its inputs disagree")
+        if _num(frac):
+            if not _num(abw) or not _num(pbw) or pbw <= 0:
+                problems.append(
+                    f"{path}:{i + 1}: commbench {label} claims bw_frac "
+                    f"{frac} without achieved_bw and peak_bw — a "
+                    "fraction with no numerator or denominator")
+            else:
+                want = min(1.0, abw / pbw)
+                if abs(frac - want) > COMMBENCH_DERIVED_TOL \
+                        * max(want, 1e-9):
+                    problems.append(
+                        f"{path}:{i + 1}: commbench {label} bw_frac "
+                        f"{frac:.4g} does not match min(1, achieved/"
+                        f"peak) = {want:.4g}")
+        if _num(pm) and _num(wb) and _num(pbw) and pbw > 0:
+            want = wb / pbw * 1e3
+            if want > 0 and abs(pm - want) > COMMBENCH_DERIVED_TOL * want:
+                problems.append(
+                    f"{path}:{i + 1}: commbench {label} predicted_ms "
+                    f"{pm:.4g} does not match wire_bytes/peak_bw = "
+                    f"{want:.4g} — the analytic floor and the peak it "
+                    "claims disagree")
+        if rec.get("event") == "db_update":
+            key = rec.get("db_key")
+            if not key:
+                problems.append(
+                    f"{path}:{i + 1}: commbench db_update for {label} "
+                    "carries no db_key — an update that references "
+                    "nothing")
+            elif key not in measured_keys:
+                problems.append(
+                    f"{path}:{i + 1}: commbench db_update references "
+                    f"db_key {key!r} but no measured record in this "
+                    "file carries it — the DB may only roll forward "
+                    "from measurements the ledger shows")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -855,7 +965,7 @@ def check_pair(jsonl_path, trace_path=None):
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
      n_serving, n_kernel, n_reqtrace, n_kernelbench, n_thread_lint,
-     problems) = check_metrics_jsonl(jsonl_path)
+     n_commbench, problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
@@ -863,6 +973,7 @@ def check_pair(jsonl_path, trace_path=None):
              "n_kernel": n_kernel, "n_reqtrace": n_reqtrace,
              "n_kernelbench": n_kernelbench,
              "n_thread_lint": n_thread_lint,
+             "n_commbench": n_commbench,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -921,6 +1032,8 @@ def main(argv):
         msg += f" ({stats['n_kernelbench']} kernel measurements)"
     if stats.get("n_thread_lint"):
         msg += f" ({stats['n_thread_lint']} thread-lint records)"
+    if stats.get("n_commbench"):
+        msg += f" ({stats['n_commbench']} collective measurements)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
