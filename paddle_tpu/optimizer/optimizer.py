@@ -21,6 +21,28 @@ from ..core import autograd
 from .lr import LRScheduler
 
 
+def _param_arrays(opt):
+    """mem_obs provider: the CURRENT device arrays of this optimizer's
+    parameter list (queried at snapshot time, never cached)."""
+    out = []
+    for p in opt._parameter_list or ():
+        v = getattr(p, "_value", None)
+        if v is not None and hasattr(v, "nbytes"):
+            out.append(v)
+    return out
+
+
+def _state_arrays(opt):
+    """mem_obs provider: every device array in the per-param state
+    dicts (moments, accumulators, fp32 masters)."""
+    out = []
+    for st in opt._states.values():
+        for v in st.values():
+            if hasattr(v, "nbytes") and hasattr(v, "dtype"):
+                out.append(v)
+    return out
+
+
 class L2Decay:
     def __init__(self, coeff=0.0):
         self.coeff = float(coeff)
@@ -68,6 +90,22 @@ class Optimizer:
         # multi_precision / amp O2): subclasses that accept the knob set
         # this True; base default off
         self._multi_precision = False
+        # memory-observatory tagging (telemetry/mem_obs): the live HBM
+        # ledger attributes this optimizer's params and moment arrays
+        # by querying these providers FRESH at each snapshot (step
+        # updates replace the underlying arrays, so identities tagged
+        # once would rot). The registry holds only a weakref to self —
+        # tagging never extends the optimizer's lifetime. Lazy import:
+        # the telemetry package init must not become an optimizer
+        # import-time dependency.
+        try:
+            from ..telemetry import mem_obs
+            mem_obs.register_provider(
+                "optimizer.params", "params", self, _param_arrays)
+            mem_obs.register_provider(
+                "optimizer.state", "opt_state", self, _state_arrays)
+        except Exception:
+            pass
 
     # ---- lr -------------------------------------------------------------
     def get_lr(self):

@@ -47,7 +47,8 @@ from .kv_cache import (  # noqa: F401
 from .resilience import (  # noqa: F401
     AdmissionController, Deadlines, DeadlineExceededError,
     EngineDeadError, EngineDrainingError, EngineStoppedError,
-    QueueFullError, RequestCancelledError, ServingError, ShedError)
+    MemoryPressureError, QueueFullError, RequestCancelledError,
+    ServingError, ShedError)
 from .scheduler import (  # noqa: F401
     Request, RequestHandle, SamplingParams, Scheduler)
 from .engine import EngineConfig, ServingEngine  # noqa: F401
@@ -59,6 +60,7 @@ __all__ = [
     "RequestHandle", "SamplingParams", "Scheduler", "EngineConfig",
     "ServingEngine", "ServingHTTPServer",
     "AdmissionController", "Deadlines", "ServingError", "ShedError",
-    "QueueFullError", "EngineDrainingError", "EngineStoppedError",
+    "QueueFullError", "MemoryPressureError", "EngineDrainingError",
+    "EngineStoppedError",
     "EngineDeadError", "RequestCancelledError", "DeadlineExceededError",
 ]

@@ -50,13 +50,16 @@ from ..generation import _cast_params
 from ..jit import bind_tensors
 from ..ops.pallas_decode import flash_prefill_chunk, paged_decode_attention
 from ..resilience.retry import classify_failure
+from ..telemetry.mem_obs import (MemoryObservatory, is_oom,
+                                 register_provider)
 from ..telemetry.recorder import span as _telemetry_span
 from ..telemetry.reqtrace import RequestTracer
 from .kv_cache import NULL_BLOCK, BlockPool, PagedKVCache, PrefixIndex
 from .resilience import (AdmissionController, DeadlineExceededError,
                          EngineDeadError, EngineDrainingError,
-                         EngineStoppedError, RequestCancelledError,
-                         ShedError, restart_backoff)
+                         EngineStoppedError, MemoryPressureError,
+                         RequestCancelledError, ShedError,
+                         restart_backoff)
 from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, PREFILL,
                         TERMINAL_STATES, RequestHandle, Request,
                         SamplingParams, Scheduler)
@@ -80,7 +83,8 @@ class EngineConfig:
                  weights="native", kv_memory_mb=None, device=None,
                  max_queue=None, max_restarts=3, restart_backoff_s=1.0,
                  enable_prefix_cache=True, enable_tracing=True,
-                 trace_exemplars=32):
+                 trace_exemplars=32, hbm_budget_mb=None,
+                 mem_sample_every=1):
         if weights not in ("native", "wo8"):
             raise ValueError(f"weights must be 'native' or 'wo8', "
                              f"got {weights!r}")
@@ -109,6 +113,11 @@ class EngineConfig:
             else int(max_queue)
         self.max_restarts = int(max_restarts)
         self.restart_backoff_s = float(restart_backoff_s)
+        # memory observatory: a declared HBM budget (None -> no budget,
+        # the observatory still samples but hbm_pressure has no
+        # jurisdiction) and the step cadence of ledger snapshots
+        self.hbm_budget_mb = hbm_budget_mb
+        self.mem_sample_every = max(1, int(mem_sample_every))
 
     @classmethod
     def from_inference_config(cls, config, **overrides):
@@ -249,6 +258,26 @@ class ServingEngine:
         # trace_check cross-rule pins it)
         self._prefix_stats = {"lookups": 0, "hits": 0,  # guarded by: _mu
                               "tokens_saved": 0, "tokens_offered": 0}
+        # memory observatory: live HBM ledger + KV occupancy telemetry
+        # sampled every `mem_sample_every` steps; its headroom gauge is
+        # what submit()'s admission consult reads. Always constructed —
+        # without a declared budget it still ledgers and reconciles,
+        # it just has no hbm_pressure jurisdiction.
+        self.mem_obs = MemoryObservatory(  # guarded by: _mu
+            sink=sink,
+            hbm_budget_bytes=(int(cfg.hbm_budget_mb) * 2 ** 20
+                              if cfg.hbm_budget_mb else None),
+            kv_source=self._kv_accounting,
+            engine=self.engine_id)
+        # a serving process has no optimizer to tag the weights, so
+        # the engine tags its own bound leaves (params + buffers) —
+        # queried fresh each snapshot, so a quantize/device_put swap
+        # is re-attributed automatically
+        register_provider(
+            "engine.weights", "params", self,
+            lambda eng: [p._value for p in eng._bound
+                         if getattr(p, "_value", None) is not None])
+        self._steps = 0                 # guarded by: _mu
         monitor.set_gauge("serving.kv_blocks_total", self.pool.capacity)
         monitor.set_gauge("serving.draining", 0)
         self._update_gauges()
@@ -502,6 +531,7 @@ class ServingEngine:
                     retry_after_s=5.0)
             self.sched.validate(req)        # client error, not load
             try:
+                self._check_mem_headroom()
                 self.admission.admit_or_raise(req, self.sched.waiting)
             except ShedError as e:
                 self._counts["shed"] += 1
@@ -592,6 +622,13 @@ class ServingEngine:
                     self._last_latency_obs = now
             did = self._prefill_one()
             did = self._decode_once() or did
+            self._steps += 1
+            if self._steps % self.cfg.mem_sample_every == 0:
+                try:
+                    self.mem_obs.snapshot(self._steps,
+                                          device=self.cfg.device)
+                except Exception:
+                    pass    # the ledger must never take a step down
             self._update_gauges()
             return did
 
@@ -855,6 +892,15 @@ class ServingEngine:
         kind = classify_failure(exc)
         traceback.print_exc()
         with self._mu:
+            if is_oom(exc):
+                # capture-on-failure: write the postmortem BEFORE the
+                # arena rebuild below frees the evidence (the ledger
+                # walk itself allocates nothing on device)
+                try:
+                    self.mem_obs.capture_postmortem(
+                        msg, step=self._steps, device=self.cfg.device)
+                except Exception:
+                    pass  # forensics must never mask the real failure
             active = [r for r in self.sched.admit_order
                       if r.state not in TERMINAL_STATES]
             if kind == "permanent":
@@ -1170,7 +1216,63 @@ class ServingEngine:
         util = self.pool.utilization()
         monitor.set_gauge("serving.kv_block_utilization", util)
         self.kv_peak_utilization = max(self.kv_peak_utilization, util)
+        headroom = self._mem_headroom_bytes()
+        if headroom is not None:
+            monitor.set_gauge("serving.mem_headroom_bytes", headroom)
         self.refresh_latency_gauges()
+
+    def _kv_accounting(self):     # requires: _mu (called from snapshot)
+        """The memory observatory's `kv_source`: the paged-pool block
+        census (total/held/free/cached — held + free + cached tile the
+        pool's capacity, the trace_check cross-rule pins it) plus the
+        scheduler's cumulative per-priority-class eviction/admission
+        counters the kv_thrash rule turns into windowed rates."""
+        pool, sched = self.pool, self.sched
+        ev = dict(sched.evictions_by_class)
+        adm = dict(sched.admissions_by_class)
+        return {
+            "blocks_total": pool.capacity,
+            "blocks_held": pool.num_used,
+            "blocks_free": pool.num_free,
+            "blocks_cached": pool.num_cached,
+            "evictions": sum(ev.values()),
+            "admissions": sum(adm.values()),
+            "evictions_by_class": ev,
+            "admissions_by_class": adm,
+        }
+
+    def _mem_headroom_bytes(self):     # requires: _mu
+        """Bytes the engine believes it can still allocate. Ledger
+        headroom (declared budget minus measured live total) when the
+        observatory has both; otherwise the KV pool's free capacity in
+        bytes — an always-available floor, so the gauge exists even
+        without a declared budget."""
+        h = self.mem_obs.headroom_bytes()
+        if h is not None:
+            return h
+        mcfg = self.model.config
+        per_block = (2 * mcfg.num_layers * self.block_size * self.hidden
+                     * jnp.dtype(self._compute_dtype).itemsize)
+        return self.pool.num_free * per_block
+
+    def _check_mem_headroom(self):     # requires: _mu
+        """submit()'s admission consult: with a declared HBM budget and
+        a measured ledger showing it fully consumed, shed at the door
+        (MemoryPressureError -> 429 + Retry-After) instead of admitting
+        work into an allocation failure mid-decode. Without a budget or
+        before the first snapshot there is no verdict to give —
+        admission proceeds."""
+        if self.mem_obs.hbm_budget_bytes is None:
+            return
+        h = self.mem_obs.headroom_bytes()
+        if h is None or h > 0:
+            return
+        monitor.incr("serving.mem_shed")
+        raise MemoryPressureError(
+            f"HBM budget exhausted: ledger shows 0 headroom bytes "
+            f"against the declared "
+            f"{self.mem_obs.hbm_budget_bytes} byte budget",
+            retry_after_s=1.0, queue_depth=len(self.sched.waiting))
 
     # the legacy-gauge <- histogram mapping (compat names kept: every
     # dashboard scraping serving.*_p50/_p99 keeps working; the scrape

@@ -513,6 +513,20 @@ class PagedKVCache:
                        for _ in range(self.num_layers))
         self.v = tuple(jnp.zeros(shape, self.dtype)
                        for _ in range(self.num_layers))
+        # memory-observatory tagging (telemetry/mem_obs): the live HBM
+        # ledger attributes these arenas to the 'kv' bucket by querying
+        # this provider FRESH each snapshot (swap() replaces the
+        # arrays, so identities tagged once would rot). Weakref-owned:
+        # the engine's restart protocol builds a NEW cache and drops
+        # this one — registration must not keep the donated arenas
+        # alive.
+        try:
+            from ..telemetry import mem_obs
+            mem_obs.register_provider(
+                "kv_cache.arenas", "kv", self,
+                lambda cache: list(cache.k) + list(cache.v))
+        except Exception:
+            pass
 
     @property
     def nbytes(self):

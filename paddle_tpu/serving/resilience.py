@@ -34,7 +34,8 @@ scheduler consult it at step boundaries:
 
 __all__ = [
     "PRIORITIES", "Deadlines", "AdmissionController", "ServingError",
-    "ShedError", "QueueFullError", "EngineDrainingError",
+    "ShedError", "QueueFullError", "MemoryPressureError",
+    "EngineDrainingError",
     "EngineStoppedError", "EngineDeadError", "RequestCancelledError",
     "DeadlineExceededError", "expired_reason", "restart_backoff",
 ]
@@ -122,6 +123,15 @@ class QueueFullError(ShedError):
     """The bounded waiting queue is at capacity."""
 
     reason = "queue_full"
+
+
+class MemoryPressureError(ShedError):
+    """The memory observatory's ledger shows the declared HBM budget
+    fully consumed: admitting more work would walk the engine into an
+    allocation failure mid-decode, so the request bounces at the door
+    instead (HTTP 429 + Retry-After, like every other shed)."""
+
+    reason = "mem_pressure"
 
 
 class EngineDrainingError(ServingError):

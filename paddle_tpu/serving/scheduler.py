@@ -292,6 +292,15 @@ class Scheduler:    # guarded by: ServingEngine._mu
         self.running = [None] * self.max_slots
         self.admit_order = []              # running/prefilling, oldest first
         self.preemptions = 0
+        # per-priority-class admission/eviction ledger (telemetry/
+        # mem_obs KV-occupancy accounting; the kv_thrash rule judges
+        # the rates derived from these cumulative counters). An
+        # admission counts each time a request ENTERS prefill —
+        # including recompute-replay re-admissions, which is the point:
+        # a preempt/re-admit ping-pong shows up as both counters
+        # climbing in lockstep
+        self.admissions_by_class = {}
+        self.evictions_by_class = {}
 
     # -- queries ------------------------------------------------------------
     def free_slots(self):
@@ -370,6 +379,9 @@ class Scheduler:    # guarded by: ServingEngine._mu
                     else time.monotonic()
             self.prefilling.append(req)
             self.admit_order.append(req)
+            cls = req.priority_class
+            self.admissions_by_class[cls] = \
+                self.admissions_by_class.get(cls, 0) + 1
             admitted.append(req)
         return admitted
 
@@ -488,6 +500,9 @@ class Scheduler:    # guarded by: ServingEngine._mu
         self.requeue(req)
         req.preemptions += 1
         self.preemptions += 1
+        cls = req.priority_class
+        self.evictions_by_class[cls] = \
+            self.evictions_by_class.get(cls, 0) + 1
         monitor.incr("serving.preemptions")
 
     def note_prefill_done(self, req):

@@ -31,9 +31,16 @@ Entry points:
   cause diff, compiled-HBM breakdown (`memory_analysis()`), cost-model
   cross-checks and a recompile-storm rule; tools/compile_report.py
   renders/replays the JSONL offline.
+- mem_obs.MemoryObservatory — the memory observatory: a live HBM
+  ledger over `jax.live_arrays()` with byte attribution into
+  params/opt_state/kv/workspace/other buckets, KV-pool occupancy
+  telemetry, reconciliation against the compile observatory's static
+  projection, and capture-on-failure OOM postmortems;
+  tools/memwatch.py renders/replays the JSONL offline.
 """
 from . import compile_obs  # noqa: F401
 from . import health  # noqa: F401
+from . import mem_obs  # noqa: F401
 from . import metrics_http  # noqa: F401
 from . import mfu  # noqa: F401
 from . import reqtrace  # noqa: F401
@@ -45,6 +52,8 @@ from .compile_obs import (  # noqa: F401
     CompileObservatory, CompileSignature, RecompileTracker,
     current_observatory, diff_signatures, signature_of)
 from .compile_obs import dispatch as observed_dispatch  # noqa: F401
+from .mem_obs import (  # noqa: F401
+    MemoryObservatory, is_oom, register_provider, snapshot_ledger)
 from .metrics_http import MetricsServer  # noqa: F401
 from .mfu import (  # noqa: F401
     device_peak_flops, model_flops_per_token, train_step_flops)
@@ -56,8 +65,9 @@ from .reqtrace import (  # noqa: F401
     trace_chrome_spans)
 from .sink import (  # noqa: F401
     JsonlSink, export_chrome_tracing, make_bench_record, make_ckpt_record,
-    make_phase_record, make_reqtrace_record, make_serving_record,
-    make_step_record, read_jsonl, validate_step_record)
+    make_memsnap_record, make_phase_record, make_reqtrace_record,
+    make_serving_record, make_step_record, read_jsonl,
+    validate_step_record)
 from .watchdog import HangWatchdog, dump_black_box  # noqa: F401
 
 __all__ = [
@@ -65,6 +75,8 @@ __all__ = [
     "current_recorder", "open_spans", "JsonlSink", "read_jsonl",
     "make_step_record", "make_phase_record", "make_ckpt_record",
     "make_bench_record", "make_serving_record", "make_reqtrace_record",
+    "make_memsnap_record",
+    "MemoryObservatory", "is_oom", "register_provider", "snapshot_ledger",
     "RequestTrace", "RequestTracer", "decompose", "dominant_cause",
     "trace_chrome_spans",
     "validate_step_record", "export_chrome_tracing",
@@ -75,5 +87,5 @@ __all__ = [
     "current_observatory", "diff_signatures", "signature_of",
     "observed_dispatch",
     "mfu", "sink", "health", "watchdog", "metrics_http", "compile_obs",
-    "reqtrace",
+    "reqtrace", "mem_obs",
 ]

@@ -131,6 +131,31 @@ class HealthConfig:
                       dominated by the SAME pathological cause (latched
                       per cause: one page per pathology, not per
                       request)
+    hbm_pressure_frac memory-observatory records (kind='memsnap',
+                      telemetry/mem_obs via tools/memwatch): a ledger
+                      whose total_bytes exceeds this fraction of the
+                      hbm_budget_bytes riding ON the record fires
+                      `hbm_pressure` — ONE-SIDED and latched per
+                      engine/rank. Records without a budget are
+                      exempt: no budget declared, no jurisdiction.
+    kv_thrash_ratio   `kv_thrash` fires when a memsnap record's
+                      kv_eviction_rate exceeds this multiple of its
+                      kv_admission_rate (evicting faster than the pool
+                      admits means the cache is cannibalizing itself)
+                      ...
+    kv_thrash_min_rate ... AND the eviction rate is at least this many
+                      blocks/step — an idle pool evicting a stray
+                      block must not page. Latched per engine/rank;
+                      records without rates (first snapshot — no
+                      window yet) are exempt.
+    mem_reconcile_tol multiplicative tolerance between a memsnap
+                      record's live total_bytes and the compile
+                      observatory's static projected_bytes riding on
+                      the record: `mem_projection_drift` fires when the
+                      ratio leaves [1/(1+tol), 1+tol] — either side
+                      means the static planning numbers no longer
+                      describe what the chip actually holds. Latched
+                      per projection_family.
     hang_deadline_s   arm a HangWatchdog with this deadline (None: off)
     dump_dir          where black-box dumps go ('.' default)
     dump_on_exception fire the black-box dump when an exception escapes
@@ -145,8 +170,10 @@ class HealthConfig:
                  kernel_drift_tol=3.0, comm_bw_tol=1.0,
                  straggler_rel=0.5, straggler_abs_ms=10.0,
                  ckpt_stall_s=300.0, tail_cause_frac=0.6,
-                 tail_cause_count=4, hang_deadline_s=None, dump_dir=".",
-                 dump_on_exception=True, ring_size=64):
+                 tail_cause_count=4, hbm_pressure_frac=0.92,
+                 kv_thrash_ratio=2.0, kv_thrash_min_rate=1.0,
+                 mem_reconcile_tol=0.25, hang_deadline_s=None,
+                 dump_dir=".", dump_on_exception=True, ring_size=64):
         if action not in _ACTIONS:
             raise ValueError(f"health action must be one of {_ACTIONS}, "
                              f"got {action!r}")
@@ -171,6 +198,10 @@ class HealthConfig:
         self.ckpt_stall_s = float(ckpt_stall_s)
         self.tail_cause_frac = float(tail_cause_frac)
         self.tail_cause_count = int(tail_cause_count)
+        self.hbm_pressure_frac = float(hbm_pressure_frac)
+        self.kv_thrash_ratio = float(kv_thrash_ratio)
+        self.kv_thrash_min_rate = float(kv_thrash_min_rate)
+        self.mem_reconcile_tol = float(mem_reconcile_tol)
         self.hang_deadline_s = hang_deadline_s
         self.dump_dir = dump_dir
         self.dump_on_exception = bool(dump_on_exception)
@@ -304,6 +335,28 @@ class AnomalyDetector:
                            dominating is the work the user asked for.
                            Latched per cause so one pathology pages
                            once, not once per request
+    - hbm_pressure         memory-observatory records (kind='memsnap',
+                           telemetry/mem_obs via tools/memwatch): a
+                           live ledger whose total_bytes exceeds
+                           hbm_pressure_frac of the hbm_budget_bytes
+                           riding ON the record. One-sided + latched
+                           per engine/rank; records without a budget
+                           are exempt (none declared, no jurisdiction)
+    - kv_thrash            a memsnap record whose kv_eviction_rate
+                           exceeds kv_thrash_ratio x its
+                           kv_admission_rate AND kv_thrash_min_rate
+                           blocks/step — the KV pool is evicting
+                           faster than it admits (the cache is
+                           cannibalizing itself to feed churn).
+                           Latched per engine/rank; first snapshots
+                           (no rate window yet) are exempt
+    - mem_projection_drift a memsnap record whose live total_bytes
+                           leaves the [1/(1+mem_reconcile_tol),
+                           1+mem_reconcile_tol] band around the compile
+                           observatory's static projected_bytes —
+                           either side means the planning numbers no
+                           longer describe the chip. Latched per
+                           projection_family
 
     Clean values enter their windows AFTER judgment, so a spike does not
     vaccinate the window against itself; anomalous values are excluded
@@ -373,6 +426,10 @@ class AnomalyDetector:
             return found
         if rec.get("kind") == "commbench":
             found = self._observe_commbench(rec)
+            self.anomalies.extend(found)
+            return found
+        if rec.get("kind") == "memsnap":
+            found = self._observe_memsnap(rec)
             self.anomalies.extend(found)
             return found
         step = rec.get("step", self._n - 1)
@@ -609,6 +666,91 @@ class AnomalyDetector:
                 f"(band {band:.2f}x) — an ICI link or a peer is "
                 "degraded, or the DB row no longer describes this mesh",
                 expected=reference, z=round(ratio, 3)))
+        return found
+
+    def _observe_memsnap(self, rec):
+        """The hbm_pressure / kv_thrash / mem_projection_drift rules
+        over one memory-observatory ledger record (kind='memsnap',
+        telemetry/mem_obs via tools/memwatch): every reference judged
+        against — the declared budget, the eviction/admission rates,
+        the static projection — rides ON the record, so the in-flight
+        detector and offline replay (tools/healthwatch.py, memwatch
+        --selfcheck) see identical numbers. Records without a
+        reference are exempt per rule: no budget -> no pressure
+        jurisdiction, no rate window yet -> no thrash jurisdiction, no
+        projection -> no drift jurisdiction (the commbench stance).
+        All three latch: pressure/thrash per engine (falling back to
+        rank), drift per projection_family."""
+        c = self.config
+        found = []
+        step = rec.get("step", self._n - 1)
+        engine = rec.get("engine")
+        fam = f"engine{engine}" if engine is not None \
+            else f"rank{rec.get('rank', 0)}"
+        total = rec.get("total_bytes")
+        budget = rec.get("hbm_budget_bytes")
+        if isinstance(total, (int, float)) and total >= 0 \
+                and isinstance(budget, (int, float)) and budget > 0:
+            frac = float(total) / float(budget)
+            key = ("hbm_pressure", fam)
+            if frac <= c.hbm_pressure_frac:
+                self._drift_latched.discard(key)
+            elif key not in self._drift_latched:
+                self._drift_latched.add(key)
+                found.append(Anomaly(
+                    "hbm_pressure", step, float(total),
+                    f"{fam}: live HBM ledger holds "
+                    f"{float(total) / 2**20:.1f} MiB — "
+                    f"{frac * 100:.0f}% of the declared "
+                    f"{float(budget) / 2**20:.1f} MiB budget (band "
+                    f"{c.hbm_pressure_frac * 100:.0f}%) — the next "
+                    "allocation spike is an OOM, shed load or raise "
+                    "the budget",
+                    expected=budget, z=round(frac, 3)))
+        ev = rec.get("kv_eviction_rate")
+        adm = rec.get("kv_admission_rate")
+        if isinstance(ev, (int, float)) and ev >= 0 \
+                and isinstance(adm, (int, float)) and adm >= 0:
+            key = ("kv_thrash", fam)
+            thrash = ev >= c.kv_thrash_min_rate \
+                and ev > c.kv_thrash_ratio * adm
+            if not thrash:
+                self._drift_latched.discard(key)
+            elif key not in self._drift_latched:
+                self._drift_latched.add(key)
+                found.append(Anomaly(
+                    "kv_thrash", step, float(ev),
+                    f"{fam}: KV pool evicting {float(ev):.2f} "
+                    f"blocks/step against {float(adm):.2f} "
+                    f"admitted/step (ratio threshold "
+                    f"{c.kv_thrash_ratio:.1f}x, floor "
+                    f"{c.kv_thrash_min_rate:.1f}/step) — the cache is "
+                    "cannibalizing itself to feed churn; admission is "
+                    "outrunning the block budget",
+                    expected=adm, z=round(ev / max(adm, 1e-9), 3)))
+        proj = rec.get("projected_bytes")
+        pfam = rec.get("projection_family", "default")
+        if isinstance(total, (int, float)) and total > 0 \
+                and isinstance(proj, (int, float)) and proj > 0:
+            ratio = float(total) / float(proj)
+            band = 1.0 + c.mem_reconcile_tol
+            key = ("mem_projection_drift", pfam)
+            if 1.0 / band <= ratio <= band:
+                self._drift_latched.discard(key)
+            elif key not in self._drift_latched:
+                self._drift_latched.add(key)
+                side = f"{ratio:.2f}x above" if ratio > band \
+                    else f"{1.0 / ratio:.2f}x below"
+                found.append(Anomaly(
+                    "mem_projection_drift", step, float(total),
+                    f"{pfam}: live ledger total "
+                    f"{float(total) / 2**20:.1f} MiB is {side} the "
+                    f"static projection "
+                    f"{float(proj) / 2**20:.1f} MiB (band "
+                    f"{1.0 / band:.2f}x–{band:.2f}x) — the compile "
+                    "observatory's planning numbers no longer "
+                    "describe what the chip holds",
+                    expected=proj, z=round(ratio, 3)))
         return found
 
     def _observe_straggler(self, step, rank, step_ms):

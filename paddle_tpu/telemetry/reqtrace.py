@@ -32,8 +32,11 @@ TIMELINE that names its cause:
   (telemetry.health.AnomalyDetector — same rule in flight and in
   offline replays, per the PR-3 pattern), and tests: every span maps to
   one of CAUSES (queue_wait, preemption, restart, prefill, cow_fork,
-  decode, other), with replayed prefill chunks charged to the
-  preemption/restart that forced the recompute rather than to prefill.
+  decode, collective, transfer, other), with replayed prefill chunks
+  charged to the preemption/restart that forced the recompute rather
+  than to prefill. Collective waits and host<->device transfers get
+  their own columns — charging comm time to `other` hid exactly the
+  costs a multi-chip serving mesh needs attributed.
 """
 import heapq
 import itertools
@@ -48,9 +51,14 @@ __all__ = ["RequestTrace", "RequestTracer", "CAUSES",
 
 # the attribution vocabulary: every span kind maps onto exactly one of
 # these buckets (decompose below); "other" absorbs the zero-duration
-# markers (admit/finalize) and anything a newer producer adds
+# markers (admit/finalize) and anything a newer producer adds.
+# collective (cross-chip sync waits) and transfer (host<->device
+# staging) carry their own buckets: they are real work like decode,
+# but work the MESH does — a tail report that lumped them into
+# "other" could not say whether a slow request waited on compute or
+# on the interconnect
 CAUSES = ("queue_wait", "preemption", "restart", "prefill", "cow_fork",
-          "decode", "other")
+          "decode", "collective", "transfer", "other")
 # causes that are a PROBLEM when they dominate a request's latency —
 # decode and prefill are the work the user asked for; these are the
 # serving stack's own mechanisms getting in the way
@@ -311,6 +319,10 @@ def decompose(rec):
             key = "restart"
         elif kind == "shed":
             key = "queue_wait"
+        elif kind == "collective":
+            key = "collective"
+        elif kind == "transfer":
+            key = "transfer"
         else:                        # admit / finalize markers
             key = "other"
         causes[key] += float(dur)

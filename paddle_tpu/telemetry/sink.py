@@ -791,6 +791,128 @@ def make_commbench_record(op, axis, axis_size, payload_bytes, backend,
     return rec
 
 
+# required keys of a memory-observatory ledger record
+# (telemetry/mem_obs via tools/memwatch.py); optional: the attribution
+# buckets, budget/headroom/projection anchors, KV-pool accounting, and
+# the postmortem payload (top_arrays, compile_families)
+MEMSNAP_RECORD_KEYS = ("schema", "kind", "rank", "event", "step",
+                       "total_bytes")
+
+# attribution buckets — every live byte lands in exactly ONE, so
+# tools/trace_check.py can recompute total_bytes from the record's own
+# fields (the reqtrace decomposition stance, applied to HBM)
+MEMSNAP_BUCKETS = ("params_bytes", "opt_state_bytes", "kv_bytes",
+                   "workspace_bytes", "other_bytes")
+
+# what one memsnap record may claim to be: a step-cadence ledger
+# snapshot, or the capture-on-failure POSTMORTEM written when an
+# allocation failed (RESOURCE_EXHAUSTED) — a postmortem must carry an
+# error note and the top-K array listing (validated below), so an OOM
+# is diagnosable offline from the ledger alone
+MEMSNAP_EVENTS = ("snapshot", "postmortem")
+
+
+def make_memsnap_record(event, step, total_bytes, rank=0,
+                        params_bytes=None, opt_state_bytes=None,
+                        kv_bytes=None, workspace_bytes=None,
+                        other_bytes=None, hbm_budget_bytes=None,
+                        headroom_bytes=None, projected_bytes=None,
+                        projection_family=None, n_arrays=None,
+                        kv_blocks_total=None, kv_blocks_held=None,
+                        kv_blocks_free=None, kv_blocks_cached=None,
+                        kv_occupancy=None, kv_cache_share=None,
+                        kv_evictions=None, kv_admissions=None,
+                        kv_eviction_rate=None, kv_admission_rate=None,
+                        evictions_by_class=None, admissions_by_class=None,
+                        engine=None, error=None, top_arrays=None,
+                        compile_families=None, **extra):
+    """One live-HBM ledger snapshot as a first-class typed record
+    (kind='memsnap') — the memory sibling of kind='commbench': the mesh
+    observatory measures what the mesh moves, the memory observatory
+    measures what the chip HOLDS. The bucket fields (MEMSNAP_BUCKETS)
+    partition total_bytes — tools/trace_check.py recomputes the sum;
+    `headroom_bytes` is max(0, hbm_budget_bytes - total_bytes), the
+    admission signal the serving engine gauges; `projected_bytes` is
+    the compile observatory's static memory_analysis() projection the
+    reconcile-drift rule latches against; the kv_* fields snapshot the
+    BlockPool/PrefixIndex accounting (held+free+cached must tile
+    kv_blocks_total) plus the eviction/admission rates the kv_thrash
+    rule judges — all riding ON the record, so healthwatch replay and
+    the in-flight detector see identical numbers. A postmortem event
+    additionally carries `error`, the top-K `top_arrays` by bytes, and
+    the active `compile_families`. Non-finite measurements become None
+    + an error note, like make_commbench_record — a NaN never rides
+    the ledger silently."""
+    def _clean(v):
+        if v is None:
+            return None, False
+        bad = isinstance(v, float) and (v != v or v in (float("inf"),
+                                                        float("-inf")))
+        return (None if bad else float(v)), bad
+
+    total_bytes, bad = _clean(total_bytes)
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "memsnap",
+        "rank": int(rank),
+        "event": str(event),
+        "step": int(step),
+        "total_bytes": None if total_bytes is None else int(total_bytes),
+    }
+    if bad:
+        rec["error"] = "non-finite total_bytes"
+    for key, v in (("params_bytes", params_bytes),
+                   ("opt_state_bytes", opt_state_bytes),
+                   ("kv_bytes", kv_bytes),
+                   ("workspace_bytes", workspace_bytes),
+                   ("other_bytes", other_bytes),
+                   ("hbm_budget_bytes", hbm_budget_bytes),
+                   ("headroom_bytes", headroom_bytes),
+                   ("projected_bytes", projected_bytes)):
+        v, bad = _clean(v)
+        if v is not None:
+            rec[key] = int(v)
+        elif bad:
+            rec["error"] = f"non-finite {key}"
+    for key, v in (("kv_occupancy", kv_occupancy),
+                   ("kv_cache_share", kv_cache_share),
+                   ("kv_eviction_rate", kv_eviction_rate),
+                   ("kv_admission_rate", kv_admission_rate)):
+        v, bad = _clean(v)
+        if v is not None:
+            rec[key] = round(v, 6)
+        elif bad:
+            rec["error"] = f"non-finite {key}"
+    for key, v in (("n_arrays", n_arrays),
+                   ("kv_blocks_total", kv_blocks_total),
+                   ("kv_blocks_held", kv_blocks_held),
+                   ("kv_blocks_free", kv_blocks_free),
+                   ("kv_blocks_cached", kv_blocks_cached),
+                   ("kv_evictions", kv_evictions),
+                   ("kv_admissions", kv_admissions),
+                   ("engine", engine)):
+        if v is not None:
+            rec[key] = int(v)
+    if projection_family is not None:
+        rec["projection_family"] = str(projection_family)
+    if evictions_by_class is not None:
+        rec["evictions_by_class"] = {str(k): int(v) for k, v
+                                     in evictions_by_class.items()}
+    if admissions_by_class is not None:
+        rec["admissions_by_class"] = {str(k): int(v) for k, v
+                                      in admissions_by_class.items()}
+    if error is not None:
+        rec["error"] = str(error)
+    if top_arrays is not None:
+        rec["top_arrays"] = list(top_arrays)
+    if compile_families is not None:
+        rec["compile_families"] = list(compile_families)
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 # required keys of an auto-sharding plan record (paddle_tpu.planner);
 # optional: chip, n_chips, projected_hbm_bytes, measured_hbm_bytes,
 # hbm_budget_bytes, cost_step_s, calibration, verify
@@ -1384,6 +1506,73 @@ def validate_step_record(rec):
                 problems.append(f"'{key}' not a non-negative number: {v!r}")
         if ev == "commit" and "save_ms" not in rec:
             problems.append("ckpt commit record carries no save_ms")
+        return problems
+    if kind == "memsnap":
+        for key in MEMSNAP_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"memsnap record missing '{key}'")
+        ev = rec.get("event")
+        if ev is not None and ev not in MEMSNAP_EVENTS:
+            problems.append(f"unknown memsnap event {ev!r} "
+                            f"(expected one of {list(MEMSNAP_EVENTS)})")
+        for key in ("total_bytes",) + MEMSNAP_BUCKETS + (
+                "hbm_budget_bytes", "headroom_bytes", "projected_bytes",
+                "kv_eviction_rate", "kv_admission_rate"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
+        if rec.get("total_bytes") is None and "error" not in rec:
+            problems.append("memsnap record with null total_bytes "
+                            "carries no 'error' note")
+        for key in ("kv_occupancy", "kv_cache_share"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or not 0.0 <= v <= 1.0):
+                problems.append(
+                    f"'{key}' not a fraction in [0, 1]: {v!r}")
+        for key in ("n_arrays", "kv_blocks_total", "kv_blocks_held",
+                    "kv_blocks_free", "kv_blocks_cached",
+                    "kv_evictions", "kv_admissions"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative int: {v!r}")
+        for key in ("evictions_by_class", "admissions_by_class"):
+            v = rec.get(key)
+            if v is None:
+                continue
+            if not isinstance(v, dict):
+                problems.append(f"'{key}' not a dict: {v!r}")
+            else:
+                for cls, n in v.items():
+                    if not isinstance(n, int) or n < 0:
+                        problems.append(
+                            f"'{key}' count for class {cls!r} not a "
+                            f"non-negative int: {n!r}")
+        if ev == "postmortem":
+            # the forensic contract: an OOM record that cannot say
+            # what failed, or show WHO held the bytes, diagnoses
+            # nothing offline
+            if not str(rec.get("error", "")).strip():
+                problems.append(
+                    "memsnap postmortem carries no error note — a "
+                    "forensic record that cannot say what killed the "
+                    "allocation")
+            ta = rec.get("top_arrays")
+            if not isinstance(ta, list) or not ta:
+                problems.append(
+                    "memsnap postmortem carries no top_arrays listing "
+                    "— an OOM with no suspects named")
+            else:
+                for j, a in enumerate(ta):
+                    if not isinstance(a, dict) or \
+                            not isinstance(a.get("bytes"), int) or \
+                            a["bytes"] < 0:
+                        problems.append(
+                            f"top_arrays[{j}] carries no non-negative "
+                            "'bytes'")
         return problems
     for key in STEP_RECORD_KEYS:
         if key not in rec:

@@ -543,8 +543,8 @@ def test_traced_collective_span_tagged():
 def test_reqtrace_collective_transfer_spans_validate_and_decompose():
     """The span vocabulary admits collective/transfer kinds (multi-chip
     serving: a tp allreduce or a host<->device transfer inside a
-    request's life) and the decomposition invariant still holds — they
-    charge to 'other' and the spans still sum to e2e."""
+    request's life) and the decomposition invariant still holds — each
+    gets its own attribution column and the spans still sum to e2e."""
     from paddle_tpu.telemetry import reqtrace
     spans = [
         {"kind": "queued", "t0_ms": 0.0, "dur_ms": 1.0},
@@ -560,7 +560,9 @@ def test_reqtrace_collective_transfer_spans_validate_and_decompose():
                                     spans=spans, e2e_ms=10.0)
     assert sink.validate_step_record(rec) == []
     causes = reqtrace.decompose(rec)
-    assert causes["other"] == pytest.approx(3.5)   # admit + coll + xfer
+    assert causes["collective"] == pytest.approx(2.0)
+    assert causes["transfer"] == pytest.approx(1.0)
+    assert causes["other"] == pytest.approx(0.5)   # admit only
     assert sum(causes.values()) == pytest.approx(10.0)
     # an off-vocabulary kind is still rejected
     bad = sink.make_reqtrace_record(

@@ -1,0 +1,466 @@
+"""Memory observatory (paddle_tpu/telemetry/mem_obs + serving wiring):
+the live HBM ledger and its provider registry, the step-cadence
+MemoryObservatory with kind=memsnap records, the hbm_pressure /
+kv_thrash / mem_projection_drift health rules replayed over the same
+records, trace_check's memsnap cross-rules, OOM recognition + the
+capture-on-failure postmortem, the serving engine's admission-headroom
+gate (MemoryPressureError), and the BlockPool leak-check
+(assert_quiesced) across every release path the engine has: finish,
+cancel, deadline expiry, eviction, warm restart."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.resilience.retry import tag_transient
+from paddle_tpu.serving import (BlockLeakError, BlockPool, Deadlines,
+                                MemoryPressureError, SamplingParams,
+                                ServingEngine, ShedError)
+from paddle_tpu.telemetry import JsonlSink
+from paddle_tpu.telemetry.health import AnomalyDetector, HealthConfig
+from paddle_tpu.telemetry.mem_obs import (BUCKETS, MemoryObservatory,
+                                          is_oom, register_provider,
+                                          registered_providers,
+                                          snapshot_ledger,
+                                          unregister_provider)
+from paddle_tpu.telemetry.sink import make_memsnap_record
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _tc():
+    sys.path.insert(0, TOOLS)
+    import trace_check
+    return trace_check
+
+
+def _write(tmp_path, name, recs):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    return str(p)
+
+
+def _small_gpt(seed=0):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, max_seq_len=64, dropout=0.0,
+                    use_flash_attention=False)
+    return GPTForPretraining(cfg)
+
+
+# ---------------------------------------------------------------------------
+# the ledger walk + provider registry
+# ---------------------------------------------------------------------------
+
+class _Owner:
+    """Something for a provider to hang off: the registry must hold it
+    by weakref only."""
+
+    def __init__(self, arrs):
+        self.arrs = arrs
+
+
+def test_register_provider_rejects_unknown_bucket():
+    with pytest.raises(ValueError, match="unknown bucket"):
+        register_provider("x", "not_a_bucket", _Owner([]), lambda o: [])
+
+
+def test_ledger_attributes_tagged_arrays_and_partitions():
+    import jax.numpy as jnp
+    a = jnp.ones((1024,), jnp.float32)      # 4096 bytes
+    b = jnp.ones((512,), jnp.float32)       # 2048 bytes
+    owner = _Owner([a, b])
+    key = register_provider("test.params", "params", owner,
+                            lambda o: o.arrs)
+    try:
+        led = snapshot_ledger()
+        assert led["params_bytes"] >= a.nbytes + b.nbytes
+        # the buckets PARTITION the total — trace_check's sum rule
+        assert sum(led[f"{bk}_bytes"] for bk in BUCKETS) \
+            == led["total_bytes"]
+        assert led["n_arrays"] >= 2
+        # top_arrays descend by bytes and carry the bucket attribution
+        tops = led["top_arrays"]
+        assert tops == sorted(tops, key=lambda r: r["bytes"],
+                              reverse=True)
+        assert all(t["bucket"] in BUCKETS for t in tops)
+    finally:
+        unregister_provider(key)
+    # untagged now: the same arrays fall back to workspace
+    led2 = snapshot_ledger()
+    assert led2["params_bytes"] < led["params_bytes"]
+
+
+def test_dead_owner_drops_out_of_the_registry():
+    import jax.numpy as jnp
+    owner = _Owner([jnp.ones((64,), jnp.float32)])
+    key = register_provider("test.kv", "kv", owner, lambda o: o.arrs)
+    assert any(k == key for k, _ in registered_providers())
+    del owner
+    # a dead owner must not pin its arrays: the provider vanishes
+    assert not any(k == key for k, _ in registered_providers())
+    snapshot_ledger()                       # reaps without error
+    unregister_provider(key)                # idempotent on reaped keys
+
+
+def test_broken_provider_cannot_kill_sampling():
+    def boom(owner):
+        raise RuntimeError("provider exploded")
+    owner = _Owner([])
+    key = register_provider("test.bad", "opt_state", owner, boom)
+    try:
+        led = snapshot_ledger()             # must not raise
+        assert led["total_bytes"] >= 0
+    finally:
+        unregister_provider(key)
+
+
+def test_is_oom_recognition():
+    assert is_oom(MemoryError("host allocator"))
+    assert is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 4096 bytes"))
+    assert is_oom(RuntimeError("Out of memory while trying to allocate"))
+    assert not is_oom(ValueError("shape mismatch"))
+    assert not is_oom(RuntimeError("INVALID_ARGUMENT: bad layout"))
+
+
+# ---------------------------------------------------------------------------
+# the observatory: records, gauges, headroom, postmortem
+# ---------------------------------------------------------------------------
+
+def _fake_kv(state):
+    """A kv_source over a mutable accounting dict."""
+    def src():
+        return dict(state)
+    return src
+
+
+def test_observatory_snapshot_record_and_headroom(tmp_path):
+    path = str(tmp_path / "mem.jsonl")
+    sink = JsonlSink(path)
+    kv = {"blocks_total": 16, "blocks_held": 4, "blocks_free": 10,
+          "blocks_cached": 2, "evictions": 0, "admissions": 3,
+          "evictions_by_class": {}, "admissions_by_class": {"normal": 3}}
+    obs = MemoryObservatory(sink=sink, hbm_budget_bytes=1 << 32,
+                            kv_source=_fake_kv(kv),
+                            projection_family="unit", engine=7)
+    assert obs.headroom_bytes() is None     # nothing sampled yet
+    r1 = obs.snapshot(1)
+    kv.update(evictions=2, admissions=5,
+              evictions_by_class={"batch": 2},
+              admissions_by_class={"normal": 5})
+    r2 = obs.snapshot(3)
+    sink.close()
+
+    assert r1["kind"] == "memsnap" and r1["event"] == "snapshot"
+    assert r1["engine"] == 7
+    assert sum(r1[f"{bk}_bytes"] for bk in BUCKETS) == r1["total_bytes"]
+    assert r1["headroom_bytes"] == max(0, (1 << 32) - r1["total_bytes"])
+    assert obs.headroom_bytes() == r2["headroom_bytes"]
+    # KV census rides on the record, occupancy derived from it
+    assert r1["kv_blocks_total"] == 16 and r1["kv_blocks_held"] == 4
+    assert r1["kv_occupancy"] == pytest.approx(6 / 16)
+    assert r1["kv_cache_share"] == pytest.approx(2 / 16)
+    # rates need a window: absent on the first sample, per-step after
+    assert "kv_eviction_rate" not in r1
+    assert r2["kv_eviction_rate"] == pytest.approx(2 / 2)
+    assert r2["kv_admission_rate"] == pytest.approx(2 / 2)
+    # the mem.* gauges mirror the last record
+    assert monitor.get_gauge("mem.total_bytes") == float(
+        r2["total_bytes"])
+    assert monitor.get_gauge("mem.headroom_bytes") == float(
+        r2["headroom_bytes"])
+    # and the file round-trips through the validator + cross-rules
+    problems, stats = _tc().check_pair(path)
+    assert problems == []
+    assert stats["n_memsnap"] == 2
+
+
+def test_observatory_no_budget_means_no_opinion():
+    obs = MemoryObservatory()
+    rec = obs.snapshot(1)
+    assert "hbm_budget_bytes" not in rec
+    assert "headroom_bytes" not in rec
+    assert obs.headroom_bytes() is None     # admission: no opinion
+
+
+def test_postmortem_carries_forensics(tmp_path):
+    path = str(tmp_path / "post.jsonl")
+    sink = JsonlSink(path)
+    obs = MemoryObservatory(sink=sink, hbm_budget_bytes=1 << 30)
+    rec = obs.capture_postmortem(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory"), step=12)
+    sink.close()
+    assert rec["event"] == "postmortem" and rec["step"] == 12
+    assert "RESOURCE_EXHAUSTED" in rec["error"]
+    assert rec["top_arrays"] and all(
+        "bytes" in t for t in rec["top_arrays"])
+    assert isinstance(rec["compile_families"], list)
+    problems, stats = _tc().check_pair(path)
+    assert problems == []
+    assert stats["n_memsnap"] == 1
+
+
+# ---------------------------------------------------------------------------
+# health rules: in-flight == replay (the records carry their references)
+# ---------------------------------------------------------------------------
+
+def _snap(step, total, budget=None, **kw):
+    return make_memsnap_record("snapshot", step, total,
+                               hbm_budget_bytes=budget, **kw)
+
+
+def test_hbm_pressure_fires_and_latches():
+    det = AnomalyDetector(HealthConfig(action="record"))
+    det.observe(_snap(1, 80, budget=100))    # 0.80 < 0.92: quiet
+    det.observe(_snap(2, 93, budget=100))    # 0.93 >= 0.92: fires
+    det.observe(_snap(3, 95, budget=100))    # latched: no repeat page
+    kinds = [a.kind for a in det.anomalies]
+    assert kinds.count("hbm_pressure") == 1
+    # no declared budget -> no jurisdiction, however large the total
+    det2 = AnomalyDetector(HealthConfig(action="record"))
+    det2.observe(_snap(1, 10 ** 15))
+    assert det2.anomalies == []
+
+
+def test_kv_thrash_needs_rate_and_ratio():
+    det = AnomalyDetector(HealthConfig(action="record"))
+    # high ratio but below the absolute rate floor: churn too small
+    det.observe(_snap(1, 10, kv_eviction_rate=0.5,
+                      kv_admission_rate=0.1))
+    assert det.anomalies == []
+    # real churn, evictions dominating admissions: thrash
+    det.observe(_snap(2, 10, kv_eviction_rate=5.0,
+                      kv_admission_rate=1.0))
+    assert [a.kind for a in det.anomalies] == ["kv_thrash"]
+
+
+def test_mem_projection_drift_two_sided_band():
+    cfg = HealthConfig(action="record")      # mem_reconcile_tol=0.25
+    det = AnomalyDetector(cfg)
+    det.observe(_snap(1, 110, projected_bytes=100,
+                      projection_family="f"))          # within 1.25x
+    assert det.anomalies == []
+    det.observe(_snap(2, 200, projected_bytes=100,
+                      projection_family="f"))          # 2x: drifted
+    det.observe(_snap(3, 40, projected_bytes=100,
+                      projection_family="f"))          # latched per fam
+    assert [a.kind for a in det.anomalies] == ["mem_projection_drift"]
+    # no projection on the record -> exempt, not silently compared
+    det2 = AnomalyDetector(cfg)
+    det2.observe(_snap(1, 10 ** 12))
+    assert det2.anomalies == []
+
+
+# ---------------------------------------------------------------------------
+# trace_check cross-rules: the record's claims must recompute
+# ---------------------------------------------------------------------------
+
+def test_trace_check_memsnap_cross_rules(tmp_path):
+    tc = _tc()
+    good = _snap(1, 100, budget=150, params_bytes=60, opt_state_bytes=20,
+                 kv_bytes=10, workspace_bytes=8, other_bytes=2,
+                 headroom_bytes=50, kv_blocks_total=16, kv_blocks_held=10,
+                 kv_blocks_free=4, kv_blocks_cached=2,
+                 kv_occupancy=12 / 16, kv_cache_share=2 / 16)
+    problems, stats = tc.check_pair(_write(tmp_path, "ok.jsonl", [good]))
+    assert problems == []
+    assert stats["n_memsnap"] == 1
+
+    bad_sum = dict(good, params_bytes=61)
+    problems, _ = tc.check_pair(
+        _write(tmp_path, "sum.jsonl", [bad_sum]))
+    assert any("bucket" in p for p in problems)
+
+    bad_headroom = dict(good, headroom_bytes=9)
+    problems, _ = tc.check_pair(
+        _write(tmp_path, "head.jsonl", [bad_headroom]))
+    assert any("headroom" in p for p in problems)
+
+    bad_census = dict(good, kv_blocks_free=5)
+    problems, _ = tc.check_pair(
+        _write(tmp_path, "census.jsonl", [bad_census]))
+    assert any("tile" in p or "census" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# serving-engine wiring: ledger cadence, headroom gate, OOM postmortem
+# ---------------------------------------------------------------------------
+
+def test_engine_emits_validating_ledger(tmp_path):
+    path = str(tmp_path / "serve.jsonl")
+    sink = JsonlSink(path)
+    eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                        prefill_chunk=8, max_model_len=64,
+                        hbm_budget_mb=256, sink=sink)
+    rs = np.random.RandomState(0)
+    h = eng.submit(rs.randint(0, 256, (6,)).tolist(),
+                   SamplingParams(max_new_tokens=4))
+    eng.run_until_idle(max_steps=2000)
+    assert h.status == "finished"
+    sink.close()
+    problems, stats = _tc().check_pair(path)
+    assert problems == []
+    assert stats["n_memsnap"] >= 1
+    last = eng.mem_obs.last
+    # the engine tags its own weights: params never reads as workspace
+    assert last["params_bytes"] > 0
+    # KV census from the live pool rides on every snapshot
+    assert last["kv_blocks_total"] == eng.pool.capacity
+    # the admission gauge is live and equals the observatory's headroom
+    assert monitor.get_gauge("serving.mem_headroom_bytes") \
+        == float(eng.mem_obs.headroom_bytes())
+
+
+def test_engine_sheds_on_exhausted_headroom():
+    eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                        prefill_chunk=8, max_model_len=64,
+                        hbm_budget_mb=1)     # weights alone exceed 1MiB
+    eng.mem_obs.snapshot(0)                  # ledger: headroom 0
+    assert eng.mem_obs.headroom_bytes() == 0
+    before = monitor.get("serving.mem_shed", 0)
+    with pytest.raises(MemoryPressureError) as e:
+        eng.submit(list(range(1, 7)), SamplingParams(max_new_tokens=4))
+    assert isinstance(e.value, ShedError)
+    assert e.value.reason == "mem_pressure"
+    assert e.value.retry_after_s > 0
+    assert monitor.get("serving.mem_shed", 0) == before + 1
+    assert eng._counts["shed"] == 1
+
+
+def test_engine_without_budget_never_mem_sheds():
+    eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                        prefill_chunk=8, max_model_len=64)
+    eng.mem_obs.snapshot(0)
+    h = eng.submit(list(range(1, 7)), SamplingParams(max_new_tokens=2))
+    eng.run_until_idle(max_steps=2000)
+    assert h.status == "finished"
+
+
+def test_engine_oom_writes_postmortem_before_rebuild():
+    eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                        prefill_chunk=8, max_model_len=64,
+                        hbm_budget_mb=256, max_restarts=1,
+                        restart_backoff_s=0.01)
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 9876543210 bytes")
+
+    eng._decode_greedy_jit = boom
+    eng.start()
+    h = eng.submit(list(range(1, 7)), SamplingParams(max_new_tokens=4))
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        h.result(timeout=120)
+    eng.stop()
+    posts = [r for r in eng.mem_obs.records
+             if r.get("event") == "postmortem"]
+    assert posts, "OOM step left no forensic record"
+    assert "RESOURCE_EXHAUSTED" in posts[-1]["error"]
+    assert posts[-1]["top_arrays"]
+
+
+# ---------------------------------------------------------------------------
+# BlockPool leak-check: assert_quiesced across every release path
+# ---------------------------------------------------------------------------
+
+class TestBlockPoolLeakCheck:
+    """Every way a request can leave the engine must put its blocks
+    back: finish, cancel, deadline expiry, eviction, warm restart.
+    assert_quiesced is the witness — held blocks after drain are a
+    leak, cached blocks at refcount 0 are not."""
+
+    def test_raises_on_a_genuinely_held_block(self):
+        pool = BlockPool(8)
+        blocks = pool.alloc(1, owner="leaker")
+        with pytest.raises(BlockLeakError, match="leaker"):
+            pool.assert_quiesced()
+        pool.free(blocks)
+        pool.assert_quiesced()
+
+    def test_finish_path(self):
+        eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                            prefill_chunk=8, max_model_len=64)
+        rs = np.random.RandomState(0)
+        hs = [eng.submit(rs.randint(0, 256, (n,)).tolist(),
+                         SamplingParams(max_new_tokens=4))
+              for n in (6, 9)]
+        eng.run_until_idle(max_steps=2000)
+        assert all(h.status == "finished" for h in hs)
+        eng.pool.assert_quiesced()
+
+    def test_cancel_path(self):
+        eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                            prefill_chunk=8, max_model_len=64)
+        h = eng.submit(list(range(1, 9)),
+                       SamplingParams(max_new_tokens=16))
+        for _ in range(3):
+            eng.step()
+        assert eng.pool.num_used > 0
+        assert h.cancel() is True
+        eng.pool.assert_quiesced()          # released NOW, not at idle
+
+    def test_deadline_expiry_path(self):
+        eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                            prefill_chunk=8, max_model_len=64)
+        h = eng.submit(list(range(1, 7)),
+                       SamplingParams(max_new_tokens=8),
+                       deadlines=Deadlines(ttft_s=1e-4))
+        time.sleep(0.002)
+        eng.run_until_idle(max_steps=200)
+        assert h.status == "expired"
+        eng.pool.assert_quiesced()
+
+    def test_eviction_path(self):
+        from paddle_tpu.serving.scheduler import Request, Scheduler
+        pool = BlockPool(7)                  # capacity 6
+        sched = Scheduler(pool, block_size=8, max_slots=3,
+                          max_model_len=48)
+        key = np.zeros((2,), np.uint32)
+        reqs = [Request([1] * 8, SamplingParams(max_new_tokens=8), key)
+                for _ in range(3)]
+        for r in reqs:
+            sched.submit(r)
+        sched.admit()
+        for r in reqs:
+            assert sched.ensure_blocks(r, 16, evict=False)
+        assert pool.num_free == 0
+        # growth under pressure evicts the youngest: its blocks must
+        # come back to the pool, not leak with the preempted request
+        assert sched.ensure_blocks(reqs[0], 17, evict=True)
+        assert reqs[2].state == "waiting" and reqs[2].blocks == []
+        assert sched.evictions_by_class.get("normal", 0) == 1
+        for r in reqs[:2]:
+            sched.finish(r)
+        pool.assert_quiesced()
+
+    def test_warm_restart_path(self):
+        eng = ServingEngine(_small_gpt(), max_slots=2, block_size=8,
+                            prefill_chunk=8, max_model_len=64,
+                            restart_backoff_s=0.01)
+        calls = {"n": 0}
+        orig = eng._decode_greedy_jit
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise tag_transient(OSError(5, "injected fault"))
+            return orig(*a, **k)
+
+        eng._decode_greedy_jit = flaky
+        with eng:
+            h = eng.submit(list(range(1, 8)),
+                           SamplingParams(max_new_tokens=6))
+            h.result(timeout=180)
+        assert calls["n"] >= 2               # the fault really fired
+        assert h.status == "finished"
+        # the rebuilt arena is clean AND the old pool was fully
+        # reclaimed before the rebuild (restart releases everything)
+        eng.pool.assert_quiesced()

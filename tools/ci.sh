@@ -8,7 +8,23 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/10] native build =="
+# per-stage wall-time ledger: stage() stamps the boundary between
+# stages; the summary line at the bottom names where the minutes went
+# (a CI run that slows down should say WHICH gate slowed it down)
+STAGE_TIMES=""
+_stage_name=""
+_stage_t0=$SECONDS
+stage() {
+  local now=$SECONDS
+  if [ -n "$_stage_name" ]; then
+    STAGE_TIMES="${STAGE_TIMES}${STAGE_TIMES:+, }${_stage_name} $((now - _stage_t0))s"
+  fi
+  _stage_name="$1"
+  _stage_t0=$now
+  if [ -n "$1" ]; then echo "== $1 =="; fi
+}
+
+stage "[1/10] native build"
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -37,13 +53,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-echo "== [2/10] api-surface audit =="
+stage "[2/10] api-surface audit"
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-echo "== [3/10] graph doctor + framework lint =="
+stage "[3/10] graph doctor + framework lint"
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -113,8 +129,18 @@ JAX_PLATFORMS=cpu python tools/threaddoctor.py --selfcheck
 # (claimed bytes vs a re-trace of the same sweep program), and the
 # comm DB must refuse non-finite rows and round-trip losslessly
 JAX_PLATFORMS=cpu python tools/commlab.py --selfcheck
+# memory watch gate (tools/memwatch.py over telemetry/mem_obs.py), the
+# observatory selfcheck pattern applied to what the chip HOLDS: the
+# checked-in pressure specimen (tools/specimens/memsnap_pressure.jsonl)
+# must trip the hbm_pressure AND kv_thrash anomalies BY NAME through
+# the real AnomalyDetector, a clean smoke ledger (tagged engine weights
+# + optimizer state + paged-KV arenas sampled live) must validate under
+# trace_check, reconcile against its shape-derived static projection
+# within HealthConfig.mem_reconcile_tol and stay silent, and a captured
+# OOM postmortem must round-trip with its suspects named
+JAX_PLATFORMS=cpu python tools/memwatch.py --selfcheck
 
-echo "== [4/10] training health + compile observatory + bench gates =="
+stage "[4/10] training health + compile observatory + bench gates"
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases, plus the
@@ -196,6 +222,19 @@ JAX_PLATFORMS=cpu python tools/commlab.py --smoke \
     2>> /tmp/bench_health_ci.err \
     || { tail -40 /tmp/bench_health_ci.err >&2
          echo "FATAL: comm-lab smoke failed"; exit 1; }
+# memory-watch smoke (tools/memwatch.py --smoke): the live HBM ledger
+# sampled over a real serving engine + optimizer step with every
+# tagging hook exercised, gated through trace_check inside the tool
+# (exit 14 on any finding — invalid record, fired rule, failed
+# projection reconciliation) with its kind=memsnap records appended to
+# the SAME gated file, so healthwatch below replays the hbm_pressure /
+# kv_thrash / mem_projection_drift rules over the identical records
+# (quiet here: the smoke budget is generous and the ledger reconciles)
+JAX_PLATFORMS=cpu python tools/memwatch.py --smoke \
+    --telemetry /tmp/bench_health_ci.jsonl \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: memory-watch smoke failed"; exit 1; }
 JAX_PLATFORMS=cpu python tools/healthwatch.py /tmp/bench_health_ci.jsonl
 JAX_PLATFORMS=cpu python tools/healthwatch.py \
     tools/specimens/health_anomalous.jsonl \
@@ -220,7 +259,7 @@ JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
 JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
 JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
-echo "== [5/10] serving engine smoke =="
+stage "[5/10] serving engine smoke"
 # continuous-batching serving gate (paddle_tpu/serving +
 # tools/serving_smoke.py), the two-sided pattern:
 #   a) N concurrent streamed requests through the real engine loop
@@ -254,7 +293,7 @@ JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
 #      right on the actual traces.
 JAX_PLATFORMS=cpu python tools/tail_report.py --selfcheck
 
-echo "== [6/10] serving resilience drill =="
+stage "[6/10] serving resilience drill"
 # serving robustness gate (paddle_tpu/serving/resilience +
 # tools/serving_drill.py), the two-sided pattern:
 #   a) --selfcheck first proves the failures are VISIBLE: the
@@ -275,7 +314,7 @@ echo "== [6/10] serving resilience drill =="
 #      kind=serving ledger that passes trace_check.
 JAX_PLATFORMS=cpu python tools/serving_drill.py --selfcheck
 
-echo "== [7/10] resilience chaos drill =="
+stage "[7/10] resilience chaos drill"
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
 #   a) the checked-in corrupt-checkpoint specimen
 #      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
@@ -290,7 +329,7 @@ echo "== [7/10] resilience chaos drill =="
 #      telemetry ledger validating under tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
 
-echo "== [8/10] elastic mesh drill =="
+stage "[8/10] elastic mesh drill"
 # host-loss gate (distributed.elastic + resilience.reshard +
 # tools/elastic_drill.py), the two-sided pattern:
 #   a) the checked-in cross-layout specimen
@@ -307,12 +346,12 @@ echo "== [8/10] elastic mesh drill =="
 #      by tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/elastic_drill.py --selfcheck
 
-echo "== [9/10] test suite =="
+stage "[9/10] test suite"
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-echo "== [10/10] op benchmark gate =="
+stage "[10/10] op benchmark gate"
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0
@@ -330,4 +369,6 @@ else
       tools/op_bench_baseline_v5e.json /tmp/op_bench_current.json \
       --threshold 0.25
 fi
+stage ""   # close the last stage so the ledger covers all ten
+echo "stage wall times: ${STAGE_TIMES} (total ${SECONDS}s)"
 echo "CI OK"

@@ -26,9 +26,15 @@ telemetry/comm_obs) run the comm_bw_degraded rule against the DB
 reference riding on the record; request-trace records (kind=reqtrace,
 telemetry.reqtrace) run the tail_latency rule — requests dominated by
 a serving pathology (queue wait / preemption / warm restart / CoW)
-count per cause and page past the threshold. Detector knobs (--window, --z-loss, --z-grad,
---z-step-time, --min-points, --ckpt-stall-s, --tail-frac,
---tail-count) mirror HealthConfig.
+count per cause and page past the threshold; memory-ledger records
+(kind=memsnap, telemetry/mem_obs via tools/memwatch.py) run the
+hbm_pressure / kv_thrash / mem_projection_drift rules — the budget,
+rates and projection each rule judges against ride ON the record, so
+replay and production see identical numbers. Detector knobs (--window,
+--z-loss, --z-grad, --z-step-time, --min-points, --ckpt-stall-s,
+--tail-frac, --tail-count) mirror HealthConfig; `--rules fam1,fam2`
+keeps only those anomaly families in the verdict, so a replay can
+isolate one rule family without muting the others at the source.
 
 Exit codes: 0 clean / all expected families fired; 5 findings in gate
 mode; 9 an expected family did NOT fire (the watcher itself is broken).
@@ -88,6 +94,13 @@ def analyze_file(path, config):
             # restart / CoW forking count per cause and page past the
             # threshold, offline exactly as in production
             pass
+        elif kind == "memsnap":
+            # memory-observatory ledger records (telemetry/mem_obs via
+            # tools/memwatch): replay through the same hbm_pressure /
+            # kv_thrash / mem_projection_drift rules the in-flight
+            # detector runs — budget, windowed rates and static
+            # projection all ride ON the record
+            pass
         else:
             continue
         det.observe(rec)
@@ -113,7 +126,19 @@ def main(argv=None):
     ap.add_argument("--ckpt-stall-s", type=float, default=300.0)
     ap.add_argument("--tail-frac", type=float, default=0.6)
     ap.add_argument("--tail-count", type=int, default=4)
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated anomaly families to keep "
+                         "(e.g. hbm_pressure,kv_thrash); everything "
+                         "else is dropped from the verdict — replay "
+                         "one rule family in isolation")
     args = ap.parse_args(argv)
+
+    keep = None
+    if args.rules is not None:
+        keep = {k.strip() for k in args.rules.split(",") if k.strip()}
+        if not keep:
+            print("--rules given but no family named", file=sys.stderr)
+            return 2
 
     config = HealthConfig(
         action="record", window=args.window, min_points=args.min_points,
@@ -125,6 +150,8 @@ def main(argv=None):
     per_file = {}
     for path in args.paths:
         anoms, n_step, n_phase, problems = analyze_file(path, config)
+        if keep is not None:
+            anoms = [a for a in anoms if a.kind in keep]
         all_anoms += anoms
         all_problems += problems
         per_file[path] = {

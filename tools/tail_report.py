@@ -6,10 +6,14 @@ dominant cause per exemplar.
 A p99 gauge says a request was slow; a request trace
 (paddle_tpu/telemetry/reqtrace.py) says WHY: each record is a span
 timeline tiling the request's life (queued / admit / prefill_chunk /
-decode / preempt / cow_fork / restart_replay / finalize), so the tail
-decomposes into the five mechanisms that can each make one request
-slow — queue wait vs preemption vs warm restart vs long prefill vs
-copy-on-write forking. Findings run through the SAME `tail_latency`
+decode / preempt / cow_fork / restart_replay / collective / transfer /
+finalize), so the tail decomposes into the mechanisms that can each
+make one request slow — queue wait vs preemption vs warm restart vs
+long prefill vs copy-on-write forking, plus the mesh's own time:
+collective sync waits and host<->device transfers carry their own
+breakdown columns (previously charged to `other`, which hid whether a
+slow request waited on compute or on the interconnect). Findings run
+through the SAME `tail_latency`
 rule the in-flight AnomalyDetector carries (paddle_tpu.telemetry.
 health), so what this tool gates on offline is exactly what pages in
 production (the healthwatch pattern).

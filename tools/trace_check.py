@@ -25,7 +25,7 @@ def check_metrics_jsonl(path):
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
     n_serving_records, n_kernel_records, n_reqtrace_records,
     n_kernelbench_records, n_thread_lint_records, n_commbench_records,
-    problems). Positional
+    n_memsnap_records, problems). Positional
     consumers should
     prefer check_pair's named stats dict — this tuple GROWS when a new
     record kind lands (kerneldoctor's selfcheck was silently broken by
@@ -40,12 +40,9 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
-                                                           "empty "
-                                                           "metrics file "
-                                                           "(0 bytes): no "
-                                                           "step was ever "
-                                                           "recorded"]
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
+                f"{path}: empty metrics file (0 bytes): no step was "
+                "ever recorded"]
         with open(path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
@@ -56,8 +53,8 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [f"{path}: "
-                                                       f"unreadable: {e}"]
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
+            f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
     for i, rec in enumerate(records):
@@ -75,6 +72,7 @@ def check_metrics_jsonl(path):
     problems += check_kernelbench_records(records, path)
     problems += check_thread_lint_records(records, path)
     problems += check_commbench_records(records, path)
+    problems += check_memsnap_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -104,9 +102,12 @@ def check_metrics_jsonl(path):
     n_commbench = sum(1 for r in records
                       if isinstance(r, dict)
                       and r.get("kind") == "commbench")
+    n_memsnap = sum(1 for r in records
+                    if isinstance(r, dict)
+                    and r.get("kind") == "memsnap")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
             n_elastic, n_serving, n_kernel, n_reqtrace, n_kernelbench,
-            n_thread_lint, n_commbench, problems)
+            n_thread_lint, n_commbench, n_memsnap, problems)
 
 
 def check_compile_records(records, path):
@@ -926,6 +927,133 @@ def check_commbench_records(records, path):
     return problems
 
 
+# how far kv_occupancy / kv_cache_share may drift from the values
+# recomputable from the block counts on the same record (the counts
+# are exact ints; the fractions are rounded to 6 places on write)
+MEMSNAP_DERIVED_TOL = 1e-4
+
+
+def check_memsnap_records(records, path):
+    """Cross-rules over memory-observatory ledger records
+    (kind='memsnap', telemetry/mem_obs via tools/memwatch.py). The
+    schema basics (non-negative bytes, fractions in [0, 1], postmortem
+    forensics completeness) live in sink.validate_step_record; here
+    the claims that must be recomputable from the record's own fields:
+
+    - when every attribution bucket is present, the buckets must sum
+      EXACTLY to total_bytes — the ledger walk assigns each live array
+      to exactly one bucket, so a mismatch means bytes were invented
+      or dropped after the walk;
+    - headroom_bytes must equal max(0, hbm_budget_bytes - total_bytes)
+      and requires the budget on the record — headroom against an
+      undeclared budget is a claim with no denominator;
+    - the KV block census must tile: held + free + cached ==
+      blocks_total (every pool block is in exactly one of the three
+      states — BlockPool's own invariant, re-proved per record);
+    - kv_occupancy must equal (held + cached) / blocks_total and
+      kv_cache_share must equal cached / blocks_total, each requiring
+      its counts on the record;
+    - the per-class eviction/admission breakdowns, when present, must
+      sum to the cumulative kv_evictions / kv_admissions counters;
+    - a postmortem's top_arrays bytes must each be <= total_bytes — a
+      suspect larger than the whole ledger is a fabricated suspect.
+    """
+    problems = []
+
+    def _num(v):
+        return isinstance(v, (int, float)) and v == v
+
+    buckets = ("params_bytes", "opt_state_bytes", "kv_bytes",
+               "workspace_bytes", "other_bytes")
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict) or rec.get("kind") != "memsnap":
+            continue
+        label = f"memsnap step {rec.get('step')}"
+        total = rec.get("total_bytes")
+        vals = [rec.get(k) for k in buckets]
+        if _num(total) and all(_num(v) for v in vals):
+            bsum = sum(vals)
+            if bsum != total:
+                problems.append(
+                    f"{path}:{i + 1}: {label} buckets sum to {bsum} "
+                    f"but total_bytes claims {total} — the ledger walk "
+                    "assigns every array to exactly one bucket, so "
+                    "bytes were invented or dropped after the walk")
+        head = rec.get("headroom_bytes")
+        budget = rec.get("hbm_budget_bytes")
+        if _num(head):
+            if not _num(budget) or not _num(total):
+                problems.append(
+                    f"{path}:{i + 1}: {label} claims headroom_bytes "
+                    f"{head} without hbm_budget_bytes and total_bytes "
+                    "— headroom against an undeclared budget")
+            elif head != max(0, budget - total):
+                problems.append(
+                    f"{path}:{i + 1}: {label} headroom_bytes {head} "
+                    f"does not match max(0, budget {budget} - total "
+                    f"{total}) = {max(0, budget - total)}")
+        nt = rec.get("kv_blocks_total")
+        nh, nf, nc = (rec.get("kv_blocks_held"),
+                      rec.get("kv_blocks_free"),
+                      rec.get("kv_blocks_cached"))
+        counts_ok = all(isinstance(v, int) for v in (nt, nh, nf, nc))
+        if counts_ok and nh + nf + nc != nt:
+            problems.append(
+                f"{path}:{i + 1}: {label} KV census does not tile: "
+                f"held {nh} + free {nf} + cached {nc} != total {nt} — "
+                "every pool block is in exactly one state")
+        occ = rec.get("kv_occupancy")
+        if _num(occ):
+            if not counts_ok or nt <= 0:
+                problems.append(
+                    f"{path}:{i + 1}: {label} claims kv_occupancy "
+                    f"{occ} without a positive block census — a "
+                    "fraction with no counts behind it")
+            else:
+                want = min(1.0, (nh + nc) / nt)
+                if abs(occ - want) > MEMSNAP_DERIVED_TOL:
+                    problems.append(
+                        f"{path}:{i + 1}: {label} kv_occupancy "
+                        f"{occ:.6g} does not match (held + cached)/"
+                        f"total = {want:.6g}")
+        share = rec.get("kv_cache_share")
+        if _num(share):
+            if not counts_ok or nt <= 0:
+                problems.append(
+                    f"{path}:{i + 1}: {label} claims kv_cache_share "
+                    f"{share} without a positive block census")
+            else:
+                want = min(1.0, nc / nt)
+                if abs(share - want) > MEMSNAP_DERIVED_TOL:
+                    problems.append(
+                        f"{path}:{i + 1}: {label} kv_cache_share "
+                        f"{share:.6g} does not match cached/total = "
+                        f"{want:.6g}")
+        for by_key, cum_key in (("evictions_by_class", "kv_evictions"),
+                                ("admissions_by_class",
+                                 "kv_admissions")):
+            by = rec.get(by_key)
+            cum = rec.get(cum_key)
+            if isinstance(by, dict) and by and isinstance(cum, int):
+                bsum = sum(v for v in by.values()
+                           if isinstance(v, int))
+                if bsum != cum:
+                    problems.append(
+                        f"{path}:{i + 1}: {label} {by_key} sums to "
+                        f"{bsum} but {cum_key} claims {cum} — the "
+                        "per-class breakdown and the cumulative "
+                        "counter disagree")
+        if rec.get("event") == "postmortem" and _num(total):
+            for t in rec.get("top_arrays") or []:
+                b = t.get("bytes") if isinstance(t, dict) else None
+                if isinstance(b, int) and b > total:
+                    problems.append(
+                        f"{path}:{i + 1}: {label} postmortem names a "
+                        f"suspect of {b} bytes, larger than the whole "
+                        f"ledger ({total}) — a fabricated suspect")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -965,7 +1093,7 @@ def check_pair(jsonl_path, trace_path=None):
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
      n_serving, n_kernel, n_reqtrace, n_kernelbench, n_thread_lint,
-     n_commbench, problems) = check_metrics_jsonl(jsonl_path)
+     n_commbench, n_memsnap, problems) = check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
@@ -974,6 +1102,7 @@ def check_pair(jsonl_path, trace_path=None):
              "n_kernelbench": n_kernelbench,
              "n_thread_lint": n_thread_lint,
              "n_commbench": n_commbench,
+             "n_memsnap": n_memsnap,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -1034,6 +1163,8 @@ def main(argv):
         msg += f" ({stats['n_thread_lint']} thread-lint records)"
     if stats.get("n_commbench"):
         msg += f" ({stats['n_commbench']} collective measurements)"
+    if stats.get("n_memsnap"):
+        msg += f" ({stats['n_memsnap']} memory snapshots)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
