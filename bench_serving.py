@@ -36,12 +36,28 @@ the same file (tools/compile_report.py gates it clean in CI).
     python bench_serving.py --cpu --telemetry serving_telemetry.jsonl
     python bench_serving.py --cpu --check-vs-single 1.5   # CI floor
 
+**Fleet mode** (`--fleet N`) benches the tier ABOVE the engine
+(paddle_tpu/fleet): the same concurrent wave through a `FleetRouter`
+over N in-process replicas vs over 1 — `fleet.rated_throughput_
+tokens_per_sec` and `fleet.scaling_efficiency` (aggregate / N x
+single-replica; a fleet whose efficiency decays is paying routing
+overhead the ~linear-scaling target does not allow) — plus a
+shared-prefix affinity leg: templated prompts rendezvous-route to ONE
+replica, so the fleet-wide `serving.prefix_hit_rate` must be > 0 with
+every hit CONCENTRATED on that affine replica, and the streams must
+stay bit-identical to a cold (prefix-cache-off) single engine. Those
+rows are owned by this mode; the default sweep never writes them.
+
+    python bench_serving.py --cpu --fleet 2 --telemetry fleet.jsonl
+
 Exit codes: 0 ok; 4 when --check-vs-single is given and the measured
-ratio falls below it (the bench_gate findings code).
+ratio falls below it (the bench_gate findings code), or when the fleet
+leg's affinity/identity invariants fail.
 """
 import argparse
 import json
 import sys
+import threading
 import time
 
 import numpy as np
@@ -238,10 +254,195 @@ def single_stream_baseline(model, prompts, max_new, reps=3):
     return sorted(runs)[len(runs) // 2]
 
 
+def fleet_phase(args, n_replicas):
+    """Fleet-tier leg: rated throughput + scaling efficiency through a
+    FleetRouter over N in-process replicas (each replica owns its own
+    identically-seeded model — concurrently-tracing engines must not
+    share one), plus the shared-prefix affinity proof. Owns the
+    fleet.* SERVING_BENCH_METRICS rows."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import telemetry
+    from paddle_tpu.fleet import FleetRouter, InProcessReplica
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import (EngineConfig, SamplingParams,
+                                    ServingEngine)
+
+    on_tpu = jax.default_backend() == "tpu"
+    dev = jax.devices()[0]
+    if on_tpu:
+        mcfg = GPTConfig.gpt3_125m(max_seq_len=1024, dropout=0.0)
+        ekw = dict(max_slots=16, block_size=16, prefill_chunk=128,
+                   max_model_len=512, weights="wo8")
+        prompt_len, max_new, tpl_len, tail_len = 128, 64, 96, 32
+    else:
+        # small enough that N replicas + a cold control compile inside
+        # the CI budget; the fleet rows measure SCALING, not the engine
+        mcfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=4, max_seq_len=128, dropout=0.0,
+                         use_flash_attention=False)
+        ekw = dict(max_slots=4, block_size=8, prefill_chunk=8,
+                   max_model_len=64)
+        prompt_len, max_new, tpl_len, tail_len = 12, 12, 16, 6
+    block_size = ekw["block_size"]
+    vocab = mcfg.vocab_size
+
+    def build_engine(engine_id, enable_prefix=True):
+        paddle.seed(0)                 # identical weights per replica
+        m = GPTForPretraining(mcfg)
+        if ekw.get("weights") == "wo8":
+            from paddle_tpu.quant import quantize_for_decode
+            quantize_for_decode(m)
+        e = ServingEngine(m, config=EngineConfig(
+            engine_id=engine_id, enable_prefix_cache=enable_prefix,
+            **ekw))
+        # warm NOW: compiles land sequentially at build time, outside
+        # the timed waves and outside any concurrent trace
+        e.submit(list(range(2, 2 + block_size)),
+                 SamplingParams(max_new_tokens=2))
+        e.run_until_idle()
+        return e
+
+    engines = [build_engine(i) for i in range(n_replicas)]
+    replicas = [InProcessReplica(f"b{i}", e)
+                for i, e in enumerate(engines)]
+    for e in engines:
+        e.start()
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, vocab, (prompt_len + (i % 5) - 2,)).tolist()
+               for i in range(8)]
+
+    def wave(router, n_requests, wave_prompts):
+        results = [None] * n_requests
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = router.generate(
+                    wave_prompts[i % len(wave_prompts)],
+                    {"max_new_tokens": max_new})
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = max(1e-9, time.perf_counter() - t0)
+        if errors:
+            raise RuntimeError(f"fleet wave failed: {errors[:3]}")
+        return sum(len(r) for r in results) / dt, results
+
+    try:
+        # single-replica rated baseline through the SAME router
+        # machinery (1-replica fleet), so the efficiency ratio isolates
+        # fleet scaling, not router/threading overhead; best-of-2 waves
+        single_router = FleetRouter(replicas[:1], block_size=block_size,
+                                    probe_interval_s=0.05)
+        n_single = 2 * ekw["max_slots"]
+        single_tps = max(wave(single_router, n_single, prompts)[0]
+                         for _ in range(2))
+
+        router = FleetRouter(replicas, block_size=block_size,
+                             probe_interval_s=0.05)
+        fleet_tps = max(
+            wave(router, n_replicas * n_single, prompts)[0]
+            for _ in range(2))
+        efficiency = fleet_tps / max(n_replicas * single_tps, 1e-9)
+        print(f"# fleet rated: {fleet_tps:.1f} tok/s over {n_replicas} "
+              f"replicas vs {single_tps:.1f} single "
+              f"(efficiency {efficiency:.3f})", file=sys.stderr)
+
+        # shared-prefix affinity leg: every prompt opens with the same
+        # template (>= 1 full block), so rendezvous prefix affinity must
+        # land ALL of them on one replica where the radix index is warm
+        template = rs.randint(0, vocab, (tpl_len,)).tolist()
+        shared = [template + rs.randint(0, vocab, (tail_len,)).tolist()
+                  for _ in range(8)]
+        before = [e.prefix_stats() for e in engines]
+        router.generate(shared[0], {"max_new_tokens": 2})   # warm the
+        _, warm_streams = wave(router, len(shared), shared)  # affine one
+        after = [e.prefix_stats() for e in engines]
+        hits = [a["hits"] - b["hits"] for a, b in zip(after, before)]
+        saved = sum(a["tokens_saved"] - b["tokens_saved"]
+                    for a, b in zip(after, before))
+        offered = sum(a["tokens_offered"] - b["tokens_offered"]
+                      for a, b in zip(after, before))
+        hit_rate = saved / offered if offered else 0.0
+        affine = int(np.argmax(hits)) if any(hits) else None
+        concentrated = sum(hits) > 0 and max(hits) == sum(hits)
+        print(f"# fleet shared-prefix: hit_rate {round(hit_rate, 4)}, "
+              f"hits per replica {hits} (affine b{affine}, "
+              f"concentrated={concentrated})", file=sys.stderr)
+    finally:
+        for e in engines:
+            e.stop()
+
+    # the cold reference: a fresh prefix-cache-OFF single engine must
+    # produce bit-identical streams — affinity is placement, and
+    # placement must be invisible in the output
+    control = build_engine(1000 + n_replicas, enable_prefix=False)
+    refs = []
+    for p in shared:
+        h = control.submit(p, SamplingParams(max_new_tokens=max_new))
+        control.run_until_idle()
+        refs.append(list(h.output_tokens))
+    identical = [list(s) for s in warm_streams] == refs
+
+    tsink = telemetry.JsonlSink(args.telemetry)
+    summary = {
+        "metric": "fleet.rated_throughput_tokens_per_sec",
+        "value": round(fleet_tps, 1),
+        "unit": "tokens/sec",
+        "fleet.rated_throughput_tokens_per_sec": round(fleet_tps, 1),
+        "fleet.scaling_efficiency": round(efficiency, 4),
+        "fleet.replicas": n_replicas,
+        "single_replica_tokens_per_sec": round(single_tps, 1),
+        "serving.prefix_hit_rate": round(hit_rate, 4),
+        "prefix_hits_per_replica": hits,
+        "prefix_affine_replica": affine,
+        "prefix_hits_concentrated": concentrated,
+        "prefix_streams_identical": identical,
+    }
+    for name, unit in (("fleet.rated_throughput_tokens_per_sec",
+                        "tokens/sec"),
+                       ("fleet.scaling_efficiency", "frac"),
+                       ("fleet.replicas", "replicas")):
+        tsink.write(telemetry.make_bench_record(
+            name, summary[name], unit=unit, device=dev.device_kind))
+    tsink.close()
+    print(json.dumps(summary))
+
+    rc = 0
+    if not identical:
+        print("FAIL: fleet shared-prefix streams diverged from the "
+              "cold single-engine control", file=sys.stderr)
+        rc = 4
+    if hit_rate <= 0:
+        print("FAIL: fleet-wide prefix hit rate is zero — affinity "
+              "routing never landed a prompt on its warm replica",
+              file=sys.stderr)
+        rc = 4
+    elif not concentrated:
+        print(f"FAIL: prefix hits scattered across replicas {hits} — "
+              "rendezvous affinity is not concentrating the shared "
+              "template", file=sys.stderr)
+        rc = 4
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--cpu", action="store_true",
                     help="hermetic CPU smoke config (CI)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="fleet mode: bench a FleetRouter over N "
+                         "in-process replicas (owns the fleet.* rows); "
+                         "skips the single-engine sweep")
     ap.add_argument("--telemetry", default="serving_telemetry.jsonl")
     ap.add_argument("--slo-ttft-ms", type=float, default=None,
                     help="p99 TTFT SLO (default: config-dependent)")
@@ -255,6 +456,10 @@ def main(argv=None):
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if args.fleet:
+        if args.fleet < 1:
+            ap.error("--fleet needs N >= 1")
+        return fleet_phase(args, args.fleet)
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import telemetry
@@ -397,11 +602,12 @@ def main(argv=None):
     values = dict(summary)
     values["serving.throughput_tokens_per_sec"] = summary["value"]
     for name in SERVING_BENCH_METRICS:
-        if name.startswith("serving.rated_"):
+        if name.startswith("serving.rated_") or name.startswith("fleet."):
             # the rated-load SLO rows are owned by the resilience
-            # drill's leg (tools/serving_drill.py --rated-only), which
-            # runs into the same gated file right after this sweep —
-            # a null placeholder here would shadow a real measurement
+            # drill's leg (tools/serving_drill.py --rated-only) and the
+            # fleet.* rows by this bench's own --fleet mode — both run
+            # into the same gated file; a null placeholder here would
+            # shadow a real measurement
             continue
         v = values.get(name)
         extra = {}

@@ -96,7 +96,7 @@ from .io.serialization import save, load  # noqa: F401
 
 # heavier subpackages are imported lazily to keep import cost low
 _LAZY = ("distributed", "vision", "text", "hapi", "profiler", "inference",
-         "ops", "incubate", "static", "onnx")
+         "ops", "incubate", "static", "onnx", "fleet")
 
 
 def __getattr__(name):

@@ -1,0 +1,36 @@
+"""Fleet tier: a resilient router/front over N serving-engine replicas.
+
+One process, one engine was the ceiling the ROADMAP named; this package
+is the tier above it — the part of "serving heavy traffic from millions
+of users" that survives a replica dying mid-stream, because at fleet
+scale replica loss is the steady state, not the exception.
+
+- `replica` — the one backend interface (`Replica`) with two
+  implementations: `InProcessReplica` (an engine in this process, health
+  read straight off its internals) and `HTTPReplica` (a remote
+  `serving/http.py` front, health probed via the /livez-vs-/healthz
+  split, streams consumed as chunked JSONL).
+- `router` — `FleetRouter`: replica registry with circuit-breakered
+  health probes and consecutive-miss death declaration, prefix-affinity
+  / session-sticky / least-loaded routing, cross-replica admission
+  shedding, failover replay with stream splicing (token-identical by
+  the engine's recompute-replay invariant — and proven, not assumed),
+  and drain-aware rolling restarts. Every decision is a typed
+  `kind=fleet` telemetry record.
+- `http` — `FleetHTTPServer`: the fleet's own /generate front with
+  failover built in, plus /metrics (fleet.* gauges), /healthz, /livez,
+  /replicas.
+"""
+from .replica import HTTPReplica, InProcessReplica, Replica  # noqa: F401
+from .router import FleetRouter, FleetShedError, NoHealthyReplicaError  # noqa: F401
+
+__all__ = ["Replica", "InProcessReplica", "HTTPReplica", "FleetRouter",
+           "FleetShedError", "NoHealthyReplicaError", "FleetHTTPServer"]
+
+
+def __getattr__(name):
+    if name == "FleetHTTPServer":     # lazy: pulls in http.server
+        from .http import FleetHTTPServer
+        return FleetHTTPServer
+    raise AttributeError(f"module 'paddle_tpu.fleet' has no attribute "
+                         f"{name!r}")

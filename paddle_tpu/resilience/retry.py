@@ -34,7 +34,8 @@ import time
 
 __all__ = ["RetryPolicy", "RetryBudget", "RetryError", "with_retry",
            "retrying", "is_transient", "classify_failure",
-           "tag_transient"]
+           "tag_transient", "classify_http_status", "retry_after_hint",
+           "HTTPStatusError", "TRANSIENT_HTTP_STATUSES"]
 
 # errno values worth retrying: transient kernel/FS/network conditions.
 # Deliberately NOT here: ENOSPC/EDQUOT (disk full stays full), EACCES/
@@ -59,6 +60,56 @@ _PROGRAMMING_TYPES = (ValueError, TypeError, KeyError, IndexError,
                       RecursionError, UnboundLocalError)
 
 
+# HTTP statuses worth retrying — the serving tier's own refusal
+# vocabulary (serving/http.py): 429 is an admission shed and 503 a
+# drain, both of which ship a Retry-After that IS the backoff hint;
+# 504 is a server-side deadline (the request was fine, the moment was
+# not). Deliberately NOT here: every other 4xx (the request itself is
+# wrong — retrying replays the same rejection), and other 5xx (can't
+# prove transient; the three-way classifier calls them 'infra').
+TRANSIENT_HTTP_STATUSES = frozenset({429, 503, 504})
+
+
+def classify_http_status(status):
+    """Three-way taxonomy for an HTTP status from a serving replica:
+    429/503/504 'transient' (shed / draining / deadline — the fleet
+    router retries elsewhere, honoring Retry-After), other 4xx
+    'permanent' (the request is malformed; another replica would reject
+    it identically), anything else 'infra'."""
+    status = int(status)
+    if status in TRANSIENT_HTTP_STATUSES:
+        return "transient"
+    if 400 <= status < 500:
+        return "permanent"
+    return "infra"
+
+
+def retry_after_hint(exc):
+    """The server's Retry-After hint carried on `exc` (seconds, float),
+    or None. `with_retry` uses it as a backoff FLOOR: the server said
+    when the queue will have drained — coming back sooner just re-sheds."""
+    hint = getattr(exc, "retry_after_s", None)
+    if hint is None:
+        return None
+    try:
+        hint = float(hint)
+    except (TypeError, ValueError):
+        return None
+    return hint if hint >= 0 else None
+
+
+class HTTPStatusError(RuntimeError):
+    """A non-2xx reply from a serving replica, classified by status.
+    `http_status` drives `classify_failure`; `retry_after_s` (when the
+    reply carried a Retry-After header) becomes the backoff base."""
+
+    def __init__(self, message, http_status, retry_after_s=None):
+        super().__init__(message)
+        self.http_status = int(http_status)
+        self.retry_after_s = None if retry_after_s is None \
+            else float(retry_after_s)
+
+
 class RetryError(Exception):
     """All attempts exhausted (or deadline/budget hit). `last` carries
     the final underlying exception; `attempts` how many were made."""
@@ -81,6 +132,9 @@ def is_transient(exc):
     tagged = getattr(exc, "transient", None)
     if tagged is not None:
         return bool(tagged)
+    status = getattr(exc, "http_status", None)
+    if status is not None:
+        return int(status) in TRANSIENT_HTTP_STATUSES
     if isinstance(exc, (TimeoutError, ConnectionError)):
         return True
     if isinstance(exc, _PERMANENT_TYPES):
@@ -125,6 +179,9 @@ def classify_failure(exc):
         return "transient"
     if tagged is False:
         return "permanent"
+    status = getattr(exc, "http_status", None)
+    if status is not None:
+        return classify_http_status(status)
     if is_transient(exc):
         return "transient"
     if isinstance(exc, _PERMANENT_TYPES) or isinstance(exc,
@@ -236,6 +293,12 @@ def with_retry(fn, policy=None, on_retry=None, clock=None, sleep=None,
                 f"{type(last).__name__}: {last}", last=last,
                 attempts=attempt)
         delay = policy.delay(attempt)
+        # a Retry-After hint on the failure is a backoff FLOOR: the
+        # server told us when its queue drains — a jittered draw below
+        # that just re-sheds on arrival
+        hint = retry_after_hint(last)
+        if hint is not None:
+            delay = max(delay, hint)
         if policy.deadline_s is not None and \
                 (clock() - t0) + delay > policy.deadline_s:
             raise RetryError(

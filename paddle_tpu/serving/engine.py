@@ -84,7 +84,7 @@ class EngineConfig:
                  max_queue=None, max_restarts=3, restart_backoff_s=1.0,
                  enable_prefix_cache=True, enable_tracing=True,
                  trace_exemplars=32, hbm_budget_mb=None,
-                 mem_sample_every=1):
+                 mem_sample_every=1, engine_id=None):
         if weights not in ("native", "wo8"):
             raise ValueError(f"weights must be 'native' or 'wo8', "
                              f"got {weights!r}")
@@ -118,6 +118,11 @@ class EngineConfig:
         # jurisdiction) and the step cadence of ledger snapshots
         self.hbm_budget_mb = hbm_budget_mb
         self.mem_sample_every = max(1, int(mem_sample_every))
+        # explicit engine identity for multi-process fleets: the
+        # default per-process counter collides across replicas (every
+        # child's first engine is 0), and the combined fleet ledger
+        # tallies per (rank, engine)
+        self.engine_id = None if engine_id is None else int(engine_id)
 
     @classmethod
     def from_inference_config(cls, config, **overrides):
@@ -173,7 +178,8 @@ class ServingEngine:
     def __init__(self, model, config=None, sink=None, **overrides):
         self.cfg = config or EngineConfig(**overrides)
         cfg = self.cfg
-        self.engine_id = next(_ENGINE_IDS)
+        self.engine_id = next(_ENGINE_IDS) if cfg.engine_id is None \
+            else cfg.engine_id
         self._sink = sink               # threadlint: type=JsonlSink
         self.model = model
         mcfg = model.config
@@ -497,13 +503,24 @@ class ServingEngine:
     # submission / admission control
     # ------------------------------------------------------------------
     def submit(self, prompt_ids, params=None, deadlines=None,
-               priority="normal", **kw):
+               priority="normal", request_id=None, replay_tokens=None,
+               **kw):
         """Queue one generation; returns a RequestHandle whose
         `.tokens()` stream yields ids as the engine emits them.
 
         `deadlines` (resilience.Deadlines) are server-side budgets the
         scheduler enforces at step boundaries; `priority` orders the
         bounded waiting queue ('interactive' | 'normal' | 'batch').
+        `request_id` is the stable client-visible id echoed on every
+        stream event and telemetry record (defaults to
+        'e<engine>-r<rid>'); `replay_tokens` seeds a FAILOVER REPLAY —
+        tokens another replica already streamed before dying. They are
+        treated exactly like a preemption's kept tokens: prefill
+        recomputes their K/V (riding the prefix cache) and decode
+        resumes at fold_in(base, len(replay_tokens)), so the continued
+        stream is token-identical to an uninterrupted run. The handle's
+        stream yields only the NEW tokens (the replayed ones are
+        already on the client's wire).
         Raises `ShedError`/`QueueFullError` (429 + Retry-After at the
         HTTP front) when admission control rejects the request up
         front, `EngineDrainingError` during a graceful drain, and
@@ -518,7 +535,26 @@ class ServingEngine:
             from ..core.random import default_generator
             base = default_generator().split()
         req = Request(prompt_ids, params, np.asarray(base),
-                      deadlines=deadlines, priority=priority)
+                      deadlines=deadlines, priority=priority,
+                      request_id=request_id)
+        if req.request_id is None:
+            req.request_id = f"e{self.engine_id}-r{req.rid}"
+        if replay_tokens:
+            replay = [int(t) for t in replay_tokens]
+            if len(replay) >= params.max_new_tokens:
+                raise ValueError(
+                    f"replay_tokens carries {len(replay)} token(s) but "
+                    f"max_new_tokens is {params.max_new_tokens} — "
+                    "nothing left to stream")
+            if params.eos_token_id is not None and \
+                    int(params.eos_token_id) in replay:
+                raise ValueError(
+                    "replay_tokens contains eos_token_id — the stream "
+                    "already terminated")
+            # direct assignment, NOT push_token: these tokens are
+            # already on the client's wire — they must not enter this
+            # handle's stream queue or stamp first_token_time
+            req.out_tokens = replay
         with self._cv:
             if self._dead:
                 raise EngineDeadError(
@@ -537,6 +573,7 @@ class ServingEngine:
                 self._counts["shed"] += 1
                 monitor.incr("serving.shed")
                 self._record("shed", rid=req.rid,
+                             request_id=req.request_id,
                              queue_depth=e.queue_depth,
                              predicted_wait_ms=e.predicted_wait_ms,
                              retry_after_s=e.retry_after_s,
@@ -556,9 +593,11 @@ class ServingEngine:
             monitor.incr("serving.requests")
             monitor.incr("serving.admitted")
             self._record("admitted", rid=req.rid,
+                         request_id=req.request_id,
                          queue_depth=len(self.sched.waiting),
                          priority=req.priority_class,
-                         queue_deadline_ms=self._queue_deadline_ms(req))
+                         queue_deadline_ms=self._queue_deadline_ms(req),
+                         replayed=len(req.out_tokens) or None)
             self._update_gauges()
             self._cv.notify_all()
         return RequestHandle(req, engine=self)
@@ -1168,7 +1207,9 @@ class ServingEngine:
         self._counts[event] += 1
         if counter is not None:
             monitor.incr(counter)
-        self._record(event, rid=req.rid, n_tokens=len(req.out_tokens),
+        self._record(event, rid=req.rid,
+                     request_id=getattr(req, "request_id", None),
+                     n_tokens=len(req.out_tokens),
                      queue_wait_ms=req.queue_wait_ms(),
                      queue_deadline_ms=self._queue_deadline_ms(req),
                      priority=req.priority_class, error=error, **fields)

@@ -8,11 +8,16 @@ engine process is scrapeable and servable with nothing but the stdlib.
   "decode_strategy": "greedy"|"sampling", "top_k", "top_p",
   "temperature", "eos_token_id", "seed", "stream": bool,
   "priority": "interactive"|"normal"|"batch",
-  "queue_wait_deadline_s", "ttft_deadline_s", "deadline_s"}`.
-  `stream=true` answers chunked `application/jsonl`: one
-  `{"token": id}` line per generated token AS THE ENGINE EMITS IT
-  (continuous batching means concurrent streams interleave at token
-  granularity), then a `{"done": true, "tokens": [...]}` tail — or a
+  "queue_wait_deadline_s", "ttft_deadline_s", "deadline_s",
+  "request_id": str, "replay_tokens": [ids...]}`.
+  `request_id` is a stable client-chosen id echoed on every stream
+  event and telemetry record (the fleet router joins failover halves
+  on it); `replay_tokens` seeds a failover replay — see
+  `ServingEngine.submit`. `stream=true` answers chunked
+  `application/jsonl`: one `{"token": id, "request_id": ...}` line per
+  generated token AS THE ENGINE EMITS IT (continuous batching means
+  concurrent streams interleave at token granularity), then a
+  `{"done": true, "tokens": [...], "request_id": ...}` tail — or a
   terminal `{"error": ..., "status": ...}` line when the request
   failed/expired/was cancelled, so clients always see a clean end of
   stream, never a hang or a broken chunked body.
@@ -161,6 +166,11 @@ class _Handler(BaseHTTPRequestHandler):
             deadlines = Deadlines(**dl) if any(
                 v is not None for v in dl.values()) else None
             stream = bool(req.get("stream", False))
+            request_id = req.get("request_id")
+            replay_tokens = req.get("replay_tokens")
+            if replay_tokens is not None and \
+                    not isinstance(replay_tokens, list):
+                raise ValueError("'replay_tokens' must be an id list")
         except (KeyError, ValueError, TypeError,
                 json.JSONDecodeError) as e:
             self._send(400, json.dumps({"error": str(e)}))
@@ -168,7 +178,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             handle = self.server.engine.submit(
                 [int(t) for t in prompt], params, deadlines=deadlines,
-                priority=priority)
+                priority=priority, request_id=request_id,
+                replay_tokens=replay_tokens)
         except ShedError as e:        # load shed: come back later
             self._send(429, json.dumps(
                 {"error": str(e), "status": "shed",
@@ -212,8 +223,9 @@ class _Handler(BaseHTTPRequestHandler):
                 handle.cancel()
                 self._send(500, json.dumps({"error": str(e)}))
                 return
-            self._send(200, json.dumps({"tokens": toks,
-                                        "stats": handle.stats}))
+            self._send(200, json.dumps(
+                {"tokens": toks, "stats": handle.stats,
+                 "request_id": handle.request_id}))
             return
         # chunked token stream: one JSON line per token as it lands
         self.send_response(200)
@@ -236,26 +248,32 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
         toks = []
-        try:
+        rid = handle.request_id    # echoed on EVERY stream event so a
+        try:                       # fleet router can join spliced halves
             for tok in handle.tokens(timeout=self.server.request_timeout):
                 toks.append(tok)
-                chunk({"token": tok})
-            final = {"done": True, "tokens": toks, "stats": handle.stats}
+                chunk({"token": tok, "request_id": rid})
+            final = {"done": True, "tokens": toks, "stats": handle.stats,
+                     "request_id": rid}
         except _DISCONNECTS:
             abandoned()
             return
         except DeadlineExceededError as e:
-            final = {"error": str(e), "status": "deadline_exceeded"}
+            final = {"error": str(e), "status": "deadline_exceeded",
+                     "request_id": rid}
         except RequestCancelledError as e:
-            final = {"error": str(e), "status": "cancelled"}
+            final = {"error": str(e), "status": "cancelled",
+                     "request_id": rid}
         except (EngineStoppedError, EngineDeadError) as e:
-            final = {"error": str(e), "status": "unavailable"}
+            final = {"error": str(e), "status": "unavailable",
+                     "request_id": rid}
         except Exception as e:        # engine failure / server timeout
             # if the request is still live (request_timeout is the
             # usual case), release its slot + KV blocks now — the
             # server has stopped consuming this stream for good
             handle.cancel()
-            final = {"error": str(e), "status": "failed"}
+            final = {"error": str(e), "status": "failed",
+                     "request_id": rid}
         # terminate the JSONL stream with the final event + the chunked
         # epilogue even on failure — a truncated chunked body looks like
         # an infrastructure fault to the client instead of a clean error

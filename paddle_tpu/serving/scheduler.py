@@ -96,8 +96,12 @@ class Request:    # guarded by: ServingEngine._mu
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, params, rng_key, submit_time=None,
-                 deadlines=None, priority="normal"):
+                 deadlines=None, priority="normal", request_id=None):
         self.rid = next(Request._ids)
+        # the stable CLIENT-visible id (engine `rid`s are per-process
+        # counters — after a fleet failover the replay on replica B gets
+        # a fresh rid, and request_id is what joins the two ledgers)
+        self.request_id = None if request_id is None else str(request_id)
         self.prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not self.prompt:
             raise ValueError("empty prompt")
@@ -249,6 +253,12 @@ class RequestHandle:
     @property
     def finished(self):
         return self._req.state in TERMINAL_STATES
+
+    @property
+    def request_id(self):
+        """The stable client-visible id (echoed on stream events and
+        telemetry records — what a fleet router joins ledgers on)."""
+        return self._req.request_id
 
     @property
     def output_tokens(self):

@@ -230,7 +230,8 @@ class RequestTracer:
             t0_s=tr.t0, ttft_ms=req.ttft_ms(), tpot_ms=req.tpot_ms(),
             queue_wait_ms=req.queue_wait_ms(),
             n_tokens=len(req.out_tokens), prompt_len=len(req.prompt),
-            preemptions=req.preemptions))
+            preemptions=req.preemptions,
+            request_id=getattr(req, "request_id", None)))
 
     def record_shed(self, req, t, queue_depth=None, reason=None):
         """A request admission rejected up front: its trace is the
@@ -241,7 +242,8 @@ class RequestTracer:
         return self._note(make_reqtrace_record(
             rid=req.rid, outcome="shed", spans=tr.spans,
             e2e_ms=tr.e2e_ms, rank=self.rank, engine=self.engine_id,
-            t0_s=tr.t0, prompt_len=len(req.prompt)))
+            t0_s=tr.t0, prompt_len=len(req.prompt),
+            request_id=getattr(req, "request_id", None)))
 
     # -- consumers ----------------------------------------------------------
     def timelines(self, n=None):

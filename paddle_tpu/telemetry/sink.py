@@ -145,6 +145,32 @@ REQTRACE_SPAN_KINDS = ("queued", "admit", "shed", "prefill_chunk",
 REQTRACE_OUTCOMES = ("finished", "failed", "cancelled", "expired",
                      "shed")
 
+# required keys of a fleet-tier record (paddle_tpu.fleet FleetRouter —
+# the router/front tier over N engine replicas); optional: replica,
+# to_replica, request_id, policy, healthy, miss_count, detect_s,
+# breaker, streamed_before, streamed_after, n_tokens, queue_depth,
+# retry_after_s, reason, error, counts
+FLEET_RECORD_KEYS = ("schema", "kind", "rank", "event")
+# the fleet lifecycle vocabulary: route (a routing decision — which
+# replica and WHY: prefix_affinity / session / least_loaded), probe
+# (one health-probe verdict; an unhealthy probe carries miss_count, the
+# ElasticCoordinator consecutive-miss pattern one tier up),
+# declared_dead (miss_count consecutive failed probes — must be
+# preceded by at least one failed probe for the same replica, the
+# elastic declared-dead rule), failover (a request resubmitted after
+# replica death or a mid-stream error: must reference a preceding death
+# OR carry the error that justified it), replay_spliced (the spliced
+# stream's accounting: n_tokens MUST equal streamed_before +
+# streamed_after — the recompute-replay invariant made auditable),
+# restart (one rolling-restart step: drain -> quiesce -> restart ->
+# re-admit for one replica), shed (cross-replica admission rejected the
+# request at the fleet door: every replica full/unhealthy), quiesce
+# (the fleet ledger snapshot: requests == admitted + shed, and the sum
+# of per-replica serving admissions must equal fleet admitted +
+# failover re-admissions; tools/trace_check.py enforces all of it).
+FLEET_EVENTS = ("route", "probe", "declared_dead", "failover",
+                "replay_spliced", "restart", "shed", "quiesce")
+
 
 def make_step_record(step, step_ms, compile_ms, rank=0, loss=None,
                      tokens_per_sec=None, mfu=None, mem_bytes=None,
@@ -451,6 +477,66 @@ def make_reqtrace_record(rid, outcome, spans, e2e_ms, rank=0, engine=None,
     return rec
 
 
+def make_fleet_record(event, rank=0, replica=None, to_replica=None,
+                      request_id=None, policy=None, healthy=None,
+                      miss_count=None, detect_s=None, breaker=None,
+                      streamed_before=None, streamed_after=None,
+                      n_tokens=None, queue_depth=None, retry_after_s=None,
+                      reason=None, error=None, counts=None, **extra):
+    """One fleet-tier event as a first-class record (kind='fleet',
+    paddle_tpu.fleet.FleetRouter). `event` is one of FLEET_EVENTS;
+    `replica` names the replica the event is ABOUT (for a failover,
+    the one that failed — `to_replica` is where the request went);
+    `request_id` is the stable client-visible id that joins fleet
+    records to the per-replica kind=serving / kind=reqtrace records;
+    `counts` is the quiesce snapshot of the router's accounting."""
+    if event not in FLEET_EVENTS:
+        raise ValueError(f"fleet event must be one of {FLEET_EVENTS}, "
+                         f"got {event!r}")
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "kind": "fleet",
+        "rank": int(rank),
+        "event": str(event),
+    }
+    if replica is not None:
+        rec["replica"] = str(replica)
+    if to_replica is not None:
+        rec["to_replica"] = str(to_replica)
+    if request_id is not None:
+        rec["request_id"] = str(request_id)
+    if policy is not None:
+        rec["policy"] = str(policy)
+    if healthy is not None:
+        rec["healthy"] = bool(healthy)
+    if miss_count is not None:
+        rec["miss_count"] = int(miss_count)
+    if detect_s is not None:
+        rec["detect_s"] = round(float(detect_s), 4)
+    if breaker is not None:
+        rec["breaker"] = str(breaker)
+    if streamed_before is not None:
+        rec["streamed_before"] = int(streamed_before)
+    if streamed_after is not None:
+        rec["streamed_after"] = int(streamed_after)
+    if n_tokens is not None:
+        rec["n_tokens"] = int(n_tokens)
+    if queue_depth is not None:
+        rec["queue_depth"] = int(queue_depth)
+    if retry_after_s is not None:
+        rec["retry_after_s"] = round(float(retry_after_s), 4)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    if error is not None:
+        rec["error"] = str(error)
+    if counts is not None:
+        rec["counts"] = {str(k): int(v) for k, v in counts.items()}
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
 BENCH_RECORD_KEYS = ("schema", "kind", "metric", "value")
 
 # the SERVING bench-metric family (bench_serving.py over
@@ -501,6 +587,16 @@ SERVING_BENCH_METRICS = {
     # budget once a device round seeds the row — a tracer that starts
     # doing per-token host work fails the gate like any regression
     "serving.trace_overhead_frac": "lower",
+    # the fleet-tier rated leg (bench_serving.py --fleet N): aggregate
+    # rated throughput over N replicas, and scaling efficiency —
+    # aggregate / (N x the single-replica rated figure measured in the
+    # same run). Direction 'higher' on both: a router whose efficiency
+    # decays is paying routing/affinity overhead the ROADMAP's
+    # ~linear-scaling target does not allow. replicas is the
+    # denominator that makes the efficiency row auditable (info).
+    "fleet.rated_throughput_tokens_per_sec": "higher",
+    "fleet.scaling_efficiency": "higher",
+    "fleet.replicas": "info",
 }
 
 # required keys of a Kernel Doctor result record (analysis/kernel_lint
@@ -1453,6 +1549,58 @@ def validate_step_record(rec):
                         problems.append(
                             f"quiesce count {k!r} not a non-negative "
                             f"int: {v!r}")
+        return problems
+    if kind == "fleet":
+        for key in FLEET_RECORD_KEYS:
+            if key not in rec:
+                problems.append(f"fleet record missing '{key}'")
+        ev = rec.get("event")
+        if ev is not None and ev not in FLEET_EVENTS:
+            problems.append(f"unknown fleet event {ev!r} "
+                            f"(expected one of {list(FLEET_EVENTS)})")
+        if ev in ("route", "probe", "declared_dead", "failover",
+                  "replay_spliced", "restart"):
+            if not str(rec.get("replica", "")).strip():
+                problems.append(f"fleet {ev} record names no replica")
+        if ev == "declared_dead":
+            mc = rec.get("miss_count")
+            if not isinstance(mc, int) or mc < 1:
+                problems.append(
+                    f"fleet declared_dead 'miss_count' not a positive "
+                    f"int: {mc!r}")
+        if ev == "failover" and not str(rec.get("to_replica",
+                                                "")).strip():
+            problems.append("fleet failover record names no to_replica "
+                            "— where did the request go?")
+        if ev == "replay_spliced":
+            # the splice must be auditable on its own: both halves and
+            # the total are WHAT it asserts (the cross-rule checks the
+            # arithmetic; the validator checks the fields exist)
+            for key in ("streamed_before", "streamed_after", "n_tokens"):
+                v = rec.get(key)
+                if not isinstance(v, int) or v < 0:
+                    problems.append(
+                        f"fleet replay_spliced '{key}' not a "
+                        f"non-negative int: {v!r}")
+        if ev == "quiesce":
+            counts = rec.get("counts")
+            if not isinstance(counts, dict):
+                problems.append(
+                    "fleet quiesce record carries no counts dict")
+            else:
+                for k, v in counts.items():
+                    if not isinstance(v, int) or v < 0:
+                        problems.append(
+                            f"fleet quiesce count {k!r} not a "
+                            f"non-negative int: {v!r}")
+        for key in ("miss_count", "detect_s", "streamed_before",
+                    "streamed_after", "n_tokens", "queue_depth",
+                    "retry_after_s"):
+            v = rec.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v != v or v < 0):
+                problems.append(
+                    f"'{key}' not a non-negative number: {v!r}")
         return problems
     if kind == "reqtrace":
         for key in REQTRACE_RECORD_KEYS:

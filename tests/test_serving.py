@@ -460,13 +460,14 @@ def test_trace_check_serving_family_rules(tmp_path):
 def test_serving_metrics_in_baseline_and_declared_family_agree():
     """The rolling baseline's serving rows must be exactly the declared
     family with matching directions — a drift here silently un-gates a
-    metric."""
+    metric. The family spans two prefixes: serving.* (one engine) and
+    fleet.* (the bench_serving --fleet leg over N replicas)."""
     import os as _os
     from paddle_tpu.telemetry.sink import SERVING_BENCH_METRICS
     base = json.load(open(_os.path.join(
         _os.path.dirname(__file__), "..", "tools", "bench_baseline.json")))
     rows = {k: v for k, v in base["metrics"].items()
-            if k.startswith("serving.")}
+            if k.startswith(("serving.", "fleet."))}
     assert set(rows) == set(SERVING_BENCH_METRICS)
     for name, spec in rows.items():
         assert spec["direction"] == SERVING_BENCH_METRICS[name], name

@@ -349,6 +349,58 @@ def test_warm_restart_replay_over_prefix_hit():
     assert eng.prefix_index._pool is eng.pool          # rebound post-restart
 
 
+def test_failover_readmission_rides_prefix_cache_token_identical():
+    """The fleet router's failover replay lands as submit(replay_tokens
+    =...) on a WARM replica: the replayed prompt re-matches the blocks
+    the first admission cached there, and the spliced stream (replayed
+    prefix + resumed decode) is token-identical to an uninterrupted
+    run — the recompute-replay invariant, cross-engine."""
+    model = _small_gpt()
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, 512, (18,)).tolist()
+    [ref] = _refs(model, [prompt], 12)
+    eng = _engine(model, max_slots=2)
+    # first admission: the 'replica that survives' serves this prompt
+    # once, populating its radix index with the prompt's full blocks
+    h0 = eng.submit(prompt, SamplingParams(max_new_tokens=12),
+                    request_id="fo-orig")
+    eng.run_until_idle()
+    assert h0.output_tokens == ref
+    hits_before = eng.prefix_stats()["hits"]
+    # ... now a request that streamed 5 tokens on another replica
+    # before it died fails over HERE, replaying what already reached
+    # the client's wire
+    replayed = ref[:5]
+    h1 = eng.submit(prompt, SamplingParams(max_new_tokens=12),
+                    request_id="fo-replay", replay_tokens=replayed)
+    eng.run_until_idle()
+    # only the NEW tokens stream (the replayed ones are already on the
+    # client's wire); output_tokens carries the full spliced stream
+    assert list(h1.tokens(timeout=5)) == ref[5:]
+    assert h1.output_tokens == ref                  # the splice
+    # the engine's own ledger counts ALL tokens, replayed included —
+    # the quantity the router's splice proof checks
+    assert h1.stats["n_tokens"] == len(ref)
+    # the replay re-matched the first admission's cached blocks
+    assert eng.prefix_stats()["hits"] > hits_before
+
+
+def test_replay_tokens_validation():
+    """submit() rejects replays that leave nothing to stream or that
+    already terminated — a malformed failover must fail loudly at the
+    door, not wedge a slot."""
+    model = _small_gpt()
+    eng = _engine(model)
+    prompt = list(range(2, 14))
+    with pytest.raises(ValueError, match="nothing left to stream"):
+        eng.submit(prompt, SamplingParams(max_new_tokens=4),
+                   replay_tokens=[1, 2, 3, 4])
+    with pytest.raises(ValueError, match="eos_token_id"):
+        eng.submit(prompt,
+                   SamplingParams(max_new_tokens=8, eos_token_id=3),
+                   replay_tokens=[1, 2, 3])
+
+
 def test_stale_index_on_serve_loop_keeps_request_and_self_heals():
     """A stale index binding raises BEFORE the admission pop, so the
     request stays queued — and the background loop's warm restart

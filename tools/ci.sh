@@ -24,7 +24,7 @@ stage() {
   if [ -n "$1" ]; then echo "== $1 =="; fi
 }
 
-stage "[1/10] native build"
+stage "[1/11] native build"
 if command -v cmake >/dev/null && command -v ninja >/dev/null; then
   cmake -S csrc -B csrc/build/cmake -G Ninja >/dev/null
   cmake --build csrc/build/cmake >/dev/null
@@ -53,13 +53,13 @@ csrc/build/predictor_smoke "$SMOKE_DIR/m" csrc/build/libpjrt_mock.so \
     | grep -q "^OK" && echo "native serving smoke OK"
 rm -rf "$SMOKE_DIR"
 
-stage "[2/10] api-surface audit"
+stage "[2/11] api-surface audit"
 python tools/api_audit.py --out api_gap.json --strict
 # signature-level diff (check_api_compatible.py analog): param names,
 # relative order, and no new required params vs the reference
 python tools/api_sig_audit.py --out api_sig_gap.json --strict
 
-stage "[3/10] graph doctor + framework lint"
+stage "[3/11] graph doctor + framework lint"
 # pre-flight static analysis (paddle_tpu/analysis): the GPT config's
 # traced step + sharding specs must lint clean, every rule family must
 # demonstrably fire on its broken specimen, and a new framework-lint
@@ -140,7 +140,7 @@ JAX_PLATFORMS=cpu python tools/commlab.py --selfcheck
 # OOM postmortem must round-trip with its suspects named
 JAX_PLATFORMS=cpu python tools/memwatch.py --selfcheck
 
-stage "[4/10] training health + compile observatory + bench gates"
+stage "[4/11] training health + compile observatory + bench gates"
 # the health monitor's offline analyzer (tools/healthwatch.py) replays
 # the SAME anomaly rules the in-flight monitor runs:
 #   a) the CPU smoke-bench telemetry (GPT + ResNet phases, plus the
@@ -192,6 +192,20 @@ JAX_PLATFORMS=cpu python tools/serving_drill.py --rated-only \
     2>> /tmp/bench_health_ci.err \
     || { tail -40 /tmp/bench_health_ci.err >&2
          echo "FATAL: serving rated-load leg failed"; exit 1; }
+# fleet-tier rated leg (bench_serving.py --cpu --fleet 2): the same
+# concurrent wave through a FleetRouter over 2 in-process replicas vs
+# over 1 — fleet.rated_throughput_tokens_per_sec +
+# fleet.scaling_efficiency land in the SAME gated file (baseline rows
+# seeded, wide 0.5 threshold: CPU efficiency measures host contention,
+# not router overhead), and the shared-prefix affinity leg must show a
+# fleet-wide prefix hit rate > 0 with every hit CONCENTRATED on the
+# rendezvous-affine replica and streams bit-identical to a cold
+# prefix-cache-off single engine (exit 4 otherwise)
+JAX_PLATFORMS=cpu python bench_serving.py --cpu --fleet 2 \
+    --telemetry /tmp/bench_health_ci.jsonl \
+    2>> /tmp/bench_health_ci.err \
+    || { tail -40 /tmp/bench_health_ci.err >&2
+         echo "FATAL: fleet bench leg failed"; exit 1; }
 # kernel-lab smoke (tools/kernellab.py --smoke): every registered
 # Pallas kernel measured once — compile-excluded median-of-k, declared
 # fallback timed on the SAME inputs — with the kind=kernelbench
@@ -259,7 +273,7 @@ JAX_PLATFORMS=cpu python tools/compile_report.py --selfcheck \
 JAX_PLATFORMS=cpu python tools/bench_gate.py --selfcheck
 JAX_PLATFORMS=cpu python tools/bench_gate.py /tmp/bench_health_ci.jsonl
 
-stage "[5/10] serving engine smoke"
+stage "[5/11] serving engine smoke"
 # continuous-batching serving gate (paddle_tpu/serving +
 # tools/serving_smoke.py), the two-sided pattern:
 #   a) N concurrent streamed requests through the real engine loop
@@ -293,7 +307,7 @@ JAX_PLATFORMS=cpu python tools/serving_smoke.py --selfcheck
 #      right on the actual traces.
 JAX_PLATFORMS=cpu python tools/tail_report.py --selfcheck
 
-stage "[6/10] serving resilience drill"
+stage "[6/11] serving resilience drill"
 # serving robustness gate (paddle_tpu/serving/resilience +
 # tools/serving_drill.py), the two-sided pattern:
 #   a) --selfcheck first proves the failures are VISIBLE: the
@@ -314,7 +328,29 @@ stage "[6/10] serving resilience drill"
 #      kind=serving ledger that passes trace_check.
 JAX_PLATFORMS=cpu python tools/serving_drill.py --selfcheck
 
-stage "[7/10] resilience chaos drill"
+stage "[7/11] fleet drill"
+# fleet-tier robustness gate (paddle_tpu/fleet + tools/fleet_drill.py),
+# the two-sided pattern one tier above the serving drill:
+#   a) --selfcheck first proves the failures are VISIBLE: the
+#      checked-in failover-without-death specimen (a failover record
+#      no death or error justifies) and the splice-mismatch specimen
+#      (a replayed stream whose n_tokens != streamed_before +
+#      streamed_after) must each be CAUGHT by tools/trace_check.py's
+#      kind=fleet cross-rules;
+#   b) then a mini in-process drill runs the real thing: 2 engine
+#      replicas behind a FleetRouter, an injected mid-stream replica
+#      failure, failover replay — every stream token-identical to the
+#      single-engine reference, the combined router+engine ledger
+#      trace_check-clean including the fleet quiesce accounting
+#      identity (requests == first-admissions + sheds + rejections)
+#      and the per-engine admission agreement.
+# Exit codes: 12 drill findings, 9 selfcheck miss — distinct from
+# serving_drill 11 / chaos_drill 8 / trace_check 7 so logs
+# disambiguate. (The full 3-process SIGKILL drill is the slow-tier
+# run: tools/fleet_drill.py with no flags.)
+JAX_PLATFORMS=cpu python tools/fleet_drill.py --selfcheck
+
+stage "[8/11] resilience chaos drill"
 # fault-tolerance gate (paddle_tpu.resilience + tools/chaos_drill.py):
 #   a) the checked-in corrupt-checkpoint specimen
 #      (tools/specimens/ckpt_corrupt) must be REJECTED by manifest
@@ -329,7 +365,7 @@ stage "[7/10] resilience chaos drill"
 #      telemetry ledger validating under tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/chaos_drill.py --selfcheck
 
-stage "[8/10] elastic mesh drill"
+stage "[9/11] elastic mesh drill"
 # host-loss gate (distributed.elastic + resilience.reshard +
 # tools/elastic_drill.py), the two-sided pattern:
 #   a) the checked-in cross-layout specimen
@@ -346,12 +382,12 @@ stage "[8/10] elastic mesh drill"
 #      by tools/trace_check.py.
 JAX_PLATFORMS=cpu python tools/elastic_drill.py --selfcheck
 
-stage "[9/10] test suite"
+stage "[10/11] test suite"
 # 4 xdist shards (reference `tools/parallel_UT_rule.py` CI sharding):
 # each worker process builds its own 8-virtual-device CPU platform
 python -m pytest tests/ -q -n auto --dist loadfile
 
-stage "[10/10] op benchmark gate"
+stage "[11/11] op benchmark gate"
 # backend init can HANG when the device tunnel is wedged (observed), so
 # the probe runs under a hard timeout; timeout/failure -> gate skipped
 probe_rc=0
@@ -369,6 +405,6 @@ else
       tools/op_bench_baseline_v5e.json /tmp/op_bench_current.json \
       --threshold 0.25
 fi
-stage ""   # close the last stage so the ledger covers all ten
+stage ""   # close the last stage so the ledger covers all eleven
 echo "stage wall times: ${STAGE_TIMES} (total ${SECONDS}s)"
 echo "CI OK"

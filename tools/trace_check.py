@@ -25,7 +25,7 @@ def check_metrics_jsonl(path):
     n_ckpt_records, n_bench_records, n_plan_records, n_elastic_records,
     n_serving_records, n_kernel_records, n_reqtrace_records,
     n_kernelbench_records, n_thread_lint_records, n_commbench_records,
-    n_memsnap_records, problems). Positional
+    n_memsnap_records, n_fleet_records, problems). Positional
     consumers should
     prefer check_pair's named stats dict — this tuple GROWS when a new
     record kind lands (kerneldoctor's selfcheck was silently broken by
@@ -40,7 +40,7 @@ def check_metrics_jsonl(path):
     records = []
     try:
         if os.path.getsize(path) == 0:
-            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
+            return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
                 f"{path}: empty metrics file (0 bytes): no step was "
                 "ever recorded"]
         with open(path) as f:
@@ -53,7 +53,7 @@ def check_metrics_jsonl(path):
                 except json.JSONDecodeError as e:
                     problems.append(f"{path}:{i + 1}: not JSON: {e}")
     except OSError as e:
-        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
+        return 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, [
             f"{path}: unreadable: {e}"]
     if not records:
         problems.append(f"{path}: no records")
@@ -73,6 +73,7 @@ def check_metrics_jsonl(path):
     problems += check_thread_lint_records(records, path)
     problems += check_commbench_records(records, path)
     problems += check_memsnap_records(records, path)
+    problems += check_fleet_records(records, path)
     n_steps = sum(1 for r in records
                   if isinstance(r, dict) and r.get("kind") == "step")
     n_compiles = sum(1 for r in records
@@ -105,9 +106,11 @@ def check_metrics_jsonl(path):
     n_memsnap = sum(1 for r in records
                     if isinstance(r, dict)
                     and r.get("kind") == "memsnap")
+    n_fleet = sum(1 for r in records
+                  if isinstance(r, dict) and r.get("kind") == "fleet")
     return (len(records), n_steps, n_compiles, n_ckpt, n_bench, n_plan,
             n_elastic, n_serving, n_kernel, n_reqtrace, n_kernelbench,
-            n_thread_lint, n_commbench, n_memsnap, problems)
+            n_thread_lint, n_commbench, n_memsnap, n_fleet, problems)
 
 
 def check_compile_records(records, path):
@@ -1054,6 +1057,152 @@ def check_memsnap_records(records, path):
     return problems
 
 
+def check_fleet_records(records, path):
+    """Cross-record rules for fleet-tier events (kind=fleet,
+    paddle_tpu.fleet.FleetRouter + tools/fleet_drill.py). Ordered
+    rules bind only WITHIN the fleet records (the router emits them
+    from one process, so concatenating per-process ledgers preserves
+    their relative order); rules that join fleet records to the
+    replicas' own kind=serving records are presence-based, because a
+    combined ledger gives no cross-process ordering.
+
+    - a DECLARED_DEAD must be preceded by a failed probe (healthy
+      false) for the same replica — a death the prober never
+      witnessed is a verdict without evidence;
+    - a FAILOVER must reference a replica previously DECLARED DEAD or
+      carry a non-empty `error` — re-routing a live, unerrored
+      replica's request is load-balancing wearing a failover's name,
+      and it would hide real failover bugs in the noise;
+    - a REPLAY_SPLICED record's arithmetic must balance: n_tokens ==
+      streamed_before + streamed_after — the spliced stream claims to
+      be token-identical to an uninterrupted run, and a count that
+      doesn't add up means tokens were dropped or double-streamed at
+      the splice point; it must also follow a FAILOVER for the same
+      request_id (a splice with no failover to explain it);
+    - a fleet QUIESCE's counts must balance: requests == (admitted -
+      failover) + shed + rejected — every request terminates exactly
+      once: a first admission (failovers are RE-admissions), a shed
+      at the fleet door, or a permanent rejection;
+    - the fleet quiesce's `admitted_by_engine` must agree with each
+      engine's OWN serving-quiesce admitted count, for engines whose
+      serving quiesce appears in the ledger (a SIGKILLed replica
+      never quiesces, so it is exempt — its admissions are vouched
+      for by its flushed per-request records instead);
+    - when the ledger carries the replicas' serving admitted records,
+      every failover's request_id must appear on at least TWO of them
+      (the first admission and the replay), at least one marked
+      `replayed` — the replayed request on replica B must reference
+      the same id as its first admission on replica A.
+    """
+    problems = []
+    fleet = [(i, r) for i, r in enumerate(records)
+             if isinstance(r, dict) and r.get("kind") == "fleet"]
+    if not fleet:
+        return problems
+    admitted_rids = {}    # request_id -> [n_admissions, n_replayed]
+    serving_quiesce = {}  # str(engine) -> admitted count (last wins)
+    any_serving_admitted = False
+    for r in records:
+        if not isinstance(r, dict) or r.get("kind") != "serving":
+            continue
+        if r.get("event") == "admitted":
+            any_serving_admitted = True
+            rid = r.get("request_id")
+            if rid is not None:
+                slot = admitted_rids.setdefault(str(rid), [0, 0])
+                slot[0] += 1
+                if r.get("replayed"):
+                    slot[1] += 1
+        elif r.get("event") == "quiesce":
+            counts = r.get("counts")
+            if isinstance(counts, dict) and r.get("engine") is not None:
+                serving_quiesce[str(r.get("engine"))] = \
+                    counts.get("admitted", 0)
+    probe_failed = set()     # replicas with a witnessed failed probe
+    dead = set()             # replicas declared dead so far
+    failover_rids = set()    # request_ids with a failover so far
+    for i, rec in fleet:
+        ev = rec.get("event")
+        replica = rec.get("replica")
+        if ev == "probe" and rec.get("healthy") is False:
+            probe_failed.add(replica)
+        elif ev == "declared_dead":
+            if replica not in probe_failed:
+                problems.append(
+                    f"{path}:{i + 1}: replica {replica!r} declared "
+                    "dead with no preceding failed probe — a death "
+                    "verdict the prober never witnessed")
+            dead.add(replica)
+        elif ev == "failover":
+            rid = rec.get("request_id")
+            if rid is not None:
+                failover_rids.add(str(rid))
+            if replica not in dead and not rec.get("error"):
+                problems.append(
+                    f"{path}:{i + 1}: failover away from replica "
+                    f"{replica!r} which was neither declared dead nor "
+                    "carries an error — a re-route wearing a "
+                    "failover's name")
+            if any_serving_admitted and rid is not None:
+                n_adm, n_replayed = admitted_rids.get(str(rid), (0, 0))
+                # a failover at streamed_before == 0 re-admits WITHOUT
+                # replay tokens (there is nothing to replay), so the
+                # replayed marker is only owed when tokens were already
+                # on the wire
+                need_replayed = bool(rec.get("streamed_before"))
+                if n_adm < 2 or (need_replayed and n_replayed < 1):
+                    problems.append(
+                        f"{path}:{i + 1}: failover for request "
+                        f"{rid!r} but the ledger shows {n_adm} "
+                        f"admission(s) ({n_replayed} replayed) for "
+                        "that id — the replay on the new replica must "
+                        "reference the same request_id as its first "
+                        "admission")
+        elif ev == "replay_spliced":
+            before = rec.get("streamed_before")
+            after = rec.get("streamed_after")
+            n = rec.get("n_tokens")
+            if isinstance(before, int) and isinstance(after, int) and \
+                    isinstance(n, int) and before + after != n:
+                problems.append(
+                    f"{path}:{i + 1}: spliced stream accounting "
+                    f"broken: n_tokens {n} != streamed_before "
+                    f"{before} + streamed_after {after} — tokens were "
+                    "dropped or double-streamed at the splice point")
+            rid = rec.get("request_id")
+            if rid is not None and str(rid) not in failover_rids:
+                problems.append(
+                    f"{path}:{i + 1}: replay_spliced for request "
+                    f"{rid!r} with no preceding failover for that "
+                    "request — a splice nothing explains")
+        elif ev == "quiesce":
+            counts = rec.get("counts")
+            if isinstance(counts, dict):
+                req = counts.get("requests", 0)
+                first = counts.get("admitted", 0) \
+                    - counts.get("failover", 0)
+                expect = first + counts.get("shed", 0) \
+                    + counts.get("rejected", 0)
+                if req != expect:
+                    problems.append(
+                        f"{path}:{i + 1}: fleet quiesce counts don't "
+                        f"balance: requests {req} != (admitted - "
+                        f"failover) + shed + rejected {expect} — a "
+                        "request terminated zero or twice")
+            by_engine = rec.get("admitted_by_engine")
+            if isinstance(by_engine, dict):
+                for eng, n_adm in by_engine.items():
+                    have = serving_quiesce.get(str(eng))
+                    if have is not None and have != n_adm:
+                        problems.append(
+                            f"{path}:{i + 1}: fleet routed {n_adm} "
+                            f"admission(s) to engine {eng} but that "
+                            f"engine's own quiesce counted {have} — "
+                            "the router and the replica disagree "
+                            "about what was admitted")
+    return problems
+
+
 def check_chrome_trace(path):
     """Returns (n_events, ranks, problems)."""
     problems = []
@@ -1093,7 +1242,8 @@ def check_pair(jsonl_path, trace_path=None):
     re-parse the files."""
     (n_rec, n_steps, n_compiles, n_ckpt, n_bench, n_plan, n_elastic,
      n_serving, n_kernel, n_reqtrace, n_kernelbench, n_thread_lint,
-     n_commbench, n_memsnap, problems) = check_metrics_jsonl(jsonl_path)
+     n_commbench, n_memsnap, n_fleet, problems) = \
+        check_metrics_jsonl(jsonl_path)
     stats = {"n_records": n_rec, "n_steps": n_steps,
              "n_compiles": n_compiles, "n_ckpt": n_ckpt,
              "n_bench": n_bench, "n_plan": n_plan,
@@ -1103,6 +1253,7 @@ def check_pair(jsonl_path, trace_path=None):
              "n_thread_lint": n_thread_lint,
              "n_commbench": n_commbench,
              "n_memsnap": n_memsnap,
+             "n_fleet": n_fleet,
              "n_events": 0, "ranks": set()}
     if trace_path is not None:
         n_ev, ranks, trace_problems = check_chrome_trace(trace_path)
@@ -1165,6 +1316,8 @@ def main(argv):
         msg += f" ({stats['n_commbench']} collective measurements)"
     if stats.get("n_memsnap"):
         msg += f" ({stats['n_memsnap']} memory snapshots)"
+    if stats.get("n_fleet"):
+        msg += f" ({stats['n_fleet']} fleet events)"
     if trace_path:
         msg += (f"; {stats['n_events']} trace events over ranks "
                 f"{sorted(stats['ranks'])} in {trace_path}")
